@@ -1,0 +1,31 @@
+"""garage_tpu — a TPU-native, S3-compatible, geo-distributed object store.
+
+A from-scratch rebuild of the capabilities of Garage (reference:
+/root/reference, Rust) with the block data path — Reed-Solomon GF(2^8)
+erasure coding and content hashing — running as JAX/Pallas kernels on TPU.
+
+Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
+
+  utils/     foundation: ids+hashes, config, CRDTs, versioned encoding,
+             background workers            (ref: src/util)
+  db/        embedded KV facade (sqlite + in-memory engines)
+                                           (ref: src/db)
+  ops/       the TPU data plane: GF(2^8) linear algebra, RS(k,m) codec,
+             batched tree hashing — jnp + Pallas kernels (no ref analogue;
+             replaces CPU hashing/zstd hot loops of src/block, src/api/s3/put.rs)
+  parallel/  jax.sharding meshes + sharded encode/scrub pipelines for
+             multi-chip (replaces nothing in ref; TPU-native scale axis)
+  net/       asyncio transport mesh: auth, framing, priorities, streams
+                                           (ref: src/net)
+  rpc/       membership, cluster layout (max-flow), quorum engine
+                                           (ref: src/rpc)
+  table/     replicated CRDT table engine with Merkle anti-entropy
+                                           (ref: src/table)
+  block/     content-addressed block store behind a BlockCodec boundary:
+             replicate-N (CPU) and erasure(k,m) (TPU)  (ref: src/block)
+  models/    application schemas + composition root    (ref: src/model)
+  api/       S3/K2V/admin HTTP frontends               (ref: src/api)
+  cli/       operator CLI + server entrypoint          (ref: src/garage)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,405 @@
+"""KV facade + sqlite/memory engines.
+
+Ref parity: src/db/lib.rs:28-432 (Db, Tree, Transaction, TxResult, on_commit),
+src/db/sqlite_adapter.rs, src/db/open.rs:65-125 (engine selection).
+
+Concurrency model: engine calls are synchronous and guarded by an RLock; the
+asyncio server calls them directly (ops are sub-millisecond) or via
+asyncio.to_thread for bulk scans. Transactions are serializable: one writer at
+a time (the RLock), like the reference's LMDB single-writer model.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import shutil
+import sqlite3
+import threading
+from typing import Callable, Iterator, Optional, Tuple
+
+
+class TxAbort(Exception):
+    """Raise inside a transaction body to roll back. ref: db/lib.rs TxError::Abort."""
+
+    def __init__(self, value=None):
+        self.value = value
+
+
+class Db:
+    def __init__(self, engine: "_Engine"):
+        self._engine = engine
+        self._lock = threading.RLock()
+        self._trees: dict[str, Tree] = {}
+
+    @property
+    def engine_name(self) -> str:
+        return self._engine.NAME
+
+    def open_tree(self, name: str) -> "Tree":
+        with self._lock:
+            t = self._trees.get(name)
+            if t is None:
+                self._engine.ensure_tree(name)
+                t = Tree(self, name)
+                self._trees[name] = t
+            return t
+
+    def list_trees(self) -> list[str]:
+        return self._engine.list_trees()
+
+    def transaction(self, body: Callable[["Transaction"], object]):
+        """Run `body(tx)`; commit on return, roll back on TxAbort/exception.
+        Returns body's return value; TxAbort re-raises after rollback.
+        on_commit hooks registered via tx.on_commit run after a successful
+        commit (ref: db/lib.rs:322)."""
+        with self._lock:
+            tx = Transaction(self._engine)
+            self._engine.begin()
+            try:
+                result = body(tx)
+            except BaseException:
+                self._engine.rollback()
+                raise
+            self._engine.commit()
+            for hook in tx._hooks:
+                hook()
+            return result
+
+    def snapshot(self, to_dir: str) -> None:
+        """Engine-level hot copy. ref: db/lib.rs snapshot, model/snapshot.rs."""
+        with self._lock:
+            self._engine.snapshot(to_dir)
+
+    def close(self) -> None:
+        with self._lock:
+            self._engine.close()
+
+
+class Tree:
+    """A named keyspace with ordered byte keys. ref: db/lib.rs:98-270."""
+
+    def __init__(self, db: Db, name: str):
+        self._db = db
+        self._e = db._engine
+        self.name = name
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._db._lock:
+            return self._e.get(self.name, key)
+
+    def insert(self, key: bytes, value: bytes) -> Optional[bytes]:
+        """Returns previous value (the reference returns the old value)."""
+        with self._db._lock:
+            self._e.begin()
+            try:
+                old = self._e.get(self.name, key)
+                self._e.put(self.name, key, value)
+            except BaseException:
+                self._e.rollback()
+                raise
+            self._e.commit()
+            return old
+
+    def remove(self, key: bytes) -> Optional[bytes]:
+        with self._db._lock:
+            self._e.begin()
+            try:
+                old = self._e.get(self.name, key)
+                if old is not None:
+                    self._e.delete(self.name, key)
+            except BaseException:
+                self._e.rollback()
+                raise
+            self._e.commit()
+            return old
+
+    def clear(self) -> None:
+        with self._db._lock:
+            self._e.begin()
+            self._e.clear(self.name)
+            self._e.commit()
+
+    def __len__(self) -> int:
+        with self._db._lock:
+            return self._e.length(self.name)
+
+    def first(self) -> Optional[Tuple[bytes, bytes]]:
+        for kv in self.iter():
+            return kv
+        return None
+
+    def get_gt(self, key: bytes) -> Optional[Tuple[bytes, bytes]]:
+        for kv in self.iter(start=key + b"\x00"):
+            return kv
+        return None
+
+    def iter(self, start: Optional[bytes] = None, end: Optional[bytes] = None,
+             reverse: bool = False) -> Iterator[Tuple[bytes, bytes]]:
+        """Ordered scan over [start, end). Materialized per-call to stay
+        consistent under concurrent writes (scans here are short/batched)."""
+        with self._db._lock:
+            items = self._e.range(self.name, start, end, reverse)
+        return iter(items)
+
+
+class Transaction:
+    """Operations inside Db.transaction(); sees its own writes.
+    ref: db/lib.rs:272-384 (ITx)."""
+
+    def __init__(self, engine: "_Engine"):
+        self._e = engine
+        self._hooks: list[Callable[[], None]] = []
+
+    def get(self, tree: Tree, key: bytes) -> Optional[bytes]:
+        return self._e.get(tree.name, key)
+
+    def insert(self, tree: Tree, key: bytes, value: bytes) -> Optional[bytes]:
+        old = self._e.get(tree.name, key)
+        self._e.put(tree.name, key, value)
+        return old
+
+    def remove(self, tree: Tree, key: bytes) -> Optional[bytes]:
+        old = self._e.get(tree.name, key)
+        if old is not None:
+            self._e.delete(tree.name, key)
+        return old
+
+    def length(self, tree: Tree) -> int:
+        return self._e.length(tree.name)
+
+    def range(self, tree: Tree, start: Optional[bytes] = None,
+              end: Optional[bytes] = None, reverse: bool = False):
+        return self._e.range(tree.name, start, end, reverse)
+
+    def on_commit(self, hook: Callable[[], None]) -> None:
+        self._hooks.append(hook)
+
+
+# ---------------------------------------------------------------- engines
+
+
+class _Engine:
+    NAME = "?"
+
+    def ensure_tree(self, name: str) -> None: ...
+    def list_trees(self) -> list[str]: ...
+    def get(self, tree: str, key: bytes) -> Optional[bytes]: ...
+    def put(self, tree: str, key: bytes, value: bytes) -> None: ...
+    def delete(self, tree: str, key: bytes) -> None: ...
+    def clear(self, tree: str) -> None: ...
+    def length(self, tree: str) -> int: ...
+    def range(self, tree, start, end, reverse) -> list: ...
+    def begin(self) -> None: ...
+    def commit(self) -> None: ...
+    def rollback(self) -> None: ...
+    def snapshot(self, to_dir: str) -> None: ...
+    def close(self) -> None: ...
+
+
+class MemEngine(_Engine):
+    """Sorted in-memory store for tests and the deterministic sim harness."""
+
+    NAME = "memory"
+
+    def __init__(self):
+        # tree -> (dict, sorted key list)
+        self._data: dict[str, dict[bytes, bytes]] = {}
+        self._keys: dict[str, list[bytes]] = {}
+        self._undo: list | None = None
+        self._depth = 0
+
+    def ensure_tree(self, name):
+        if name not in self._data:
+            self._data[name] = {}
+            self._keys[name] = []
+
+    def list_trees(self):
+        return list(self._data)
+
+    def get(self, tree, key):
+        return self._data[tree].get(key)
+
+    def put(self, tree, key, value):
+        d = self._data[tree]
+        if self._undo is not None:
+            self._undo.append((tree, key, d.get(key)))
+        if key not in d:
+            bisect.insort(self._keys[tree], key)
+        d[key] = value
+
+    def delete(self, tree, key):
+        d = self._data[tree]
+        if key in d:
+            if self._undo is not None:
+                self._undo.append((tree, key, d[key]))
+            del d[key]
+            ks = self._keys[tree]
+            i = bisect.bisect_left(ks, key)
+            if i < len(ks) and ks[i] == key:
+                ks.pop(i)
+
+    def clear(self, tree):
+        if self._undo is not None:
+            for k, v in self._data[tree].items():
+                self._undo.append((tree, k, v))
+        self._data[tree] = {}
+        self._keys[tree] = []
+
+    def length(self, tree):
+        return len(self._data[tree])
+
+    def range(self, tree, start, end, reverse):
+        ks = self._keys[tree]
+        lo = bisect.bisect_left(ks, start) if start is not None else 0
+        hi = bisect.bisect_left(ks, end) if end is not None else len(ks)
+        sel = ks[lo:hi]
+        if reverse:
+            sel = list(reversed(sel))
+        d = self._data[tree]
+        return [(k, d[k]) for k in sel]
+
+    def begin(self):
+        self._depth += 1
+        if self._depth == 1:
+            self._undo = []
+
+    def commit(self):
+        self._depth -= 1
+        if self._depth == 0:
+            self._undo = None
+
+    def rollback(self):
+        self._depth -= 1
+        if self._depth == 0 and self._undo is not None:
+            for tree, key, old in reversed(self._undo):
+                if old is None:
+                    self._no_undo_delete(tree, key)
+                else:
+                    self._no_undo_put(tree, key, old)
+            self._undo = None
+
+    def _no_undo_put(self, tree, key, value):
+        d = self._data[tree]
+        if key not in d:
+            bisect.insort(self._keys[tree], key)
+        d[key] = value
+
+    def _no_undo_delete(self, tree, key):
+        d = self._data[tree]
+        if key in d:
+            del d[key]
+            ks = self._keys[tree]
+            i = bisect.bisect_left(ks, key)
+            if i < len(ks) and ks[i] == key:
+                ks.pop(i)
+
+    def snapshot(self, to_dir):
+        raise NotImplementedError("memory engine has no snapshot")
+
+    def close(self):
+        pass
+
+
+class SqliteEngine(_Engine):
+    """sqlite3-backed engine; one SQL table per tree.
+    ref: src/db/sqlite_adapter.rs."""
+
+    NAME = "sqlite"
+
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False,
+                                     isolation_level=None)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute(
+            "PRAGMA synchronous=%s" % ("FULL" if fsync else "OFF"))
+        self._depth = 0
+
+    @staticmethod
+    def _tbl(name: str) -> str:
+        return '"tree_%s"' % name.replace('"', '""')
+
+    def ensure_tree(self, name):
+        self._conn.execute(
+            f"CREATE TABLE IF NOT EXISTS {self._tbl(name)} "
+            "(k BLOB PRIMARY KEY, v BLOB NOT NULL) WITHOUT ROWID")
+
+    def list_trees(self):
+        rows = self._conn.execute(
+            "SELECT name FROM sqlite_master WHERE type='table' "
+            "AND name LIKE 'tree_%'").fetchall()
+        return [r[0][5:] for r in rows]
+
+    def get(self, tree, key):
+        row = self._conn.execute(
+            f"SELECT v FROM {self._tbl(tree)} WHERE k=?", (key,)).fetchone()
+        return row[0] if row else None
+
+    def put(self, tree, key, value):
+        self._conn.execute(
+            f"INSERT INTO {self._tbl(tree)}(k,v) VALUES(?,?) "
+            "ON CONFLICT(k) DO UPDATE SET v=excluded.v", (key, value))
+
+    def delete(self, tree, key):
+        self._conn.execute(f"DELETE FROM {self._tbl(tree)} WHERE k=?", (key,))
+
+    def clear(self, tree):
+        self._conn.execute(f"DELETE FROM {self._tbl(tree)}")
+
+    def length(self, tree):
+        return self._conn.execute(
+            f"SELECT COUNT(*) FROM {self._tbl(tree)}").fetchone()[0]
+
+    def range(self, tree, start, end, reverse):
+        q = f"SELECT k, v FROM {self._tbl(tree)}"
+        conds, params = [], []
+        if start is not None:
+            conds.append("k >= ?")
+            params.append(start)
+        if end is not None:
+            conds.append("k < ?")
+            params.append(end)
+        if conds:
+            q += " WHERE " + " AND ".join(conds)
+        q += " ORDER BY k" + (" DESC" if reverse else "")
+        return self._conn.execute(q, params).fetchall()
+
+    def begin(self):
+        self._depth += 1
+        if self._depth == 1:
+            self._conn.execute("BEGIN IMMEDIATE")
+
+    def commit(self):
+        self._depth -= 1
+        if self._depth == 0:
+            self._conn.execute("COMMIT")
+
+    def rollback(self):
+        self._depth -= 1
+        if self._depth == 0:
+            self._conn.execute("ROLLBACK")
+
+    def snapshot(self, to_dir):
+        os.makedirs(to_dir, exist_ok=True)
+        dest = os.path.join(to_dir, os.path.basename(self.path))
+        dst = sqlite3.connect(dest)
+        try:
+            self._conn.backup(dst)
+        finally:
+            dst.close()
+
+    def close(self):
+        self._conn.close()
+
+
+def open_db(path: str, engine: str = "sqlite", fsync: bool = False) -> Db:
+    """ref: src/db/open.rs:65-125."""
+    if engine == "sqlite":
+        return Db(SqliteEngine(os.path.join(path, "db.sqlite")
+                               if not path.endswith(".sqlite") else path,
+                               fsync=fsync))
+    if engine == "memory":
+        return Db(MemEngine())
+    raise ValueError(f"unknown db engine {engine!r} (sqlite|memory)")

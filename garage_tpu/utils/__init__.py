@@ -1,0 +1,1 @@
+"""Foundation utilities (ref: src/util)."""

@@ -1,0 +1,149 @@
+"""TOML configuration. Ref parity: src/util/config.rs:13-263.
+
+Field names mirror the reference's garage.toml so operators can port configs
+nearly verbatim; TPU-specific knobs live under [tpu].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tomllib
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class DataDir:
+    path: str
+    capacity: Optional[int] = None  # bytes; None => read-only dir
+    read_only: bool = False
+
+
+@dataclass
+class TpuConfig:
+    """TPU data-plane knobs (no reference analogue)."""
+
+    enable: bool = True
+    # batch of blocks shipped to the device in one encode/hash call
+    batch_blocks: int = 16
+    # platform override for tests ("cpu" forces the jnp fallback path)
+    platform: Optional[str] = None
+
+
+@dataclass
+class Config:
+    # ref: util/config.rs:13-258
+    metadata_dir: str = ""
+    data_dir: list[DataDir] = field(default_factory=list)
+    metadata_fsync: bool = False
+    data_fsync: bool = False
+    block_size: int = 1024 * 1024  # ref default 1 MiB (util/config.rs:269-271)
+    block_ram_buffer_max: int = 256 * 1024 * 1024
+    compression_level: Optional[int] = 1  # zstd level; None disables
+    replication_factor: int = 1
+    consistency_mode: str = "consistent"  # consistent|degraded|dangerous
+    # erasure coding mode (north star; not in reference): e.g. "4,2" => k=4,m=2
+    erasure_coding: Optional[str] = None
+
+    rpc_secret: Optional[str] = None
+    rpc_bind_addr: str = "127.0.0.1:3901"
+    rpc_public_addr: Optional[str] = None
+    bootstrap_peers: list[str] = field(default_factory=list)
+
+    db_engine: str = "sqlite"  # sqlite|memory (lmdb not in this image)
+
+    s3_api_bind_addr: Optional[str] = None
+    s3_region: str = "garage"
+    root_domain: str = ".s3.garage"
+    k2v_api_bind_addr: Optional[str] = None
+    admin_api_bind_addr: Optional[str] = None
+    admin_token: Optional[str] = None
+    metrics_token: Optional[str] = None
+    web_bind_addr: Optional[str] = None
+    web_root_domain: str = ".web.garage"
+
+    metadata_auto_snapshot_interval: Optional[float] = None  # seconds
+
+    tpu: TpuConfig = field(default_factory=TpuConfig)
+
+    @property
+    def data_dirs(self) -> list[DataDir]:
+        return self.data_dir
+
+    @property
+    def erasure_params(self) -> Optional[tuple[int, int]]:
+        if not self.erasure_coding:
+            return None
+        k, m = self.erasure_coding.split(",")
+        return int(k), int(m)
+
+
+def _parse_data_dir(v: Any) -> list[DataDir]:
+    # Accept a single path string or a list of {path, capacity, read_only}
+    # tables (multi-HDD mode, ref: util/config.rs DataDirEnum).
+    if isinstance(v, str):
+        return [DataDir(path=v)]
+    out = []
+    for d in v:
+        if isinstance(d, str):
+            out.append(DataDir(path=d))
+        else:
+            cap = d.get("capacity")
+            if isinstance(cap, str):
+                cap = parse_capacity(cap)
+            out.append(DataDir(path=d["path"], capacity=cap,
+                               read_only=bool(d.get("read_only", False))))
+    return out
+
+
+def parse_capacity(s: str) -> int:
+    """'1G', '100M', '2T' → bytes (decimal units like the reference)."""
+    s = s.strip()
+    units = {"k": 10**3, "m": 10**6, "g": 10**9, "t": 10**12}
+    if s and s[-1].lower() in units:
+        return int(float(s[:-1]) * units[s[-1].lower()])
+    return int(s)
+
+
+def read_config(path: str) -> Config:
+    """ref: util/config.rs:259 read_config. Env var GARAGE_RPC_SECRET etc.
+    override file values (subset of the reference's layered secrets)."""
+    with open(path, "rb") as f:
+        raw = tomllib.load(f)
+    return config_from_dict(raw)
+
+
+def config_from_dict(raw: dict) -> Config:
+    cfg = Config()
+    simple_fields = {f.name for f in dataclasses.fields(Config)} - {"data_dir", "tpu"}
+    for key, val in raw.items():
+        if key == "data_dir":
+            cfg.data_dir = _parse_data_dir(val)
+        elif key == "tpu" and isinstance(val, dict):
+            cfg.tpu = TpuConfig(**val)
+        elif key in ("s3_api", "k2v_api", "admin", "web"):
+            # nested sections like the reference layout
+            prefix = {"s3_api": "s3_", "k2v_api": "k2v_", "admin": "admin_", "web": "web_"}[key]
+            for k2, v2 in val.items():
+                attr = k2 if k2.startswith(prefix) else None
+                for cand in (k2, prefix + k2, {
+                    "api_bind_addr": prefix + "api_bind_addr",
+                }.get(k2, "")):
+                    if cand in simple_fields:
+                        attr = cand
+                        break
+                if attr:
+                    setattr(cfg, attr, v2)
+        elif key in simple_fields:
+            if key == "block_size" and isinstance(val, str):
+                val = parse_capacity(val)
+            setattr(cfg, key, val)
+        # unknown keys ignored (forward compat)
+    if os.environ.get("GARAGE_RPC_SECRET"):
+        cfg.rpc_secret = os.environ["GARAGE_RPC_SECRET"]
+    if os.environ.get("GARAGE_ADMIN_TOKEN"):
+        cfg.admin_token = os.environ["GARAGE_ADMIN_TOKEN"]
+    if not cfg.metadata_dir:
+        raise ValueError("metadata_dir is required")
+    return cfg

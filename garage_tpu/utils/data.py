@@ -1,0 +1,64 @@
+"""Core data types: 32-byte ids, hashes, hex codecs.
+
+Ref parity: src/util/data.rs:9-177 (FixedBytes32 = Uuid = Hash, sha256sum,
+blake2sum, fasthash, gen_uuid). Design difference: the block *content* hash in
+this framework is a parallel tree hash (ops/treehash.py) so it can run batched
+on TPU; blake2b-256 remains the metadata/item hash exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+# A FixedBytes32 is just `bytes` of length 32. We keep plain bytes (hashable,
+# comparable, serializable) rather than a wrapper class; helpers below enforce
+# the invariant where it matters.
+
+Hash = bytes  # 32 bytes
+Uuid = bytes  # 32 bytes
+
+ZERO_HASH: Hash = b"\x00" * 32
+
+
+def check32(b: bytes) -> bytes:
+    if len(b) != 32:
+        raise ValueError(f"expected 32 bytes, got {len(b)}")
+    return b
+
+
+def sha256sum(data: bytes) -> Hash:
+    """ref: src/util/data.rs:114-122"""
+    return hashlib.sha256(data).digest()
+
+
+def blake2sum(data: bytes) -> Hash:
+    """blake2b-256 — the metadata/item hash. ref: src/util/data.rs:124-132"""
+    return hashlib.blake2b(data, digest_size=32).digest()
+
+
+def fasthash(data: bytes) -> int:
+    """Fast non-cryptographic 64-bit hash (ref xxh3: src/util/data.rs:134-143).
+
+    xxhash is not available in this image; blake2b-8byte is the stand-in.
+    Used only for in-memory sharding decisions, never persisted.
+    """
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "big")
+
+
+def gen_uuid() -> Uuid:
+    """Random 32-byte uuid. ref: src/util/data.rs:145-150"""
+    return os.urandom(32)
+
+
+def hex_of(h: bytes) -> str:
+    return h.hex()
+
+
+def hash_of_hex(s: str) -> Hash:
+    return check32(bytes.fromhex(s))
+
+
+def debug_short(h: bytes) -> str:
+    """First 8 hex chars, for logs. ref: src/util/data.rs hexdump style."""
+    return h[:4].hex()

@@ -1,0 +1,51 @@
+"""Unified error types. Ref parity: src/util/error.rs."""
+
+from __future__ import annotations
+
+
+class GarageError(Exception):
+    """Base error."""
+
+
+class TimeoutError_(GarageError):
+    pass
+
+
+class QuorumError(GarageError):
+    """Could not reach quorum. ref: util/error.rs Error::Quorum(q, sets, ok, total, errs)."""
+
+    def __init__(self, quorum: int, sets: int | None, ok: int, total: int, errors: list):
+        self.quorum, self.sets, self.ok, self.total, self.errors = quorum, sets, ok, total, errors
+        where = f" in {sets} sets" if sets is not None else ""
+        super().__init__(
+            f"could not reach quorum {quorum}{where}: {ok}/{total} ok; "
+            f"errors: {[str(e) for e in errors[:4]]}"
+        )
+
+
+class CorruptData(GarageError):
+    def __init__(self, hash_: bytes):
+        self.hash = hash_
+        super().__init__(f"corrupt data for block {hash_.hex()[:16]}")
+
+
+class MissingBlock(GarageError):
+    def __init__(self, hash_: bytes):
+        self.hash = hash_
+        super().__init__(f"missing block {hash_.hex()[:16]}")
+
+
+class RpcError(GarageError):
+    """An error returned by a remote node."""
+
+
+class NoSuchBucket(GarageError):
+    pass
+
+
+class NoSuchKey(GarageError):
+    pass
+
+
+class BadRequest(GarageError):
+    pass

@@ -1,0 +1,14 @@
+"""Force JAX onto a virtual 8-device CPU mesh for all tests.
+
+Multi-chip hardware is not available in CI; sharding tests run against
+xla_force_host_platform_device_count=8. Must run before jax is imported.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

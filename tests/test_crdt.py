@@ -1,0 +1,67 @@
+"""CRDT property tests: merge must be commutative, associative, idempotent.
+
+Mirrors the reference's reliance on CRDT semantics (src/util/crdt/) and the
+survey's recommendation of property-based merge tests (SURVEY.md §5.2).
+"""
+
+import random
+
+from garage_tpu.utils.crdt import Bool, CrdtMap, Deletable, Lww, LwwMap
+
+
+def random_lww(rng):
+    return Lww(rng.randint(0, 5), rng.randint(0, 100))
+
+
+def random_lwwmap(rng):
+    m = LwwMap()
+    for _ in range(rng.randint(0, 6)):
+        k = rng.choice("abcd")
+        m = LwwMap({k: random_lww(rng)}).merge(m)
+    return m
+
+
+def random_crdtmap(rng):
+    m = CrdtMap()
+    for _ in range(rng.randint(0, 6)):
+        m = m.put(rng.choice("abcd"), random_lww(rng))
+    return m
+
+
+GENS = [random_lww, random_lwwmap, random_crdtmap,
+        lambda rng: Bool(rng.random() < 0.5),
+        lambda rng: Deletable(None if rng.random() < 0.3 else random_lww(rng))]
+
+
+def test_merge_laws():
+    rng = random.Random(1234)
+    for gen in GENS:
+        for _ in range(200):
+            a, b, c = gen(rng), gen(rng), gen(rng)
+            assert a.merge(b) == b.merge(a), f"commutativity: {gen.__name__}"
+            assert a.merge(b).merge(c) == a.merge(b.merge(c)), "associativity"
+            assert a.merge(a) == a, "idempotence"
+
+
+def test_lww_update_monotonic():
+    a = Lww.new("x", ts=1000)
+    b = a.update("y")
+    assert b.ts > a.ts
+    assert a.merge(b).value == "y"
+    assert b.merge(a).value == "y"
+
+
+def test_lwwmap_insert_wins():
+    m = LwwMap().insert("k", 1)
+    m2 = m.insert("k", 2)
+    assert m.merge(m2).get("k") == 2
+    assert m2.merge(m).get("k") == 2
+
+
+def test_bool_true_wins():
+    assert Bool(False).merge(Bool(True)).value is True
+
+
+def test_deletable_delete_wins():
+    d = Deletable.present(Lww(1, "v")).merge(Deletable.deleted())
+    assert d.is_deleted

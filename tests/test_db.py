@@ -1,0 +1,115 @@
+"""KV engine tests — the same suite over sqlite and memory engines,
+mirroring src/db/test.rs:3-150."""
+
+import pytest
+
+from garage_tpu.db import TxAbort, open_db
+
+
+@pytest.fixture(params=["sqlite", "memory"])
+def db(request, tmp_path):
+    d = open_db(str(tmp_path / "meta"), engine=request.param)
+    yield d
+    d.close()
+
+
+def test_basic_ops(db):
+    t = db.open_tree("test")
+    assert t.get(b"k") is None
+    assert t.insert(b"k", b"v1") is None
+    assert t.get(b"k") == b"v1"
+    assert t.insert(b"k", b"v2") == b"v1"
+    assert len(t) == 1
+    assert t.remove(b"k") == b"v2"
+    assert t.get(b"k") is None
+    assert len(t) == 0
+
+
+def test_ordering_and_range(db):
+    t = db.open_tree("rng")
+    for k in [b"b", b"a", b"d", b"c"]:
+        t.insert(k, k.upper())
+    assert [k for k, _ in t.iter()] == [b"a", b"b", b"c", b"d"]
+    assert [k for k, _ in t.iter(start=b"b", end=b"d")] == [b"b", b"c"]
+    assert [k for k, _ in t.iter(reverse=True)] == [b"d", b"c", b"b", b"a"]
+    assert t.first() == (b"a", b"A")
+    assert t.get_gt(b"b") == (b"c", b"C")
+    assert t.get_gt(b"d") is None
+
+
+def test_transaction_commit_and_abort(db):
+    t1 = db.open_tree("t1")
+    t2 = db.open_tree("t2")
+
+    def body(tx):
+        tx.insert(t1, b"x", b"1")
+        tx.insert(t2, b"y", b"2")
+        return "ok"
+
+    assert db.transaction(body) == "ok"
+    assert t1.get(b"x") == b"1"
+    assert t2.get(b"y") == b"2"
+
+    def aborting(tx):
+        tx.insert(t1, b"x", b"999")
+        tx.remove(t2, b"y")
+        raise TxAbort("rolled back")
+
+    with pytest.raises(TxAbort):
+        db.transaction(aborting)
+    assert t1.get(b"x") == b"1"
+    assert t2.get(b"y") == b"2"
+
+
+def test_tx_sees_own_writes(db):
+    t = db.open_tree("own")
+
+    def body(tx):
+        tx.insert(t, b"a", b"1")
+        assert tx.get(t, b"a") == b"1"
+        tx.remove(t, b"a")
+        assert tx.get(t, b"a") is None
+        tx.insert(t, b"a", b"2")
+        return tx.get(t, b"a")
+
+    assert db.transaction(body) == b"2"
+    assert t.get(b"a") == b"2"
+
+
+def test_on_commit_hooks(db):
+    t = db.open_tree("hooks")
+    fired = []
+
+    def body(tx):
+        tx.insert(t, b"k", b"v")
+        tx.on_commit(lambda: fired.append(1))
+
+    db.transaction(body)
+    assert fired == [1]
+
+    def aborting(tx):
+        tx.on_commit(lambda: fired.append(2))
+        raise TxAbort()
+
+    with pytest.raises(TxAbort):
+        db.transaction(aborting)
+    assert fired == [1]
+
+
+def test_clear_and_list_trees(db):
+    t = db.open_tree("clearme")
+    t.insert(b"a", b"1")
+    t.clear()
+    assert len(t) == 0
+    assert "clearme" in db.list_trees()
+
+
+def test_sqlite_snapshot(tmp_path):
+    d = open_db(str(tmp_path / "meta"), engine="sqlite")
+    t = d.open_tree("snap")
+    t.insert(b"k", b"v")
+    d.snapshot(str(tmp_path / "snapdir"))
+    d.close()
+    d2 = open_db(str(tmp_path / "snapdir" / "db.sqlite"), engine="sqlite")
+    assert d2.open_tree("snap").get(b"k") == b"v"
+    d2.close()

@@ -1,0 +1,138 @@
+"""Tests for data types, config, persister, tranquilizer, background runner."""
+
+import asyncio
+
+import pytest
+
+from garage_tpu.utils import background, config, data, migrate
+from garage_tpu.utils.persister import Persister, PersisterShared
+
+
+def test_hashes():
+    assert len(data.sha256sum(b"hello")) == 32
+    assert len(data.blake2sum(b"hello")) == 32
+    assert data.blake2sum(b"a") != data.blake2sum(b"b")
+    assert isinstance(data.fasthash(b"x"), int)
+    u = data.gen_uuid()
+    assert len(u) == 32
+    assert data.hash_of_hex(data.hex_of(u)) == u
+
+
+def test_config_parse(tmp_path):
+    p = tmp_path / "garage.toml"
+    p.write_text("""
+metadata_dir = "/tmp/meta"
+data_dir = "/tmp/data"
+replication_factor = 3
+block_size = "1M"
+db_engine = "sqlite"
+rpc_bind_addr = "127.0.0.1:3901"
+bootstrap_peers = ["127.0.0.1:3902"]
+
+[s3_api]
+api_bind_addr = "127.0.0.1:3900"
+s3_region = "garage"
+
+[tpu]
+batch_blocks = 8
+""")
+    cfg = config.read_config(str(p))
+    assert cfg.metadata_dir == "/tmp/meta"
+    assert cfg.data_dir[0].path == "/tmp/data"
+    assert cfg.replication_factor == 3
+    assert cfg.block_size == 10**6
+    assert cfg.s3_api_bind_addr == "127.0.0.1:3900"
+    assert cfg.bootstrap_peers == ["127.0.0.1:3902"]
+    assert cfg.tpu.batch_blocks == 8
+    assert cfg.erasure_params is None
+
+
+def test_config_multi_hdd_and_erasure(tmp_path):
+    p = tmp_path / "g.toml"
+    p.write_text("""
+metadata_dir = "/tmp/meta"
+erasure_coding = "4,2"
+data_dir = [
+  { path = "/mnt/hdd1", capacity = "1T" },
+  { path = "/mnt/hdd2", capacity = "500G", read_only = false },
+]
+""")
+    cfg = config.read_config(str(p))
+    assert cfg.erasure_params == (4, 2)
+    assert cfg.data_dir[0].capacity == 10**12
+    assert cfg.data_dir[1].capacity == 5 * 10**11
+
+
+class PVal(migrate.Migratable):
+    VERSION_MARKER = b"GTpv1"
+
+    def __init__(self, n):
+        self.n = n
+
+    def pack(self):
+        return self.n
+
+    @classmethod
+    def unpack(cls, raw):
+        return cls(raw)
+
+
+def test_persister(tmp_path):
+    p = Persister(str(tmp_path), "val", PVal)
+    assert p.load() is None
+    p.save(PVal(42))
+    assert p.load().n == 42
+    # PersisterShared: persists default, then updates
+    ps = PersisterShared(str(tmp_path), "shared", PVal, PVal(1))
+    assert ps.get().n == 1
+    ps.update(lambda v: PVal(v.n + 1))
+    ps2 = PersisterShared(str(tmp_path), "shared", PVal, PVal(99))
+    assert ps2.get().n == 2  # loaded, not default
+
+
+def test_background_runner_lifecycle():
+    async def main():
+        runner = background.BackgroundRunner()
+        done = []
+
+        class W(background.Worker):
+            name = "test-worker"
+
+            def __init__(self):
+                self.steps = 0
+
+            async def work(self):
+                self.steps += 1
+                done.append(self.steps)
+                if self.steps >= 3:
+                    return background.WState.DONE
+                return background.WState.BUSY
+
+        runner.spawn_worker(W())
+        await asyncio.sleep(0.1)
+        infos = runner.worker_info()
+        assert len(infos) == 1
+        await runner.shutdown()
+        assert done == [1, 2, 3]
+
+    asyncio.run(main())
+
+
+def test_background_worker_error_backoff():
+    async def main():
+        runner = background.BackgroundRunner()
+
+        class Bad(background.Worker):
+            name = "bad"
+
+            async def work(self):
+                raise RuntimeError("boom")
+
+        runner.spawn_worker(Bad())
+        await asyncio.sleep(0.15)
+        info = list(runner.worker_info().values())[0]
+        assert info.errors >= 1
+        assert "boom" in info.last_error
+        await runner.shutdown()
+
+    asyncio.run(main())
