@@ -1,0 +1,18 @@
+"""ops — the TPU data plane.
+
+This package is the reason this framework exists: the reference (Garage,
+Rust) does all block math — content hashing (src/util/data.rs:124), zstd
+compression (src/block/block.rs:85), and has NO erasure coding at all — on
+CPU, one block at a time. Here the block data path is batched math on TPU:
+
+  gf256.py    GF(2^8) arithmetic + the GF(2) bit-matrix formulation that
+              turns erasure coding into int8 matmuls on the MXU
+  rs.py       Cauchy-Reed-Solomon (k, m) codec: encode / decode / repair,
+              batched over stripes (the `erasure(k,m)` replication mode
+              the north star adds next to the reference's replicate-N,
+              plugged in at src/rpc/replication_mode.rs:8)
+  treehash.py BLAKE3 tree hashing in JAX: 1 MiB block = 1024 chunks
+              compressed in parallel on the VPU (replaces the reference's
+              sequential blake2 block hash, src/block/manager.rs:554)
+  pallas/     hand-tiled Pallas TPU kernels for the ops above
+"""

@@ -1,0 +1,202 @@
+"""GF(2^8) arithmetic and the MXU-friendly GF(2) bit-matrix formulation.
+
+The field is GF(2^8) with the primitive polynomial 0x11D
+(x^8 + x^4 + x^3 + x^2 + 1) — the polynomial used by most storage
+erasure-coding libraries. alpha = 2 is a primitive element.
+
+Two layers:
+
+1. Host-side (numpy): exp/log tables, vectorized mul/div, Gauss-Jordan
+   matrix inversion. Used to build/invert generator matrices — tiny
+   (k+m <= 256 square), so this never needs the TPU.
+
+2. Device-side: the *bit-matrix trick*. Multiplication by a constant c in
+   GF(2^8) is linear over GF(2): writing a byte as a bit-vector
+   b = (b0..b7), c*b = M_c @ b  (mod 2) where M_c is an 8x8 0/1 matrix
+   whose column j holds the bits of c * x^j. A whole GF(2^8) matrix
+   A (r x s) therefore expands to a GF(2) matrix bits(A) (8r x 8s), and
+
+       A @ X  over GF(2^8)  ==  pack( bits(A) @ unpack(X)  mod 2 )
+
+   which is an ordinary int8 matmul + parity — exactly what the TPU MXU
+   eats. No per-byte table lookups (gathers are slow on TPU), no custom
+   field ops: encode/decode of arbitrarily wide stripes becomes one
+   (N, 8s) @ (8s, 8r) matmul with int32 accumulation and an AND 1.
+
+No reference analogue: Garage has no erasure coding (SURVEY.md §2.11
+item 8); this implements the north star's new math from scratch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+GF_POLY = 0x11D  # x^8 + x^4 + x^3 + x^2 + 1, primitive
+GF_ORDER = 255  # multiplicative group order
+
+
+def _build_tables() -> tuple[np.ndarray, np.ndarray]:
+    """exp/log tables for alpha=2. exp is doubled to 510 entries so
+    exp[log[a] + log[b]] needs no modular reduction."""
+    exp = np.zeros(512, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(GF_ORDER):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= GF_POLY
+    for i in range(GF_ORDER, 512):
+        exp[i] = exp[i - GF_ORDER]
+    log[0] = -1  # sentinel; callers must special-case zero
+    return exp, log
+
+
+GF_EXP, GF_LOG = _build_tables()
+
+
+def gf_mul(a, b):
+    """Element-wise GF(2^8) multiply; numpy arrays or scalars (uint8)."""
+    a = np.asarray(a, dtype=np.uint8)
+    b = np.asarray(b, dtype=np.uint8)
+    out = GF_EXP[GF_LOG[a] + GF_LOG[b]]
+    return np.where((a == 0) | (b == 0), np.uint8(0), out)
+
+
+def gf_inv(a):
+    a = np.asarray(a, dtype=np.uint8)
+    if np.any(a == 0):
+        raise ZeroDivisionError("gf_inv(0)")
+    return GF_EXP[GF_ORDER - GF_LOG[a]]
+
+
+def gf_div(a, b):
+    return gf_mul(a, gf_inv(b))
+
+
+def gf_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Dense GF(2^8) matrix product (host-side, small matrices only)."""
+    a = np.asarray(a, dtype=np.uint8)
+    b = np.asarray(b, dtype=np.uint8)
+    # (r, s, 1) x (1, s, c) -> sum over s with XOR reduction
+    prod = gf_mul(a[:, :, None], b[None, :, :])
+    return np.bitwise_xor.reduce(prod, axis=1)
+
+
+def gf_inv_matrix(a: np.ndarray) -> np.ndarray:
+    """Gauss-Jordan inversion over GF(2^8). Raises if singular."""
+    a = np.asarray(a, dtype=np.uint8)
+    n = a.shape[0]
+    if a.shape != (n, n):
+        raise ValueError(f"square matrix required, got {a.shape}")
+    aug = np.concatenate([a.copy(), np.eye(n, dtype=np.uint8)], axis=1)
+    for col in range(n):
+        piv_rows = np.nonzero(aug[col:, col])[0]
+        if piv_rows.size == 0:
+            raise np.linalg.LinAlgError("singular matrix over GF(2^8)")
+        piv = col + int(piv_rows[0])
+        if piv != col:
+            aug[[col, piv]] = aug[[piv, col]]
+        aug[col] = gf_mul(aug[col], gf_inv(aug[col, col]))
+        for row in range(n):
+            if row != col and aug[row, col] != 0:
+                aug[row] = aug[row] ^ gf_mul(aug[row, col], aug[col])
+    return aug[:, n:]
+
+
+# ---------------------------------------------------------------------------
+# GF(2) bit-matrix expansion
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _mul_bitmatrix(c: int) -> np.ndarray:
+    """8x8 GF(2) matrix M_c with (M_c @ bits(b)) % 2 == bits(c*b).
+
+    Column j = bits of c * x^j (LSB-first bit order).
+    """
+    cols = []
+    for j in range(8):
+        p = int(gf_mul(c, 1 << j))
+        cols.append([(p >> i) & 1 for i in range(8)])
+    return np.array(cols, dtype=np.uint8).T  # columns stacked
+
+
+def expand_bitmatrix(a: np.ndarray) -> np.ndarray:
+    """Expand a GF(2^8) matrix (r, s) to its GF(2) form (8r, 8s) uint8."""
+    a = np.asarray(a, dtype=np.uint8)
+    r, s = a.shape
+    out = np.zeros((8 * r, 8 * s), dtype=np.uint8)
+    for i in range(r):
+        for j in range(s):
+            out[8 * i : 8 * i + 8, 8 * j : 8 * j + 8] = _mul_bitmatrix(int(a[i, j]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Device-side bit matmul (JAX)
+# ---------------------------------------------------------------------------
+
+# jax imported lazily so host-only users (layout math, tests of table code)
+# never pay for it.
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def unpack_bits(x):
+    """(..., s, n) uint8 bytes -> (..., n, 8s) int8 bits (LSB-first).
+
+    Axis order: for byte-position p, the bit vector is the concatenation
+    over the s symbols of their 8 bits — matching expand_bitmatrix.
+    """
+    jnp = _jnp()
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (x[..., None] >> shifts) & 1  # (..., s, n, 8)
+    bits = jnp.moveaxis(bits, -3, -2)  # (..., n, s, 8)
+    return bits.reshape(*bits.shape[:-2], -1).astype(jnp.int8)  # (..., n, 8s)
+
+
+def pack_bits(bits, r: int):
+    """(..., n, 8r) int -> (..., r, n) uint8 bytes (LSB-first)."""
+    jnp = _jnp()
+    bits = bits.reshape(*bits.shape[:-1], r, 8).astype(jnp.uint8)
+    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))
+    out = (bits * weights).sum(axis=-1, dtype=jnp.uint32).astype(jnp.uint8)
+    return jnp.moveaxis(out, -1, -2)  # (..., r, n)
+
+
+def bit_matmul_apply(bitmat_t, x):
+    """Apply a GF(2^8) linear map to byte columns via one int8 MXU matmul.
+
+    bitmat_t: (8s, 8r) int8 — expand_bitmatrix(A).T, A being (r, s).
+    x:        (..., s, n) uint8 — s input symbols per byte-position.
+    returns   (..., r, n) uint8 == A @ x over GF(2^8), per byte-position.
+
+    The contraction (n, 8s) @ (8s, 8r) accumulates in int32 on the MXU;
+    parity (& 1) recovers the GF(2) sum. For RS(10,4): 8s=80, 8r=32.
+    """
+    import jax
+
+    jnp = _jnp()
+    r8 = bitmat_t.shape[1]
+    bits = unpack_bits(x)  # (..., n, 8s)
+    acc = jax.lax.dot_general(
+        bits,
+        bitmat_t,
+        dimension_numbers=(((bits.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return pack_bits(acc & 1, r8 // 8)
+
+
+def bitmat_t_for(a: np.ndarray):
+    """Device constant for bit_matmul_apply: expand_bitmatrix(a).T as int8."""
+    jnp = _jnp()
+    return jnp.asarray(expand_bitmatrix(a).T.astype(np.int8))

@@ -1,0 +1,142 @@
+"""Cauchy-Reed-Solomon (k, m) erasure codec, batched on TPU.
+
+Construction: systematic generator G (n x k, n = k + m) = [I_k ; C] with
+C the m x k Cauchy matrix C[i, j] = 1 / (x_i + y_j), x_i = i,
+y_j = m + j over GF(2^8). Every square submatrix of a Cauchy matrix is
+nonsingular, so any k of the n shards reconstruct the stripe (MDS).
+
+Shapes: a *stripe* is (k, shard_len) bytes of data producing (m,
+shard_len) parity; all ops take arbitrary leading batch dims so a whole
+batch of 1-16 MiB blocks is one MXU matmul (see gf256.bit_matmul_apply).
+Decode/repair matrices depend on *which* shards survive; they are built
+host-side per erasure pattern (k x k inversion, microseconds) and cached,
+so each pattern compiles exactly one XLA program.
+
+This is the math behind the `erasure(k, m)` replication mode — the north
+star's addition at the reference's plugin boundary
+(src/rpc/replication_mode.rs:8-20, which only offers replicate-N).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from . import gf256
+
+
+@functools.lru_cache(maxsize=None)
+def generator_matrix(k: int, m: int) -> np.ndarray:
+    """(k+m, k) systematic generator over GF(2^8): identity over Cauchy."""
+    if k < 1 or m < 0 or k + m > 256:
+        raise ValueError(f"need 1 <= k, 0 <= m, k+m <= 256; got k={k} m={m}")
+    x = np.arange(m, dtype=np.uint8)[:, None]  # parity row ids
+    y = np.arange(m, m + k, dtype=np.uint8)[None, :]  # data col ids
+    cauchy = gf256.gf_inv(x ^ y)
+    return np.concatenate([np.eye(k, dtype=np.uint8), cauchy], axis=0)
+
+
+@functools.lru_cache(maxsize=None)
+def parity_matrix(k: int, m: int) -> np.ndarray:
+    """(m, k) Cauchy part of the generator."""
+    return np.ascontiguousarray(generator_matrix(k, m)[k:])
+
+
+@functools.lru_cache(maxsize=None)
+def decode_matrix(k: int, m: int, present: tuple[int, ...]) -> np.ndarray:
+    """(k, k) matrix mapping k surviving shards (rows `present` of G,
+    ascending) back to the k data shards."""
+    if len(present) != k:
+        raise ValueError(f"need exactly k={k} shard indices, got {len(present)}")
+    sub = generator_matrix(k, m)[list(present)]
+    return gf256.gf_inv_matrix(sub)
+
+
+@functools.lru_cache(maxsize=None)
+def repair_matrix(
+    k: int, m: int, present: tuple[int, ...], missing: tuple[int, ...]
+) -> np.ndarray:
+    """(len(missing), k) matrix rebuilding the `missing` shards directly
+    from the k `present` ones (data and parity alike)."""
+    g = generator_matrix(k, m)
+    return gf256.gf_matmul(g[list(missing)], decode_matrix(k, m, present))
+
+
+# ---------------------------------------------------------------------------
+# Device (JAX) paths — jitted per (k, m[, pattern]); batched over stripes
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_apply(key, matrix_bytes, rows: int, cols: int):
+    """One jitted bit-matmul per distinct GF matrix. `key` keeps cache
+    entries readable; the matrix travels as bytes to stay hashable."""
+    import jax
+
+    mat = np.frombuffer(matrix_bytes, dtype=np.uint8).reshape(rows, cols)
+    bitmat_t = gf256.bitmat_t_for(mat)
+
+    @jax.jit
+    def apply(x):
+        return gf256.bit_matmul_apply(bitmat_t, x)
+
+    return apply
+
+
+def _apply(tag: str, mat: np.ndarray, x):
+    fn = _jit_apply((tag, mat.shape), mat.tobytes(), *mat.shape)
+    return fn(x)
+
+
+def encode(k: int, m: int, data):
+    """data (..., k, n) uint8 -> parity (..., m, n) uint8 on device."""
+    return _apply(f"enc{k},{m}", parity_matrix(k, m), data)
+
+
+def decode(k: int, m: int, present: tuple[int, ...], shards):
+    """shards (..., k, n) = surviving shard rows in ascending-index order
+    -> data (..., k, n)."""
+    return _apply(f"dec{k},{m},{present}", decode_matrix(k, m, present), shards)
+
+
+def repair(k: int, m: int, present: tuple[int, ...], missing: tuple[int, ...], shards):
+    """shards (..., k, n) -> rebuilt missing shards (..., len(missing), n)."""
+    mat = repair_matrix(k, m, present, missing)
+    return _apply(f"rep{k},{m},{present},{missing}", mat, shards)
+
+
+# ---------------------------------------------------------------------------
+# Host (numpy) reference + small-input fallback
+# ---------------------------------------------------------------------------
+
+
+def encode_np(k: int, m: int, data: np.ndarray) -> np.ndarray:
+    """Table-lookup reference: data (k, n) -> parity (m, n)."""
+    return gf256.gf_matmul(parity_matrix(k, m), np.asarray(data, dtype=np.uint8))
+
+
+def decode_np(k: int, m: int, present: tuple[int, ...], shards: np.ndarray) -> np.ndarray:
+    return gf256.gf_matmul(decode_matrix(k, m, present), np.asarray(shards, dtype=np.uint8))
+
+
+# ---------------------------------------------------------------------------
+# Stripe layout helpers (byte-level, host)
+# ---------------------------------------------------------------------------
+
+
+def shard_len(block_len: int, k: int) -> int:
+    return (block_len + k - 1) // k
+
+
+def split_stripe(data: bytes, k: int) -> np.ndarray:
+    """bytes -> (k, shard_len) uint8, zero-padded. Original length is
+    metadata the block layer stores alongside (block/codec.py)."""
+    n = shard_len(len(data), k)
+    buf = np.zeros(k * n, dtype=np.uint8)
+    buf[: len(data)] = np.frombuffer(data, dtype=np.uint8)
+    return buf.reshape(k, n)
+
+
+def join_stripe(shards: np.ndarray, block_len: int) -> bytes:
+    return np.asarray(shards, dtype=np.uint8).reshape(-1)[:block_len].tobytes()

@@ -1,0 +1,298 @@
+"""BLAKE3 tree hashing: pure-Python reference + batched JAX implementation.
+
+The reference hashes every block with sequential blake2
+(src/util/data.rs:124-132, verified on every read at
+src/block/manager.rs:554-609) — one core, one block at a time. BLAKE3's
+chunk tree is the TPU-native choice: a 1 MiB block is 1024 independent
+1 KiB chunks (VPU-parallel), merged by a 10-level binary parent tree.
+Scrub/verify of a whole batch of blocks becomes one jitted program.
+
+Layout of the JAX path: messages are padded to a static chunk count C;
+byte *lengths* stay traced, so one compiled program serves every block
+whose size lands in the same chunk count (tail blocks don't recompile).
+Within a chunk the 16 blake3 blocks chain sequentially (lax.scan); across
+chunks and across the batch everything is vmapped.
+
+The pure-Python implementation is the test oracle (checked against the
+published empty-input vector) and the host fallback for small inputs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+IV = (
+    0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+    0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,
+)
+
+MSG_PERMUTATION = (2, 6, 3, 10, 7, 0, 4, 13, 1, 11, 12, 5, 9, 14, 15, 8)
+
+CHUNK_START = 1 << 0
+CHUNK_END = 1 << 1
+PARENT = 1 << 2
+ROOT = 1 << 3
+
+CHUNK_LEN = 1024
+BLOCK_LEN = 64
+BLOCKS_PER_CHUNK = CHUNK_LEN // BLOCK_LEN  # 16
+
+
+@functools.lru_cache(maxsize=None)
+def _schedules() -> tuple[tuple[int, ...], ...]:
+    """Message-word index schedule per round (permutation pre-applied)."""
+    idx = list(range(16))
+    out = [tuple(idx)]
+    for _ in range(6):
+        idx = [idx[p] for p in MSG_PERMUTATION]
+        out.append(tuple(idx))
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# Pure-Python reference
+# ---------------------------------------------------------------------------
+
+_M32 = 0xFFFFFFFF
+
+
+def _rotr(x: int, n: int) -> int:
+    return ((x >> n) | (x << (32 - n))) & _M32
+
+
+def _g(v, a, b, c, d, mx, my):
+    v[a] = (v[a] + v[b] + mx) & _M32
+    v[d] = _rotr(v[d] ^ v[a], 16)
+    v[c] = (v[c] + v[d]) & _M32
+    v[b] = _rotr(v[b] ^ v[c], 12)
+    v[a] = (v[a] + v[b] + my) & _M32
+    v[d] = _rotr(v[d] ^ v[a], 8)
+    v[c] = (v[c] + v[d]) & _M32
+    v[b] = _rotr(v[b] ^ v[c], 7)
+
+
+def compress_py(h, m, counter: int, block_len: int, flags: int) -> list[int]:
+    """One blake3 compression; returns the 8-word chaining value."""
+    v = list(h) + list(IV[:4]) + [
+        counter & _M32, (counter >> 32) & _M32, block_len, flags,
+    ]
+    for sched in _schedules():
+        _g(v, 0, 4, 8, 12, m[sched[0]], m[sched[1]])
+        _g(v, 1, 5, 9, 13, m[sched[2]], m[sched[3]])
+        _g(v, 2, 6, 10, 14, m[sched[4]], m[sched[5]])
+        _g(v, 3, 7, 11, 15, m[sched[6]], m[sched[7]])
+        _g(v, 0, 5, 10, 15, m[sched[8]], m[sched[9]])
+        _g(v, 1, 6, 11, 12, m[sched[10]], m[sched[11]])
+        _g(v, 2, 7, 8, 13, m[sched[12]], m[sched[13]])
+        _g(v, 3, 4, 9, 14, m[sched[14]], m[sched[15]])
+    return [v[i] ^ v[i + 8] for i in range(8)]
+
+
+def _words(block: bytes) -> list[int]:
+    block = block.ljust(BLOCK_LEN, b"\x00")
+    return [int.from_bytes(block[4 * i : 4 * i + 4], "little") for i in range(16)]
+
+
+def _chunk_cv_py(chunk: bytes, counter: int, root: bool) -> list[int]:
+    n_blocks = max(1, (len(chunk) + BLOCK_LEN - 1) // BLOCK_LEN)
+    cv = list(IV)
+    for b in range(n_blocks):
+        piece = chunk[b * BLOCK_LEN : (b + 1) * BLOCK_LEN]
+        flags = (CHUNK_START if b == 0 else 0) | (
+            (CHUNK_END | (ROOT if root else 0)) if b == n_blocks - 1 else 0
+        )
+        cv = compress_py(cv, _words(piece), counter, len(piece), flags)
+    return cv
+
+
+def _parent_cv_py(left, right, root: bool) -> list[int]:
+    m = list(left) + list(right)
+    return compress_py(list(IV), m, 0, BLOCK_LEN, PARENT | (ROOT if root else 0))
+
+
+def blake3_py(data: bytes) -> bytes:
+    """Reference blake3 (default 32-byte digest)."""
+    chunks = [data[i : i + CHUNK_LEN] for i in range(0, len(data), CHUNK_LEN)] or [b""]
+    if len(chunks) == 1:
+        cv = _chunk_cv_py(chunks[0], 0, root=True)
+        return b"".join(w.to_bytes(4, "little") for w in cv)
+    cvs = [_chunk_cv_py(c, i, root=False) for i, c in enumerate(chunks)]
+    # Pairwise merge with odd tail carried — reproduces the spec tree
+    # (left subtree = largest power of two < n) level by level.
+    while len(cvs) > 2:
+        nxt = [_parent_cv_py(cvs[i], cvs[i + 1], False) for i in range(0, len(cvs) - 1, 2)]
+        if len(cvs) % 2:
+            nxt.append(cvs[-1])
+        cvs = nxt
+    root = _parent_cv_py(cvs[0], cvs[1], root=True)
+    return b"".join(w.to_bytes(4, "little") for w in root)
+
+
+# ---------------------------------------------------------------------------
+# JAX batched implementation
+# ---------------------------------------------------------------------------
+
+
+def _compress_jax(h, m, counter, block_len, flags):
+    """h (8,) u32, m (16,) u32, scalars u32 -> (8,) u32. Fully unrolled."""
+    import jax.numpy as jnp
+
+    u32 = jnp.uint32
+    v = [h[i] for i in range(8)] + [
+        u32(IV[0]), u32(IV[1]), u32(IV[2]), u32(IV[3]),
+        counter.astype(u32), (counter >> 32).astype(u32) if counter.dtype.itemsize == 8 else u32(0),
+        block_len.astype(u32), flags.astype(u32),
+    ]
+
+    def rotr(x, n):
+        return (x >> u32(n)) | (x << u32(32 - n))
+
+    def g(a, b, c, d, mx, my):
+        v[a] = v[a] + v[b] + mx
+        v[d] = rotr(v[d] ^ v[a], 16)
+        v[c] = v[c] + v[d]
+        v[b] = rotr(v[b] ^ v[c], 12)
+        v[a] = v[a] + v[b] + my
+        v[d] = rotr(v[d] ^ v[a], 8)
+        v[c] = v[c] + v[d]
+        v[b] = rotr(v[b] ^ v[c], 7)
+
+    for sched in _schedules():
+        g(0, 4, 8, 12, m[sched[0]], m[sched[1]])
+        g(1, 5, 9, 13, m[sched[2]], m[sched[3]])
+        g(2, 6, 10, 14, m[sched[4]], m[sched[5]])
+        g(3, 7, 11, 15, m[sched[6]], m[sched[7]])
+        g(0, 5, 10, 15, m[sched[8]], m[sched[9]])
+        g(1, 6, 11, 12, m[sched[10]], m[sched[11]])
+        g(2, 7, 8, 13, m[sched[12]], m[sched[13]])
+        g(3, 4, 9, 14, m[sched[14]], m[sched[15]])
+    import jax.numpy as jnp2
+
+    return jnp2.stack([v[i] ^ v[i + 8] for i in range(8)])
+
+
+def _chunk_cv_jax(words, counter, chunk_len, is_root_chunk):
+    """One chunk: words (16, 16) u32 (block, word), chunk_len u32 traced.
+
+    lax.scan over the 16 block positions; positions past the chunk's last
+    block are masked out so traced lengths don't change the program.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    u32 = jnp.uint32
+    n_blocks = jnp.maximum(u32(1), (chunk_len + u32(BLOCK_LEN - 1)) // u32(BLOCK_LEN))
+    pos = jnp.arange(BLOCKS_PER_CHUNK, dtype=jnp.uint32)
+    block_lens = jnp.clip(
+        chunk_len.astype(jnp.int32) - (pos * BLOCK_LEN).astype(jnp.int32), 0, BLOCK_LEN
+    ).astype(u32)
+    is_end = pos == (n_blocks - 1)
+    flags = (
+        jnp.where(pos == 0, u32(CHUNK_START), u32(0))
+        | jnp.where(is_end, u32(CHUNK_END), u32(0))
+        | jnp.where(is_end & is_root_chunk, u32(ROOT), u32(0))
+    )
+    active = pos < n_blocks
+
+    def step(cv, xs):
+        m, blen, flg, act = xs
+        new_cv = _compress_jax(cv, m, counter, blen, flg)
+        return jnp.where(act, new_cv, cv), None
+
+    cv, _ = jax.lax.scan(step, jnp.array(IV, dtype=u32), (words, block_lens, flags, active))
+    return cv
+
+
+def _parent_cv_jax(left, right, flags_val):
+    import jax.numpy as jnp
+
+    m = jnp.concatenate([left, right])
+    z = jnp.uint32(0)
+    return _compress_jax(
+        jnp.array(IV, dtype=jnp.uint32), m, z, jnp.uint32(BLOCK_LEN), jnp.uint32(flags_val)
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _hash_fn(n_chunks: int):
+    """Jitted (B, n_chunks*1024) u8 + (B,) i32 lengths -> (B, 8) u32."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(msg_u8, length):
+        u32 = jnp.uint32
+        words = msg_u8.reshape(n_chunks, BLOCKS_PER_CHUNK, BLOCK_LEN // 4, 4)
+        words = (
+            words[..., 0].astype(u32)
+            | (words[..., 1].astype(u32) << 8)
+            | (words[..., 2].astype(u32) << 16)
+            | (words[..., 3].astype(u32) << 24)
+        )  # (C, 16, 16) little-endian words
+        counters = jnp.arange(n_chunks, dtype=u32)
+        chunk_lens = jnp.clip(length - counters.astype(jnp.int32) * CHUNK_LEN, 0, CHUNK_LEN).astype(u32)
+        single = n_chunks == 1
+        cvs = jax.vmap(_chunk_cv_jax, in_axes=(0, 0, 0, None))(
+            words, counters, chunk_lens, jnp.bool_(single)
+        )  # (C, 8)
+        if single:
+            return cvs[0]
+        # Pairwise merge, odd tail carried (static unroll, log2 levels).
+        level = [cvs[i] for i in range(n_chunks)]
+        while len(level) > 2:
+            nxt = [
+                _parent_cv_jax(level[i], level[i + 1], PARENT)
+                for i in range(0, len(level) - 1, 2)
+            ]
+            if len(level) % 2:
+                nxt.append(level[-1])
+            level = nxt
+        return _parent_cv_jax(level[0], level[1], PARENT | ROOT)
+
+    return jax.jit(jax.vmap(one))
+
+
+def n_chunks_for(length: int) -> int:
+    return max(1, (length + CHUNK_LEN - 1) // CHUNK_LEN)
+
+
+def hash_batch_jax(msgs: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """msgs (B, C*1024) uint8 zero-padded, lengths (B,) -> (B, 32) uint8.
+
+    All messages must share the chunk count C = msgs.shape[1] // 1024.
+    """
+    b, padded = msgs.shape
+    if padded % CHUNK_LEN:
+        raise ValueError(f"padded length {padded} not a chunk multiple")
+    lengths = np.asarray(lengths, dtype=np.int32)
+    c = padded // CHUNK_LEN
+    if any(n_chunks_for(int(n)) != c for n in lengths):
+        raise ValueError(f"all lengths must span exactly {c} chunks")
+    cvs = _hash_fn(c)(msgs, lengths)
+    return np.asarray(cvs).astype("<u4").view(np.uint8).reshape(b, 32)
+
+
+def blake3_many(blobs: list[bytes]) -> list[bytes]:
+    """Hash many byte strings, batching same-chunk-count groups on device."""
+    out: list[bytes | None] = [None] * len(blobs)
+    groups: dict[int, list[int]] = {}
+    for i, blob in enumerate(blobs):
+        groups.setdefault(n_chunks_for(len(blob)), []).append(i)
+    for n_chunks, idxs in groups.items():
+        padded = n_chunks * CHUNK_LEN
+        buf = np.zeros((len(idxs), padded), dtype=np.uint8)
+        lengths = np.empty(len(idxs), dtype=np.int32)
+        for row, i in enumerate(idxs):
+            arr = np.frombuffer(blobs[i], dtype=np.uint8)
+            buf[row, : arr.size] = arr
+            lengths[row] = arr.size
+        digests = hash_batch_jax(buf, lengths)
+        for row, i in enumerate(idxs):
+            out[i] = digests[row].tobytes()
+    return out  # type: ignore[return-value]
+
+
+def blake3(data: bytes) -> bytes:
+    """Single-input convenience (host reference path)."""
+    return blake3_py(data)

@@ -1,0 +1,137 @@
+"""GF(2^8) + Reed-Solomon codec tests (ops/gf256.py, ops/rs.py)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from garage_tpu.ops import gf256, rs
+
+
+class TestGF256:
+    def test_tables_consistent(self):
+        # exp/log are inverse bijections on the nonzero elements
+        for a in range(1, 256):
+            assert gf256.GF_EXP[gf256.GF_LOG[a]] == a
+
+    def test_mul_against_schoolbook(self):
+        def slow_mul(a, b):
+            p = 0
+            for _ in range(8):
+                if b & 1:
+                    p ^= a
+                b >>= 1
+                a <<= 1
+                if a & 0x100:
+                    a ^= gf256.GF_POLY
+            return p
+
+        rng = np.random.default_rng(0)
+        for a, b in rng.integers(0, 256, size=(200, 2)):
+            assert int(gf256.gf_mul(a, b)) == slow_mul(int(a), int(b))
+
+    def test_field_axioms_sampled(self):
+        rng = np.random.default_rng(1)
+        a, b, c = rng.integers(0, 256, size=(3, 64), dtype=np.uint8)
+        assert np.array_equal(gf256.gf_mul(a, b), gf256.gf_mul(b, a))
+        assert np.array_equal(
+            gf256.gf_mul(a, gf256.gf_mul(b, c)), gf256.gf_mul(gf256.gf_mul(a, b), c)
+        )
+        # distributivity over XOR (field addition)
+        assert np.array_equal(
+            gf256.gf_mul(a, b ^ c), gf256.gf_mul(a, b) ^ gf256.gf_mul(a, c)
+        )
+
+    def test_inverse(self):
+        a = np.arange(1, 256, dtype=np.uint8)
+        assert np.all(gf256.gf_mul(a, gf256.gf_inv(a)) == 1)
+
+    def test_matrix_inverse(self):
+        rng = np.random.default_rng(2)
+        for n in (1, 3, 8):
+            while True:
+                a = rng.integers(0, 256, size=(n, n), dtype=np.uint8)
+                try:
+                    ainv = gf256.gf_inv_matrix(a)
+                    break
+                except np.linalg.LinAlgError:
+                    continue
+            assert np.array_equal(gf256.gf_matmul(a, ainv), np.eye(n, dtype=np.uint8))
+
+    def test_singular_raises(self):
+        a = np.array([[1, 2], [1, 2]], dtype=np.uint8)
+        with pytest.raises(np.linalg.LinAlgError):
+            gf256.gf_inv_matrix(a)
+
+    def test_bitmatrix_matches_field_mul(self):
+        rng = np.random.default_rng(3)
+        a = rng.integers(0, 256, size=(3, 5), dtype=np.uint8)
+        x = rng.integers(0, 256, size=(5, 17), dtype=np.uint8)
+        want = gf256.gf_matmul(a, x)
+        got = np.asarray(gf256.bit_matmul_apply(gf256.bitmat_t_for(a), x))
+        assert np.array_equal(got, want)
+
+    def test_bitmatrix_batched(self):
+        rng = np.random.default_rng(4)
+        a = rng.integers(0, 256, size=(4, 10), dtype=np.uint8)
+        x = rng.integers(0, 256, size=(2, 3, 10, 33), dtype=np.uint8)
+        got = np.asarray(gf256.bit_matmul_apply(gf256.bitmat_t_for(a), x))
+        assert got.shape == (2, 3, 4, 33)
+        for i in range(2):
+            for j in range(3):
+                assert np.array_equal(got[i, j], gf256.gf_matmul(a, x[i, j]))
+
+
+class TestRS:
+    def test_generator_systematic_and_mds(self):
+        k, m = 4, 3
+        g = rs.generator_matrix(k, m)
+        assert np.array_equal(g[:k], np.eye(k, dtype=np.uint8))
+        # MDS: every k-subset of rows is invertible
+        for rows in itertools.combinations(range(k + m), k):
+            gf256.gf_inv_matrix(g[list(rows)])  # raises if singular
+
+    @pytest.mark.parametrize("k,m", [(2, 1), (4, 2), (10, 4)])
+    def test_encode_device_matches_numpy(self, k, m):
+        rng = np.random.default_rng(5)
+        data = rng.integers(0, 256, size=(k, 101), dtype=np.uint8)
+        assert np.array_equal(np.asarray(rs.encode(k, m, data)), rs.encode_np(k, m, data))
+
+    def test_roundtrip_all_erasure_patterns(self):
+        k, m = 4, 2
+        rng = np.random.default_rng(6)
+        data = rng.integers(0, 256, size=(k, 64), dtype=np.uint8)
+        parity = np.asarray(rs.encode(k, m, data))
+        stripe = np.concatenate([data, parity], axis=0)
+        for present in itertools.combinations(range(k + m), k):
+            got = np.asarray(rs.decode(k, m, present, stripe[list(present)]))
+            assert np.array_equal(got, data), f"pattern {present}"
+
+    def test_repair_rebuilds_missing_shards(self):
+        k, m = 10, 4
+        rng = np.random.default_rng(7)
+        data = rng.integers(0, 256, size=(k, 128), dtype=np.uint8)
+        stripe = np.concatenate([data, np.asarray(rs.encode(k, m, data))], axis=0)
+        missing = (1, 7, 11, 13)
+        present = tuple(i for i in range(k + m) if i not in missing)[:k]
+        got = np.asarray(rs.repair(k, m, present, missing, stripe[list(present)]))
+        assert np.array_equal(got, stripe[list(missing)])
+
+    def test_batched_stripes(self):
+        k, m = 4, 2
+        rng = np.random.default_rng(8)
+        data = rng.integers(0, 256, size=(5, k, 32), dtype=np.uint8)
+        parity = np.asarray(rs.encode(k, m, data))
+        assert parity.shape == (5, m, 32)
+        for b in range(5):
+            assert np.array_equal(parity[b], rs.encode_np(k, m, data[b]))
+
+    def test_stripe_split_join(self):
+        blob = bytes(range(250))
+        shards = rs.split_stripe(blob, 4)
+        assert shards.shape == (4, 63)
+        assert rs.join_stripe(shards, len(blob)) == blob
+
+    def test_m_zero_is_noop_parity(self):
+        data = np.zeros((3, 8), dtype=np.uint8)
+        assert rs.encode_np(3, 0, data).shape == (0, 8)

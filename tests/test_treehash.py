@@ -1,0 +1,68 @@
+"""BLAKE3 tree hash tests (ops/treehash.py)."""
+
+import numpy as np
+import pytest
+
+from garage_tpu.ops import treehash
+
+# Published blake3 test vector: hash of the empty input.
+EMPTY_B3 = "af1349b9f5f9a1a6a0404dea36dcc9499bcb25c9adc112b7cc9a93cae41f3262"
+
+
+def vector_input(n: int) -> bytes:
+    """The official blake3 test-vector input pattern: bytes i % 251."""
+    return bytes(i % 251 for i in range(n))
+
+
+class TestPythonReference:
+    def test_empty_vector(self):
+        assert treehash.blake3_py(b"").hex() == EMPTY_B3
+
+    def test_deterministic_and_distinct(self):
+        a = treehash.blake3_py(b"hello")
+        assert a == treehash.blake3_py(b"hello")
+        assert a != treehash.blake3_py(b"hellp")
+        assert len(a) == 32
+
+    def test_chunk_boundaries_distinct(self):
+        # Different lengths straddling chunk/block boundaries all distinct
+        seen = set()
+        for n in (0, 1, 63, 64, 65, 1023, 1024, 1025, 2048, 3072):
+            seen.add(treehash.blake3_py(vector_input(n)))
+        assert len(seen) == 10
+
+
+class TestJaxMatchesReference:
+    @pytest.mark.parametrize(
+        "n",
+        [0, 1, 31, 64, 65, 128, 1023, 1024, 1025, 2047, 2048, 2049,
+         3 * 1024, 5 * 1024 + 7, 8 * 1024, 16 * 1024 + 1],
+    )
+    def test_lengths(self, n):
+        data = vector_input(n)
+        got = treehash.blake3_many([data])[0]
+        assert got.hex() == treehash.blake3_py(data).hex(), f"len={n}"
+
+    def test_batch_mixed_lengths(self):
+        blobs = [vector_input(n) for n in (0, 10, 1024, 1500, 1500, 4096, 100)]
+        got = treehash.blake3_many(blobs)
+        want = [treehash.blake3_py(b) for b in blobs]
+        assert [g.hex() for g in got] == [w.hex() for w in want]
+
+    def test_batch_same_chunkcount_shares_program(self):
+        # 1500 and 2000 bytes are both 2 chunks — one device call
+        before = treehash._hash_fn.cache_info().currsize
+        treehash.blake3_many([vector_input(1500), vector_input(2000)])
+        after = treehash._hash_fn.cache_info().currsize
+        assert after <= before + 1
+
+    def test_hash_batch_jax_shape(self):
+        msgs = np.zeros((3, 2048), dtype=np.uint8)
+        out = treehash.hash_batch_jax(msgs, np.array([1025, 1500, 2048]))
+        assert out.shape == (3, 32)
+        assert out[2].tobytes().hex() == treehash.blake3_py(bytes(2048)).hex()
+
+    def test_hash_batch_jax_rejects_wrong_chunk_count(self):
+        msgs = np.zeros((1, 2048), dtype=np.uint8)
+        with pytest.raises(ValueError):
+            treehash.hash_batch_jax(msgs, np.array([0]))
