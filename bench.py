@@ -1,0 +1,52 @@
+"""Headline benchmark: RS(10,4) erasure encode throughput, GB/s per chip.
+
+Prints exactly one JSON line. Baseline: 4.0 GB/s/chip (BASELINE.md,
+driver target for the north-star metric "RS(10,4) encode MB/s").
+Runs on whatever backend JAX finds (real TPU under the driver).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from garage_tpu.ops import rs
+
+    k, m = 10, 4
+    shard_len = 1 << 20  # 1 MiB shards -> 10 MiB stripes (16 MiB-part regime)
+    batch = 8
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(batch, k, shard_len), dtype=np.uint8)
+    data = jax.device_put(data)
+
+    parity = rs.encode(k, m, data)  # compile + warm
+    jax.block_until_ready(parity)
+
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        parity = rs.encode(k, m, data)
+    jax.block_until_ready(parity)
+    dt = time.perf_counter() - t0
+
+    gbps = batch * k * shard_len * iters / dt / 1e9
+    print(
+        json.dumps(
+            {
+                "metric": "rs_10_4_encode",
+                "value": round(gbps, 3),
+                "unit": "GB/s/chip",
+                "vs_baseline": round(gbps / 4.0, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,592 @@
+"""Authenticated channel + multiplexed, priority-scheduled framing.
+
+Ref parity: src/net/{client,server,send,recv}.rs. Same wire concepts —
+version-tag handshake gate, cluster-secret check, mutual public-key auth,
+chunked frames `[u32 request_id][u16 flags|len][bytes]` with a max chunk
+size, per-priority round-robin between in-flight streams, CANCEL frames —
+rebuilt for asyncio, plus per-stream credit flow control (the reference
+gets backpressure from its poll-driven scheduler; an asyncio push model
+needs explicit credits or slow consumers buffer whole transfers).
+
+Crypto: the reference uses the Secret-Handshake protocol + BoxStream
+(kuska). Here: ed25519 identity keys sign a transcript that includes
+X25519 ephemerals and an HMAC over the cluster `netid` (the shared
+secret gate), then both directions run ChaCha20-Poly1305 with counter
+nonces — same properties (mutual auth, cluster gate, confidentiality,
+forward secrecy) with standard primitives from `cryptography`.
+
+Frame flags (in the u16 len field):
+  0x8000 CONTINUES — more chunks follow for this section
+  0x4000 ERROR     — section is an error payload
+  0x2000 STREAM    — chunk belongs to the attached byte stream
+  len = field & 0x1FFF, <= MAX_CHUNK (0x1FF0)
+  field == 0xFFFF  — CANCEL marker for this request id
+  field == 0xFFFE  — CREDIT grant; payload = u32 additional window bytes
+
+Concurrency invariant: ALL outgoing records flow through _send_loop (the
+single writer) — the AEAD nonce counter and frame ordering both depend
+on it. CANCEL/CREDIT are enqueued control items, never written directly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac as hmac_mod
+import logging
+import struct
+from typing import Awaitable, Callable, Optional
+
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+from ..utils.error import RpcError
+from .message import PRIO_HIGH, pack, unpack
+from .stream import ByteStream
+
+log = logging.getLogger("garage_tpu.net")
+
+MAGIC = b"GRGTPU\x01\x00"  # protocol version gate (ref: net/netapp.rs:35-40)
+MAX_CHUNK = 0x1FF0
+F_CONT = 0x8000
+F_ERROR = 0x4000
+F_STREAM = 0x2000
+LEN_MASK = 0x1FFF
+CANCEL = 0xFFFF
+CREDIT = 0xFFFE
+
+# Stream flow control: sender may have this many un-acked stream bytes in
+# flight per request; receiver grants more as the consumer drains.
+STREAM_WINDOW = 4 << 20
+CREDIT_BATCH = 1 << 20  # grant credits in chunks this big
+
+
+def _hmac(key: bytes, *parts: bytes) -> bytes:
+    return hmac_mod.new(key, b"".join(parts), hashlib.blake2b).digest()[:32]
+
+
+def _hkdf(secret: bytes, info: bytes) -> bytes:
+    return hashlib.blake2b(secret, key=info[:64], digest_size=32).digest()
+
+
+class HandshakeError(RpcError):
+    pass
+
+
+async def client_handshake(
+    reader: asyncio.StreamReader,
+    writer: asyncio.StreamWriter,
+    netid: bytes,
+    privkey: Ed25519PrivateKey,
+) -> tuple[bytes, "SecureChannel"]:
+    """Initiator side. Returns (peer node id, channel)."""
+    pub = privkey.public_key().public_bytes_raw()
+    eph = X25519PrivateKey.generate()
+    eph_pub = eph.public_key().public_bytes_raw()
+    hello = MAGIC + pub + eph_pub
+    writer.write(hello + _hmac(netid, b"hello", hello))
+    await writer.drain()
+
+    srv = await reader.readexactly(len(MAGIC) + 32 + 32 + 32 + 64)
+    if srv[: len(MAGIC)] != MAGIC:
+        raise HandshakeError("protocol version mismatch")
+    off = len(MAGIC)
+    srv_pub, srv_eph, srv_mac = srv[off : off + 32], srv[off + 32 : off + 64], srv[off + 64 : off + 96]
+    srv_sig = srv[off + 96 :]
+    transcript = b"srv" + hello + srv[: off + 64]
+    if not hmac_mod.compare_digest(srv_mac, _hmac(netid, transcript)):
+        raise HandshakeError("peer does not know the cluster secret")
+    Ed25519PublicKey.from_public_bytes(srv_pub).verify(srv_sig, transcript)
+
+    sig = privkey.sign(b"cli" + transcript)
+    writer.write(sig)
+    await writer.drain()
+
+    shared = eph.exchange(X25519PublicKey.from_public_bytes(srv_eph))
+    secret = _hkdf(shared, b"garage-tpu-channel" + eph_pub + srv_eph)
+    chan = SecureChannel(reader, writer, send_key=_hkdf(secret, b"c2s"), recv_key=_hkdf(secret, b"s2c"))
+    return srv_pub, chan
+
+
+async def server_handshake(
+    reader: asyncio.StreamReader,
+    writer: asyncio.StreamWriter,
+    netid: bytes,
+    privkey: Ed25519PrivateKey,
+) -> tuple[bytes, "SecureChannel"]:
+    """Acceptor side. Returns (peer node id, channel)."""
+    hello = await reader.readexactly(len(MAGIC) + 32 + 32)
+    mac = await reader.readexactly(32)
+    if hello[: len(MAGIC)] != MAGIC:
+        raise HandshakeError("protocol version mismatch")
+    if not hmac_mod.compare_digest(mac, _hmac(netid, b"hello", hello)):
+        raise HandshakeError("peer does not know the cluster secret")
+    off = len(MAGIC)
+    cli_pub, cli_eph = hello[off : off + 32], hello[off + 32 : off + 64]
+
+    pub = privkey.public_key().public_bytes_raw()
+    eph = X25519PrivateKey.generate()
+    eph_pub = eph.public_key().public_bytes_raw()
+    head = MAGIC + pub + eph_pub
+    transcript = b"srv" + hello + head
+    srv_mac = _hmac(netid, transcript)
+    sig = privkey.sign(transcript)
+    writer.write(head + srv_mac + sig)
+    await writer.drain()
+
+    cli_sig = await reader.readexactly(64)
+    Ed25519PublicKey.from_public_bytes(cli_pub).verify(cli_sig, b"cli" + transcript)
+
+    shared = eph.exchange(X25519PublicKey.from_public_bytes(cli_eph))
+    secret = _hkdf(shared, b"garage-tpu-channel" + cli_eph + eph_pub)
+    chan = SecureChannel(reader, writer, send_key=_hkdf(secret, b"s2c"), recv_key=_hkdf(secret, b"c2s"))
+    return cli_pub, chan
+
+
+class SecureChannel:
+    """ChaCha20-Poly1305 record layer: [u32 ct_len][ct]; counter nonces."""
+
+    def __init__(self, reader, writer, send_key: bytes, recv_key: bytes):
+        self.reader = reader
+        self.writer = writer
+        self._send = ChaCha20Poly1305(send_key)
+        self._recv = ChaCha20Poly1305(recv_key)
+        self._send_ctr = 0
+        self._recv_ctr = 0
+
+    def _nonce(self, ctr: int) -> bytes:
+        return ctr.to_bytes(12, "little")
+
+    async def send_record(self, plaintext: bytes) -> None:
+        ct = self._send.encrypt(self._nonce(self._send_ctr), plaintext, None)
+        self._send_ctr += 1
+        self.writer.write(struct.pack("<I", len(ct)) + ct)
+        await self.writer.drain()
+
+    async def recv_record(self) -> bytes:
+        (n,) = struct.unpack("<I", await self.reader.readexactly(4))
+        ct = await self.reader.readexactly(n)
+        pt = self._recv.decrypt(self._nonce(self._recv_ctr), ct, None)
+        self._recv_ctr += 1
+        return pt
+
+    def close(self) -> None:
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+class _SendItem:
+    """One in-flight outgoing message: body section then optional stream.
+
+    Stream chunks are prefetched by a side task so a stalled stream
+    source never parks the connection's single send loop (the reference
+    gets this for free from its polled scheduler, src/net/send.rs).
+    """
+
+    __slots__ = (
+        "req_id", "prio", "body", "pos", "stream", "is_error", "done",
+        "kind", "next_chunk", "chunk_state", "prefetch", "window", "order_clock",
+    )
+
+    def __init__(self, req_id, prio, body, stream, is_error, kind="msg"):
+        self.req_id = req_id
+        self.prio = prio
+        self.body = body
+        self.pos = 0
+        self.stream = stream
+        self.is_error = is_error
+        self.kind = kind  # "msg" | "cancel" | "credit"
+        self.next_chunk: Optional[bytes] = None
+        self.chunk_state = "none"  # none|fetching|ready|eof|error
+        self.prefetch: Optional[asyncio.Task] = None
+        self.window = STREAM_WINDOW
+        self.order_clock = 0
+        self.done = asyncio.get_event_loop().create_future()
+
+
+class _RecvState:
+    """Reassembly of one incoming message."""
+
+    __slots__ = ("body", "stream", "is_error", "credited")
+
+    def __init__(self):
+        self.body = bytearray()
+        self.stream: Optional[ByteStream] = None
+        self.is_error = False
+        self.credited = 0
+
+
+class Conn:
+    """One duplex multiplexed connection to a peer.
+
+    Either side can issue requests; the initiator uses even request ids,
+    the acceptor odd (the reference instead opens two connections,
+    src/net/netapp.rs server_conns/client_conns — one duplex socket is
+    the asyncio-native shape).
+    """
+
+    def __init__(
+        self,
+        peer_id: bytes,
+        channel: SecureChannel,
+        handler: Callable[..., Awaitable],
+        initiator: bool,
+    ):
+        self.peer_id = peer_id
+        self.chan = channel
+        self.handler = handler  # (peer_id, path, prio, order, payload, stream)
+        self._next_id = 2 if initiator else 3
+        self._send_items: dict[int, _SendItem] = {}
+        self._ctl_items: list[_SendItem] = []
+        self._send_wakeup = asyncio.Event()
+        self._send_clock = 0
+        self._recv_states: dict[int, _RecvState] = {}
+        self._reply_waiters: dict[int, asyncio.Future] = {}
+        self._handler_tasks: dict[int, asyncio.Task] = {}
+        self._tasks: list[asyncio.Task] = []
+        self.closed = asyncio.get_event_loop().create_future()
+
+    def start(self) -> None:
+        self._tasks = [
+            asyncio.create_task(self._send_loop()),
+            asyncio.create_task(self._recv_loop()),
+        ]
+
+    # ---- outgoing ------------------------------------------------------
+
+    def _alloc_id(self) -> int:
+        i = self._next_id
+        self._next_id += 2
+        return i
+
+    def enqueue(
+        self,
+        req_id: int,
+        prio: int,
+        body: bytes,
+        stream: Optional[ByteStream] = None,
+        is_error: bool = False,
+    ) -> _SendItem:
+        item = _SendItem(req_id, prio, body, stream, is_error)
+        self._send_items[req_id] = item
+        self._send_wakeup.set()
+        return item
+
+    def _enqueue_ctl(self, kind: str, req_id: int, payload: bytes = b"") -> None:
+        item = _SendItem(req_id, 0, payload, None, False, kind=kind)
+        self._ctl_items.append(item)
+        self._send_wakeup.set()
+
+    async def call(
+        self,
+        path: str,
+        payload,
+        prio: int = PRIO_HIGH,
+        stream: Optional[ByteStream] = None,
+        timeout: Optional[float] = None,
+        order: Optional[tuple[int, int]] = None,
+    ):
+        """Send a request, await (payload, reply_stream)."""
+        req_id = self._alloc_id()
+        header = pack([path, prio, stream is not None, order, payload])
+        fut = asyncio.get_event_loop().create_future()
+        self._reply_waiters[req_id] = fut
+        self.enqueue(req_id, prio, header, stream)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except (asyncio.TimeoutError, asyncio.CancelledError):
+            self._abort_send(req_id)
+            self._enqueue_ctl("cancel", req_id)
+            raise
+        finally:
+            self._reply_waiters.pop(req_id, None)
+
+    def _abort_send(self, req_id: int) -> None:
+        item = self._send_items.pop(req_id, None)
+        if item is not None and item.prefetch is not None:
+            item.prefetch.cancel()
+
+    # ---- send scheduler ------------------------------------------------
+
+    async def _send_loop(self) -> None:
+        try:
+            while True:
+                item = self._pick_item()
+                if item is None:
+                    self._send_wakeup.clear()
+                    # re-check: a prefetch may have completed in between
+                    if self._pick_item() is None:
+                        await self._send_wakeup.wait()
+                    continue
+                await self._send_one_chunk(item)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._fail(e)
+
+    def _pick_item(self) -> Optional[_SendItem]:
+        """Control frames first; then highest priority, round-robin within
+        the level by least-recently-sent (ref: src/net/send.rs:48-60)."""
+        if self._ctl_items:
+            return self._ctl_items[0]
+        best: Optional[_SendItem] = None
+        for item in self._send_items.values():
+            if not self._sendable(item):
+                continue
+            if (
+                best is None
+                or item.prio < best.prio
+                or (item.prio == best.prio and item.order_clock < best.order_clock)
+            ):
+                best = item
+        return best
+
+    def _sendable(self, item: _SendItem) -> bool:
+        if item.pos < len(item.body) or (item.pos == 0 and not item.body):
+            return True
+        if item.stream is None:
+            return True  # finished body, will finalize
+        if item.chunk_state == "fetching":
+            return False
+        if item.chunk_state in ("ready", "eof", "error"):
+            return item.window > 0 or item.chunk_state in ("eof", "error")
+        # chunk_state == "none": start a prefetch, not sendable yet
+        self._start_prefetch(item)
+        return False
+
+    def _start_prefetch(self, item: _SendItem) -> None:
+        item.chunk_state = "fetching"
+
+        async def fetch():
+            try:
+                chunk = await item.stream.read_chunk(MAX_CHUNK)
+                item.next_chunk = chunk
+                item.chunk_state = "eof" if not chunk else "ready"
+            except Exception:
+                item.chunk_state = "error"
+            self._send_wakeup.set()
+
+        item.prefetch = asyncio.create_task(fetch())
+
+    async def _send_one_chunk(self, item: _SendItem) -> None:
+        if item.kind == "cancel":
+            self._ctl_items.remove(item)
+            await self.chan.send_record(struct.pack("<IH", item.req_id, CANCEL))
+            return
+        if item.kind == "credit":
+            self._ctl_items.remove(item)
+            await self.chan.send_record(
+                struct.pack("<IH", item.req_id, CREDIT) + item.body
+            )
+            return
+        self._send_clock += 1
+        item.order_clock = self._send_clock
+        flags_base = F_ERROR if item.is_error else 0
+        if item.pos < len(item.body) or (item.pos == 0 and not item.body):
+            chunk = item.body[item.pos : item.pos + MAX_CHUNK]
+            item.pos = max(item.pos + len(chunk), 1)  # 1 marks empty body sent
+            more_body = item.pos < len(item.body)
+            flags = flags_base | (F_CONT if more_body else 0)
+            await self.chan.send_record(
+                struct.pack("<IH", item.req_id, flags | len(chunk)) + chunk
+            )
+            if not more_body and item.stream is None:
+                self._finish_item(item)
+            return
+        # stream section
+        if item.chunk_state == "error":
+            await self.chan.send_record(
+                struct.pack("<IH", item.req_id, F_STREAM | F_ERROR)
+            )
+            self._finish_item(item)
+            return
+        if item.chunk_state == "eof":
+            await self.chan.send_record(struct.pack("<IH", item.req_id, F_STREAM))
+            self._finish_item(item)
+            return
+        assert item.chunk_state == "ready"
+        chunk = item.next_chunk or b""
+        send_now = chunk[: max(0, item.window)]
+        rest = chunk[len(send_now) :]
+        if rest:
+            item.next_chunk = rest  # window-limited; stays ready
+        else:
+            item.next_chunk = None
+            item.chunk_state = "none"
+        item.window -= len(send_now)
+        await self.chan.send_record(
+            struct.pack("<IH", item.req_id, F_STREAM | F_CONT | len(send_now)) + send_now
+        )
+
+    def _finish_item(self, item: _SendItem) -> None:
+        self._send_items.pop(item.req_id, None)
+        if not item.done.done():
+            item.done.set_result(None)
+
+    # ---- incoming ------------------------------------------------------
+
+    async def _recv_loop(self) -> None:
+        try:
+            while True:
+                rec = await self.chan.recv_record()
+                req_id, field = struct.unpack_from("<IH", rec)
+                payload = rec[6:]
+                if field == CANCEL:
+                    self._handle_cancel(req_id)
+                elif field == CREDIT:
+                    self._handle_credit(req_id, payload)
+                else:
+                    self._handle_chunk(req_id, field, payload)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._fail(e)
+
+    def _handle_cancel(self, req_id: int) -> None:
+        task = self._handler_tasks.pop(req_id, None)
+        if task is not None:
+            task.cancel()
+        self._abort_send(req_id)
+        st = self._recv_states.pop(req_id, None)
+        if st is not None and st.stream is not None:
+            st.stream.push_error(RpcError("cancelled by peer"))
+
+    def _handle_credit(self, req_id: int, payload: bytes) -> None:
+        item = self._send_items.get(req_id)
+        if item is not None and len(payload) >= 4:
+            item.window += struct.unpack("<I", payload[:4])[0]
+            self._send_wakeup.set()
+
+    def _grant_credit(self, req_id: int, stream: ByteStream) -> None:
+        """Wire consumer progress to CREDIT grants back to the sender."""
+        state = {"pending": 0}
+
+        def consumed(n: int) -> None:
+            state["pending"] += n
+            if state["pending"] >= CREDIT_BATCH:
+                grant, state["pending"] = state["pending"], 0
+                self._enqueue_ctl("credit", req_id, struct.pack("<I", grant))
+
+        stream.on_consume = consumed
+
+    def _handle_chunk(self, req_id: int, field: int, payload: bytes) -> None:
+        mine = (req_id % 2 == 0) == (self._next_id % 2 == 0)
+        st = self._recv_states.get(req_id)
+        if st is None:
+            st = self._recv_states[req_id] = _RecvState()
+        if field & F_STREAM:
+            if st.stream is None:
+                st.stream = ByteStream()
+            if field & F_ERROR:
+                st.stream.push_error(RpcError("peer stream failed"))
+                self._recv_states.pop(req_id, None)
+            elif field & F_CONT:
+                st.stream.push(payload)
+            else:
+                if payload:
+                    st.stream.push(payload)
+                st.stream.push_eof()
+                self._recv_states.pop(req_id, None)
+            return
+        st.body.extend(payload)
+        st.is_error = st.is_error or bool(field & F_ERROR)
+        if field & F_CONT:
+            return
+        try:
+            header = unpack(bytes(st.body))
+        except Exception:
+            # fragment of a cancelled request whose state we dropped —
+            # drop it; the request id is dead
+            self._recv_states.pop(req_id, None)
+            return
+        if mine:
+            self._deliver_reply(req_id, st, header)
+        else:
+            self._dispatch_request(req_id, st, header)
+
+    @staticmethod
+    def _expect_stream(header) -> bool:
+        # reply header: [ok, payload, has_stream]
+        return bool(header[2]) if isinstance(header, list) and len(header) >= 3 else False
+
+    def _deliver_reply(self, req_id: int, st: _RecvState, header) -> None:
+        fut = self._reply_waiters.pop(req_id, None)
+        has_stream = self._expect_stream(header)
+        if has_stream and st.stream is None:
+            st.stream = ByteStream()
+        if not has_stream:
+            self._recv_states.pop(req_id, None)
+        if fut is None or fut.done():
+            if st.stream:
+                st.stream.discard()
+            return
+        if st.is_error or (isinstance(header, list) and not header[0]):
+            msg = header[1] if isinstance(header, list) else "remote error"
+            fut.set_exception(RpcError(str(msg)))
+        else:
+            if st.stream is not None:
+                self._grant_credit(req_id, st.stream)
+            fut.set_result((header[1], st.stream))
+
+    def _dispatch_request(self, req_id: int, st: _RecvState, header) -> None:
+        # request header: [path, prio, has_stream, order, payload]
+        path, prio, has_stream, order, payload = header
+        if has_stream and st.stream is None:
+            st.stream = ByteStream()
+        if st.stream is not None:
+            self._grant_credit(req_id, st.stream)
+        if not has_stream:
+            self._recv_states.pop(req_id, None)
+        task = asyncio.create_task(
+            self._run_handler(req_id, path, prio, order, payload, st.stream)
+        )
+        self._handler_tasks[req_id] = task
+        task.add_done_callback(lambda t: self._handler_tasks.pop(req_id, None))
+
+    async def _run_handler(self, req_id, path, prio, order, payload, stream) -> None:
+        try:
+            result, reply_stream = await self.handler(
+                self.peer_id, path, prio, order, payload, stream
+            )
+            body = pack([True, result, reply_stream is not None])
+            self.enqueue(req_id, prio, body, reply_stream)
+        except asyncio.CancelledError:
+            pass
+        except Exception as e:
+            log.debug("handler error on %s: %s", path, e, exc_info=True)
+            self.enqueue(req_id, prio, pack([False, f"{type(e).__name__}: {e}", False]))
+
+    # ---- lifecycle -----------------------------------------------------
+
+    def _fail(self, exc: Exception) -> None:
+        for fut in self._reply_waiters.values():
+            if not fut.done():
+                fut.set_exception(RpcError(f"connection lost: {exc}"))
+        self._reply_waiters.clear()
+        for st in self._recv_states.values():
+            if st.stream:
+                st.stream.push_error(RpcError("connection lost"))
+        self._recv_states.clear()
+        for item in self._send_items.values():
+            if item.prefetch is not None:
+                item.prefetch.cancel()
+        self._send_items.clear()
+        for t in self._handler_tasks.values():
+            t.cancel()
+        if not self.closed.done():
+            self.closed.set_result(exc)
+        self.chan.close()
+
+    async def close(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        self._fail(RpcError("closed"))

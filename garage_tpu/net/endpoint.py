@@ -1,0 +1,57 @@
+"""Typed endpoints: path-keyed handler registry.
+
+Ref parity: src/net/endpoint.rs:18-104 — endpoints are named by path
+strings like "garage_table/table.rs/Rpc:object"; handlers receive the
+decoded payload plus the sender's node id, and may consume/produce byte
+streams.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Optional
+
+from ..utils.error import RpcError
+from .stream import ByteStream
+
+# handler(from_node: bytes, payload, stream: Optional[ByteStream])
+#   -> payload | (payload, Optional[ByteStream])
+Handler = Callable[..., Awaitable]
+
+
+class Endpoint:
+    """One named RPC endpoint on a NetApp."""
+
+    def __init__(self, netapp, path: str):
+        self.netapp = netapp
+        self.path = path
+        self._handler: Optional[Handler] = None
+
+    def set_handler(self, handler: Handler) -> "Endpoint":
+        self._handler = handler
+        return self
+
+    async def handle(self, from_node: bytes, payload, stream: Optional[ByteStream]):
+        if self._handler is None:
+            raise RpcError(f"no handler for {self.path}")
+        result = await self._handler(from_node, payload, stream)
+        if isinstance(result, tuple) and len(result) == 2 and (
+            result[1] is None or isinstance(result[1], ByteStream)
+        ):
+            return result
+        return result, None
+
+    async def call(
+        self,
+        node: bytes,
+        payload,
+        prio: int,
+        stream: Optional[ByteStream] = None,
+        timeout: Optional[float] = None,
+        order: Optional[tuple[int, int]] = None,
+    ):
+        """Call this endpoint on `node` (loopback if node is ourself).
+        Returns (payload, reply_stream|None)."""
+        return await self.netapp.call(
+            node, self.path, payload, prio, stream=stream, timeout=timeout, order=order
+        )

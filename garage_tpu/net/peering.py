@@ -1,0 +1,284 @@
+"""Full-mesh peering: pings, peer exchange, failure detection, reconnect.
+
+Ref parity: src/net/peering.rs:23-615. Same state machine
+(Ourself/Connected/Trying/Waiting/Abandonned), ping every 15 s carrying a
+hash of the known peer list (pull the list on mismatch), failure
+declared after 4 failed pings of 10 s each, reconnect with backoff.
+Ping RTT stats feed the rpc layer's request ordering
+(src/rpc/rpc_helper.rs:621-660).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Optional
+
+from ..utils.data import blake2sum
+from .message import PRIO_HIGH
+from .netapp import NetApp
+
+log = logging.getLogger("garage_tpu.net.peering")
+
+PING_INTERVAL = 15.0
+PING_TIMEOUT = 10.0
+FAILED_PING_THRESHOLD = 4
+CONN_RETRY_INTERVAL = 30.0
+CONN_MAX_RETRIES = 10
+
+
+class PeerConnState(Enum):
+    OURSELF = "ourself"
+    CONNECTED = "connected"
+    TRYING = "trying"
+    WAITING = "waiting"
+    ABANDONNED = "abandonned"
+
+
+@dataclass
+class PeerInfo:
+    id: bytes
+    addr: Optional[tuple]
+    state: PeerConnState
+    last_seen: Optional[float] = None
+    ping_avg: Optional[float] = None
+    ping_max: Optional[float] = None
+
+
+@dataclass
+class _Peer:
+    id: bytes
+    addr: Optional[tuple] = None
+    state: PeerConnState = PeerConnState.WAITING
+    next_retry: float = 0.0
+    retries: int = 0
+    failed_pings: int = 0
+    last_seen: Optional[float] = None
+    pings: list = field(default_factory=list)  # last RTTs
+
+    def record_ping(self, rtt: float) -> None:
+        self.pings.append(rtt)
+        if len(self.pings) > 10:
+            self.pings.pop(0)
+        self.last_seen = time.monotonic()
+        self.failed_pings = 0
+
+
+class PeeringManager:
+    """Keeps this node connected to every known peer."""
+
+    def __init__(
+        self,
+        netapp: NetApp,
+        bootstrap: list,
+        ping_interval: float = PING_INTERVAL,
+        ping_timeout: float = PING_TIMEOUT,
+        retry_interval: float = CONN_RETRY_INTERVAL,
+    ):
+        self.netapp = netapp
+        self.ping_interval = ping_interval
+        self.ping_timeout = ping_timeout
+        self.retry_interval = retry_interval
+        self.peers: dict[bytes, _Peer] = {
+            netapp.id: _Peer(netapp.id, netapp.public_addr, PeerConnState.OURSELF)
+        }
+        # bootstrap addresses whose node id we don't know yet; moved into
+        # self.peers once a connection reveals the id (kept separate — an
+        # in-band key prefix would collide with real 32-byte ids)
+        self.pending: dict[tuple, _Peer] = {}
+        for entry in bootstrap:
+            addr, pid = (entry, None) if not _is_pair(entry) else entry
+            self.add_peer(tuple(addr) if addr else None, pid)
+
+        self.ep_ping = netapp.endpoint("garage_net/peering:ping").set_handler(self._h_ping)
+        self.ep_list = netapp.endpoint("garage_net/peering:list").set_handler(self._h_list)
+        self.ep_hello = netapp.endpoint("garage_net/peering:hello").set_handler(self._h_hello)
+        netapp.on_connected.append(self._on_connected)
+        netapp.on_disconnected.append(self._on_disconnected)
+        self._stop = asyncio.Event()
+
+    # ---- public --------------------------------------------------------
+
+    def get_peer_list(self) -> list[PeerInfo]:
+        out = []
+        for p in self.peers.values():
+            avg = sum(p.pings) / len(p.pings) if p.pings else None
+            mx = max(p.pings) if p.pings else None
+            out.append(PeerInfo(p.id, p.addr, p.state, p.last_seen, avg, mx))
+        return out
+
+    def ping_avg(self, node: bytes) -> Optional[float]:
+        p = self.peers.get(node)
+        return (sum(p.pings) / len(p.pings)) if p and p.pings else None
+
+    def add_peer(self, addr, pid: Optional[bytes] = None) -> None:
+        if pid == self.netapp.id:
+            return
+        if pid is None:
+            if addr is not None and addr not in self.pending:
+                self.pending[addr] = _Peer(None, addr)
+            return
+        if pid in self.peers:
+            if addr is not None:
+                self.peers[pid].addr = addr
+        else:
+            self.peers[pid] = _Peer(pid, addr)
+        if addr is not None:
+            self.pending.pop(addr, None)
+
+    async def stop(self) -> None:
+        self._stop.set()
+
+    # ---- loops ---------------------------------------------------------
+
+    async def run(self) -> None:
+        ping_task = asyncio.create_task(self._ping_loop())
+        conn_task = asyncio.create_task(self._connect_loop())
+        await self._stop.wait()
+        ping_task.cancel()
+        conn_task.cancel()
+
+    async def _ping_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.ping_interval * random.uniform(0.8, 1.2))
+            self.netapp._ordered.prune()
+            targets = [
+                p for p in self.peers.values() if p.state == PeerConnState.CONNECTED
+            ]
+            await asyncio.gather(*(self._ping_one(p) for p in targets))
+
+    async def _ping_one(self, peer: _Peer) -> None:
+        t0 = time.monotonic()
+        try:
+            resp, _ = await self.ep_ping.call(
+                peer.id, {"hash": self._peer_list_hash()}, PRIO_HIGH, timeout=self.ping_timeout
+            )
+            peer.record_ping(time.monotonic() - t0)
+            if resp.get("hash") != self._peer_list_hash():
+                await self._pull_peer_list(peer.id)
+        except Exception:
+            peer.failed_pings += 1
+            if peer.failed_pings >= FAILED_PING_THRESHOLD:
+                log.info("peer %s failed %d pings, disconnecting", peer.id[:4].hex(), peer.failed_pings)
+                conn = self.netapp.conns.get(peer.id)
+                if conn is not None:
+                    await conn.close()
+
+    async def _connect_loop(self) -> None:
+        while True:
+            now = time.monotonic()
+            for peer in list(self.peers.values()) + list(self.pending.values()):
+                if (
+                    peer.state == PeerConnState.WAITING
+                    and peer.next_retry <= now
+                    and peer.addr is not None
+                ):
+                    peer.state = PeerConnState.TRYING
+                    asyncio.ensure_future(self._try_connect(peer))
+            await asyncio.sleep(min(1.0, self.retry_interval / 10))
+
+    async def _try_connect(self, peer: _Peer) -> None:
+        try:
+            got = await self.netapp.try_connect(peer.addr, peer.id)
+            if peer.id is None:
+                # learned the real id for a bootstrap addr
+                self.pending.pop(peer.addr, None)
+                self.add_peer(peer.addr, got)
+                p2 = self.peers.get(got)
+                if p2 is not None:
+                    p2.state = PeerConnState.CONNECTED
+        except Exception as e:
+            log.debug("connect to %s failed: %s", peer.addr, e)
+            peer.retries += 1
+            if peer.retries >= CONN_MAX_RETRIES:
+                peer.state = PeerConnState.ABANDONNED
+            else:
+                peer.state = PeerConnState.WAITING
+                backoff = self.retry_interval * min(2 ** (peer.retries - 1), 8)
+                peer.next_retry = time.monotonic() + backoff * random.uniform(0.8, 1.2)
+
+    # ---- netapp callbacks ---------------------------------------------
+
+    def _on_connected(self, peer_id: bytes, incoming: bool) -> None:
+        p = self.peers.get(peer_id)
+        if p is None:
+            p = self.peers[peer_id] = _Peer(peer_id)
+        p.state = PeerConnState.CONNECTED
+        p.retries = 0
+        p.failed_pings = 0
+        p.last_seen = time.monotonic()
+        if not incoming:
+            # tell the acceptor our public address (ref Hello message,
+            # src/net/netapp.rs:440-470)
+            asyncio.ensure_future(self._send_hello(peer_id))
+
+    async def _send_hello(self, peer_id: bytes) -> None:
+        try:
+            await self.ep_hello.call(
+                peer_id, {"addr": list(self.netapp.public_addr or ())}, PRIO_HIGH, timeout=10.0
+            )
+        except Exception:
+            pass
+
+    def _on_disconnected(self, peer_id: bytes) -> None:
+        p = self.peers.get(peer_id)
+        if p is not None and p.state == PeerConnState.CONNECTED:
+            p.state = PeerConnState.WAITING
+            p.next_retry = time.monotonic() + self.retry_interval * random.uniform(0.5, 1.0)
+
+    # ---- rpc handlers --------------------------------------------------
+
+    def _peer_list_hash(self) -> bytes:
+        # covers exactly what _h_list serves (id+addr known), so hash
+        # equality <=> list equality and pings don't re-pull forever
+        items = sorted(
+            (p.id, tuple(p.addr))
+            for p in self.peers.values()
+            if p.addr is not None
+        )
+        return blake2sum(repr(items).encode())
+
+    async def _h_ping(self, from_node, payload, stream):
+        p = self.peers.get(from_node)
+        if p is not None:
+            p.last_seen = time.monotonic()
+        return {"hash": self._peer_list_hash()}
+
+    async def _h_list(self, from_node, payload, stream):
+        return {
+            "peers": [
+                [p.id, list(p.addr)]
+                for p in self.peers.values()
+                if p.addr is not None
+            ]
+        }
+
+    async def _h_hello(self, from_node, payload, stream):
+        addr = payload.get("addr")
+        if addr:
+            self.add_peer(tuple(addr), from_node)
+            p = self.peers.get(from_node)
+            if p is not None:
+                p.addr = tuple(addr)
+        return {}
+
+    async def _pull_peer_list(self, node: bytes) -> None:
+        try:
+            resp, _ = await self.ep_list.call(node, {}, PRIO_HIGH, timeout=self.ping_timeout)
+            for pid, addr in resp.get("peers", []):
+                self.add_peer(tuple(addr) if addr else None, bytes(pid))
+        except Exception:
+            pass
+
+
+def _is_pair(entry) -> bool:
+    return (
+        isinstance(entry, (tuple, list))
+        and len(entry) == 2
+        and (entry[1] is None or isinstance(entry[1], bytes))
+        and isinstance(entry[0], (tuple, list))
+    )

@@ -10,8 +10,10 @@ Scrub/verify of a whole batch of blocks becomes one jitted program.
 Layout of the JAX path: messages are padded to a static chunk count C;
 byte *lengths* stay traced, so one compiled program serves every block
 whose size lands in the same chunk count (tail blocks don't recompile).
-Within a chunk the 16 blake3 blocks chain sequentially (lax.scan); across
-chunks and across the batch everything is vmapped.
+Batching is lane-major (batch = trailing vector axis, see the section
+comment above _compress_lanes): all C*B chunks are lanes of one 16-step
+lax.scan over block positions, and each scan step runs the 7 rounds as
+an inner scan.
 
 The pure-Python implementation is the test oracle (checked against the
 published empty-input vector) and the host fallback for small inputs.
@@ -131,126 +133,162 @@ def blake3_py(data: bytes) -> bytes:
 
 
 # ---------------------------------------------------------------------------
-# JAX batched implementation
+# JAX batched implementation — lane-major
 # ---------------------------------------------------------------------------
+#
+# Batch layout: every independent hash unit (chunk of a row, then parent
+# node of a tree level) is a *lane* — the trailing axis of every array.
+# State is (8, L), messages (16, L); the compression function is ~450
+# elementwise u32 ops on (L,) vectors regardless of batch size, so the
+# HLO graph is batch-size independent (a vmap formulation made XLA:CPU
+# compile time explode superlinearly in B) and maps straight onto the
+# TPU VPU's 128-wide lanes.
 
 
-def _compress_jax(h, m, counter, block_len, flags):
-    """h (8,) u32, m (16,) u32, scalars u32 -> (8,) u32. Fully unrolled."""
-    import jax.numpy as jnp
+def _compress_lanes(h, m, counter, block_len, flags):
+    """h (8, L), m (16, L), counter/block_len/flags (L,) or scalar u32
+    -> (8, L). All ops lane-vectorized.
 
-    u32 = jnp.uint32
-    v = [h[i] for i in range(8)] + [
-        u32(IV[0]), u32(IV[1]), u32(IV[2]), u32(IV[3]),
-        counter.astype(u32), (counter >> 32).astype(u32) if counter.dtype.itemsize == 8 else u32(0),
-        block_len.astype(u32), flags.astype(u32),
-    ]
-
-    def rotr(x, n):
-        return (x >> u32(n)) | (x << u32(32 - n))
-
-    def g(a, b, c, d, mx, my):
-        v[a] = v[a] + v[b] + mx
-        v[d] = rotr(v[d] ^ v[a], 16)
-        v[c] = v[c] + v[d]
-        v[b] = rotr(v[b] ^ v[c], 12)
-        v[a] = v[a] + v[b] + my
-        v[d] = rotr(v[d] ^ v[a], 8)
-        v[c] = v[c] + v[d]
-        v[b] = rotr(v[b] ^ v[c], 7)
-
-    for sched in _schedules():
-        g(0, 4, 8, 12, m[sched[0]], m[sched[1]])
-        g(1, 5, 9, 13, m[sched[2]], m[sched[3]])
-        g(2, 6, 10, 14, m[sched[4]], m[sched[5]])
-        g(3, 7, 11, 15, m[sched[6]], m[sched[7]])
-        g(0, 5, 10, 15, m[sched[8]], m[sched[9]])
-        g(1, 6, 11, 12, m[sched[10]], m[sched[11]])
-        g(2, 7, 8, 13, m[sched[12]], m[sched[13]])
-        g(3, 4, 9, 14, m[sched[14]], m[sched[15]])
-    import jax.numpy as jnp2
-
-    return jnp2.stack([v[i] ^ v[i + 8] for i in range(8)])
-
-
-def _chunk_cv_jax(words, counter, chunk_len, is_root_chunk):
-    """One chunk: words (16, 16) u32 (block, word), chunk_len u32 traced.
-
-    lax.scan over the 16 block positions; positions past the chunk's last
-    block are masked out so traced lengths don't change the program.
+    The 7 rounds run as a lax.scan whose body gathers that round's
+    message schedule — keeping the HLO body near 70 ops. A fully
+    unrolled formulation (~450 interdependent u32 ops) sends XLA:CPU's
+    backend into multi-minute compiles for any lane count >= 4; the
+    scan form compiles in seconds everywhere and XLA still unrolls or
+    pipelines it on TPU as it sees fit.
     """
     import jax
     import jax.numpy as jnp
 
     u32 = jnp.uint32
-    n_blocks = jnp.maximum(u32(1), (chunk_len + u32(BLOCK_LEN - 1)) // u32(BLOCK_LEN))
-    pos = jnp.arange(BLOCKS_PER_CHUNK, dtype=jnp.uint32)
+    ones = jnp.ones_like(h[0])
+    sched = jnp.asarray(np.array(_schedules(), dtype=np.int32))  # (7, 16)
+    v0 = jnp.stack(
+        [h[i] for i in range(8)]
+        + [
+            u32(IV[0]) * ones, u32(IV[1]) * ones, u32(IV[2]) * ones, u32(IV[3]) * ones,
+            counter * ones, jnp.zeros_like(ones),
+            block_len * ones, flags * ones,
+        ]
+    )  # (16, L)
+
+    def rotr(x, n):
+        return (x >> u32(n)) | (x << u32(32 - n))
+
+    def round_body(vs, idx):
+        mr = jnp.take(m, idx, axis=0)  # (16, L) permuted message
+        v = [vs[i] for i in range(16)]
+
+        def g(a, b, c, d, mx, my):
+            v[a] = v[a] + v[b] + mx
+            v[d] = rotr(v[d] ^ v[a], 16)
+            v[c] = v[c] + v[d]
+            v[b] = rotr(v[b] ^ v[c], 12)
+            v[a] = v[a] + v[b] + my
+            v[d] = rotr(v[d] ^ v[a], 8)
+            v[c] = v[c] + v[d]
+            v[b] = rotr(v[b] ^ v[c], 7)
+
+        g(0, 4, 8, 12, mr[0], mr[1])
+        g(1, 5, 9, 13, mr[2], mr[3])
+        g(2, 6, 10, 14, mr[4], mr[5])
+        g(3, 7, 11, 15, mr[6], mr[7])
+        g(0, 5, 10, 15, mr[8], mr[9])
+        g(1, 6, 11, 12, mr[10], mr[11])
+        g(2, 7, 8, 13, mr[12], mr[13])
+        g(3, 4, 9, 14, mr[14], mr[15])
+        return jnp.stack(v), None
+
+    v, _ = jax.lax.scan(round_body, v0, sched)
+    return v[:8] ^ v[8:16]
+
+
+def hash_rows(msgs, lengths, n_chunks: int):
+    """Traceable batched hash: (B, n_chunks*1024) u8 + (B,) i32 -> (B, 8) u32.
+
+    Precondition (caller-enforced, like hash_batch_jax does): every row's
+    length must span exactly n_chunks, i.e. n_chunks_for(length) ==
+    n_chunks, and bytes past `length` must be zero — otherwise the digest
+    is silently wrong (phantom all-zero chunks enter the tree).
+
+    Composable inside larger jitted programs (parallel/ data-plane steps);
+    _hash_fn below is the standalone jitted wrapper. All C*B chunks hash
+    as lanes of one 16-step lax.scan over block positions; the parent
+    tree is a static log2(C) unroll, each level one lane-vectorized
+    compression over all pairs of all rows.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    u32 = jnp.uint32
+    b = msgs.shape[0]
+    c = n_chunks
+    w = msgs.reshape(b, c, BLOCKS_PER_CHUNK, BLOCK_LEN // 4, 4).astype(u32)
+    words = w[..., 0] | (w[..., 1] << 8) | (w[..., 2] << 16) | (w[..., 3] << 24)
+    # (B, C, block, word) -> (block, word, C*B) lane = chunk-major
+    words = words.transpose(2, 3, 1, 0).reshape(BLOCKS_PER_CHUNK, 16, c * b)
+
+    counters = jnp.repeat(jnp.arange(c, dtype=u32), b)  # (C*B,)
+    chunk_lens = jnp.clip(
+        lengths[None, :] - jnp.arange(c, dtype=jnp.int32)[:, None] * CHUNK_LEN,
+        0,
+        CHUNK_LEN,
+    ).astype(u32).reshape(c * b)
+    n_blocks = jnp.maximum(u32(1), (chunk_lens + u32(BLOCK_LEN - 1)) // u32(BLOCK_LEN))
+
+    pos = jnp.arange(BLOCKS_PER_CHUNK, dtype=u32)[:, None]  # (block, 1)
     block_lens = jnp.clip(
-        chunk_len.astype(jnp.int32) - (pos * BLOCK_LEN).astype(jnp.int32), 0, BLOCK_LEN
-    ).astype(u32)
-    is_end = pos == (n_blocks - 1)
+        chunk_lens[None, :].astype(jnp.int32) - (pos * BLOCK_LEN).astype(jnp.int32),
+        0,
+        BLOCK_LEN,
+    ).astype(u32)  # (block, C*B)
+    is_end = pos == (n_blocks - 1)[None, :]
+    root_if_single = u32(ROOT if c == 1 else 0)
     flags = (
         jnp.where(pos == 0, u32(CHUNK_START), u32(0))
-        | jnp.where(is_end, u32(CHUNK_END), u32(0))
-        | jnp.where(is_end & is_root_chunk, u32(ROOT), u32(0))
+        | jnp.where(is_end, u32(CHUNK_END) | root_if_single, u32(0))
     )
-    active = pos < n_blocks
+    active = pos < n_blocks[None, :]
 
     def step(cv, xs):
         m, blen, flg, act = xs
-        new_cv = _compress_jax(cv, m, counter, blen, flg)
+        new_cv = _compress_lanes(cv, m, counters, blen, flg)
         return jnp.where(act, new_cv, cv), None
 
-    cv, _ = jax.lax.scan(step, jnp.array(IV, dtype=u32), (words, block_lens, flags, active))
-    return cv
+    init = jnp.tile(jnp.array(IV, dtype=u32)[:, None], (1, c * b))
+    cv, _ = jax.lax.scan(step, init, (words, block_lens, flags, active))  # (8, C*B)
 
+    if c == 1:
+        return cv.T  # (B, 8)
 
-def _parent_cv_jax(left, right, flags_val):
-    import jax.numpy as jnp
+    # Parent tree: pairwise merge with odd tail carried, all rows' pairs
+    # in lanes of one compression per level.
+    level = [cv.reshape(8, c, b)[:, i, :] for i in range(c)]  # C x (8, B)
+    zero = u32(0)
 
-    m = jnp.concatenate([left, right])
-    z = jnp.uint32(0)
-    return _compress_jax(
-        jnp.array(IV, dtype=jnp.uint32), m, z, jnp.uint32(BLOCK_LEN), jnp.uint32(flags_val)
-    )
+    def merge(pairs_l, pairs_r, flags_val):
+        ln = len(pairs_l)
+        left = jnp.concatenate(pairs_l, axis=-1)  # (8, ln*B)
+        right = jnp.concatenate(pairs_r, axis=-1)
+        m = jnp.concatenate([left, right], axis=0)  # (16, ln*B)
+        iv = jnp.tile(jnp.array(IV, dtype=u32)[:, None], (1, ln * b))
+        out = _compress_lanes(iv, m, zero, u32(BLOCK_LEN), u32(flags_val))
+        return [out[:, i * b : (i + 1) * b] for i in range(ln)]
+
+    while len(level) > 2:
+        nxt = merge(level[0:-1:2], level[1::2], PARENT)
+        if len(level) % 2:
+            nxt.append(level[-1])
+        level = nxt
+    (root,) = merge([level[0]], [level[1]], PARENT | ROOT)
+    return root.T  # (B, 8)
 
 
 @functools.lru_cache(maxsize=None)
 def _hash_fn(n_chunks: int):
     """Jitted (B, n_chunks*1024) u8 + (B,) i32 lengths -> (B, 8) u32."""
     import jax
-    import jax.numpy as jnp
 
-    def one(msg_u8, length):
-        u32 = jnp.uint32
-        words = msg_u8.reshape(n_chunks, BLOCKS_PER_CHUNK, BLOCK_LEN // 4, 4)
-        words = (
-            words[..., 0].astype(u32)
-            | (words[..., 1].astype(u32) << 8)
-            | (words[..., 2].astype(u32) << 16)
-            | (words[..., 3].astype(u32) << 24)
-        )  # (C, 16, 16) little-endian words
-        counters = jnp.arange(n_chunks, dtype=u32)
-        chunk_lens = jnp.clip(length - counters.astype(jnp.int32) * CHUNK_LEN, 0, CHUNK_LEN).astype(u32)
-        single = n_chunks == 1
-        cvs = jax.vmap(_chunk_cv_jax, in_axes=(0, 0, 0, None))(
-            words, counters, chunk_lens, jnp.bool_(single)
-        )  # (C, 8)
-        if single:
-            return cvs[0]
-        # Pairwise merge, odd tail carried (static unroll, log2 levels).
-        level = [cvs[i] for i in range(n_chunks)]
-        while len(level) > 2:
-            nxt = [
-                _parent_cv_jax(level[i], level[i + 1], PARENT)
-                for i in range(0, len(level) - 1, 2)
-            ]
-            if len(level) % 2:
-                nxt.append(level[-1])
-            level = nxt
-        return _parent_cv_jax(level[0], level[1], PARENT | ROOT)
-
-    return jax.jit(jax.vmap(one))
+    return jax.jit(functools.partial(hash_rows, n_chunks=n_chunks))
 
 
 def n_chunks_for(length: int) -> int:

@@ -1,0 +1,20 @@
+"""Multi-chip data-plane parallelism: meshes, sharded encode/scrub/repair.
+
+The scale axes of the reference are nodes in a TCP mesh (SURVEY.md §2.11);
+here the intra-host scale axis is a `jax.sharding.Mesh` over TPU chips:
+
+  dp  — batch of stripes/blocks, embarrassingly parallel
+  tp  — within a stripe: byte-columns for encode (GF matmul is per
+        byte-position), whole shards for hashing; XLA inserts the
+        all_to_all between the two layouts and psums for global stats
+
+No reference analogue — Garage's data plane is single-threaded-per-block
+CPU (src/block/manager.rs); this is the TPU-native replacement.
+"""
+
+from .mesh import (  # noqa: F401
+    data_plane_mesh,
+    make_put_step,
+    make_repair_step,
+    make_scrub_step,
+)

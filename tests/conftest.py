@@ -1,14 +1,20 @@
 """Force JAX onto a virtual 8-device CPU mesh for all tests.
 
 Multi-chip hardware is not available in CI; sharding tests run against
-xla_force_host_platform_device_count=8. Must run before jax is imported.
+xla_force_host_platform_device_count=8. The axon sitecustomize in this
+image force-registers a remote-TPU backend and overrides JAX_PLATFORMS,
+so an explicit config.update is required — env vars are not enough.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

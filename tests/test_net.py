@@ -1,0 +1,344 @@
+"""Transport layer tests: loopback mesh, TCP handshake, streams, ordering.
+
+Mirrors the reference's net test (src/net/test.rs:15-118 — 3-node mesh
+convergence) plus deterministic in-process coverage the reference lacks.
+"""
+
+import asyncio
+
+import pytest
+
+from garage_tpu.net import LocalNetwork, NetApp, PeeringManager
+from garage_tpu.net.message import PRIO_NORMAL
+from garage_tpu.net.stream import ByteStream
+from garage_tpu.net.peering import PeerConnState
+from garage_tpu.utils.error import RpcError
+
+NETID = b"test-cluster-secret"
+
+
+def run(coro, timeout=30.0):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def make_local_node(net: LocalNetwork) -> NetApp:
+    app = NetApp(NETID)
+    net.register(app)
+    return app
+
+
+def test_loopback_call_roundtrip():
+    async def main():
+        net = LocalNetwork()
+        a, b = make_local_node(net), make_local_node(net)
+
+        async def handler(from_node, payload, stream):
+            assert from_node == a.id
+            return {"echo": payload["x"] * 2}
+
+        b.endpoint("test/echo").set_handler(handler)
+        await a.try_connect(b.public_addr, b.id)
+        resp, _ = await a.endpoint("test/echo").call(b.id, {"x": 21}, PRIO_NORMAL, timeout=5)
+        assert resp == {"echo": 42}
+
+    run(main())
+
+
+def test_self_call_shortcircuits():
+    async def main():
+        net = LocalNetwork()
+        a = make_local_node(net)
+        a.endpoint("test/self").set_handler(lambda f, p, s: _async({"me": True}))
+        resp, _ = await a.endpoint("test/self").call(a.id, {}, PRIO_NORMAL)
+        assert resp == {"me": True}
+
+    run(main())
+
+
+async def _async(v):
+    return v
+
+
+def test_stream_attach_and_reply():
+    async def main():
+        net = LocalNetwork()
+        a, b = make_local_node(net), make_local_node(net)
+        body = bytes(range(256)) * 1000  # 256 KB, multiple chunks
+
+        async def handler(from_node, payload, stream):
+            data = await stream.read_all()
+            return {"len": len(data)}, ByteStream.from_bytes(data[::-1])
+
+        b.endpoint("test/stream").set_handler(handler)
+        await a.try_connect(b.public_addr, b.id)
+        resp, reply_stream = await a.endpoint("test/stream").call(
+            b.id, {}, PRIO_NORMAL, stream=ByteStream.from_bytes(body), timeout=10
+        )
+        assert resp == {"len": len(body)}
+        back = await reply_stream.read_all()
+        assert back == body[::-1]
+
+    run(main())
+
+
+def test_handler_error_propagates():
+    async def main():
+        net = LocalNetwork()
+        a, b = make_local_node(net), make_local_node(net)
+
+        async def handler(from_node, payload, stream):
+            raise ValueError("boom")
+
+        b.endpoint("test/err").set_handler(handler)
+        await a.try_connect(b.public_addr, b.id)
+        with pytest.raises(RpcError, match="boom"):
+            await a.endpoint("test/err").call(b.id, {}, PRIO_NORMAL, timeout=5)
+
+    run(main())
+
+
+def test_call_timeout_and_cancel():
+    async def main():
+        net = LocalNetwork()
+        a, b = make_local_node(net), make_local_node(net)
+        started = asyncio.Event()
+        cancelled = asyncio.Event()
+
+        async def handler(from_node, payload, stream):
+            started.set()
+            try:
+                await asyncio.sleep(60)
+            except asyncio.CancelledError:
+                cancelled.set()
+                raise
+
+        b.endpoint("test/slow").set_handler(handler)
+        await a.try_connect(b.public_addr, b.id)
+        with pytest.raises(asyncio.TimeoutError):
+            await a.endpoint("test/slow").call(b.id, {}, PRIO_NORMAL, timeout=0.2)
+        await asyncio.wait_for(started.wait(), 5)
+        # CANCEL frame must abort the remote handler
+        await asyncio.wait_for(cancelled.wait(), 5)
+
+    run(main())
+
+
+def test_ordered_dispatch():
+    async def main():
+        net = LocalNetwork()
+        a, b = make_local_node(net), make_local_node(net)
+        seen = []
+
+        async def handler(from_node, payload, stream):
+            seen.append(payload["seq"])
+            return {}
+
+        b.endpoint("test/ordered").set_handler(handler)
+        await a.try_connect(b.public_addr, b.id)
+        sid = 77
+        # fire seq 2, 1, 0 concurrently — handlers must run 0, 1, 2
+        await asyncio.gather(
+            *(
+                a.endpoint("test/ordered").call(
+                    b.id, {"seq": s}, PRIO_NORMAL, order=(sid, s), timeout=5
+                )
+                for s in (2, 1, 0)
+            )
+        )
+        assert seen == [0, 1, 2]
+
+    run(main())
+
+
+def test_three_node_mesh_convergence():
+    async def main():
+        net = LocalNetwork()
+        nodes = [make_local_node(net) for _ in range(3)]
+        # nodes 1 and 2 only know node 0's address
+        pms = []
+        for i, app in enumerate(nodes):
+            bootstrap = [] if i == 0 else [(nodes[0].public_addr, nodes[0].id)]
+            pm = PeeringManager(app, bootstrap, ping_interval=0.2, ping_timeout=1.0, retry_interval=0.2)
+            pms.append(pm)
+        tasks = [asyncio.create_task(pm.run()) for pm in pms]
+        try:
+            deadline = asyncio.get_event_loop().time() + 15
+            while asyncio.get_event_loop().time() < deadline:
+                if all(
+                    sum(
+                        1
+                        for p in pm.get_peer_list()
+                        if p.state == PeerConnState.CONNECTED
+                    )
+                    == 2
+                    for pm in pms
+                ):
+                    break
+                await asyncio.sleep(0.1)
+            for pm in pms:
+                connected = [p for p in pm.get_peer_list() if p.state == PeerConnState.CONNECTED]
+                assert len(connected) == 2, f"mesh did not converge: {pm.get_peer_list()}"
+        finally:
+            for pm in pms:
+                await pm.stop()
+            for t in tasks:
+                t.cancel()
+
+    run(main(), timeout=40)
+
+
+def test_failure_detection_and_reconnect():
+    async def main():
+        net = LocalNetwork()
+        a, b = make_local_node(net), make_local_node(net)
+        pma = PeeringManager(a, [(b.public_addr, b.id)], ping_interval=0.1, ping_timeout=0.3, retry_interval=0.3)
+        pmb = PeeringManager(b, [], ping_interval=0.1, ping_timeout=0.3, retry_interval=0.3)
+        tasks = [asyncio.create_task(pma.run()), asyncio.create_task(pmb.run())]
+        try:
+            await _wait_for(lambda: a.is_connected(b.id), 10)
+            net.partition(a.id, b.id)
+            await _wait_for(lambda: not a.is_connected(b.id), 10)
+            net.heal(a.id, b.id)
+            await _wait_for(lambda: a.is_connected(b.id), 15)
+        finally:
+            await pma.stop()
+            await pmb.stop()
+            for t in tasks:
+                t.cancel()
+
+    run(main(), timeout=45)
+
+
+async def _wait_for(cond, timeout):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if cond():
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError("condition not reached")
+
+
+def test_tcp_transport_end_to_end():
+    async def main():
+        a = NetApp(NETID, bind_addr=("127.0.0.1", 0))
+        b = NetApp(NETID, bind_addr=("127.0.0.1", 0))
+        await a.listen()
+        await b.listen()
+
+        async def handler(from_node, payload, stream):
+            extra = await stream.read_all() if stream else b""
+            return {"sum": payload["x"] + payload["y"], "extra": len(extra)}
+
+        b.endpoint("test/tcp").set_handler(handler)
+        try:
+            peer = await a.try_connect(b.bind_addr, b.id)
+            assert peer == b.id
+            resp, _ = await a.endpoint("test/tcp").call(
+                b.id, {"x": 1, "y": 2}, PRIO_NORMAL,
+                stream=ByteStream.from_bytes(b"z" * 100_000), timeout=10,
+            )
+            assert resp == {"sum": 3, "extra": 100_000}
+        finally:
+            await a.shutdown()
+            await b.shutdown()
+
+    run(main())
+
+
+def test_tcp_wrong_netid_rejected():
+    async def main():
+        a = NetApp(b"cluster-one", bind_addr=("127.0.0.1", 0))
+        b = NetApp(b"cluster-two", bind_addr=("127.0.0.1", 0))
+        await b.listen()
+        try:
+            with pytest.raises(Exception):
+                await a.try_connect(b.bind_addr, b.id)
+        finally:
+            await a.shutdown()
+            await b.shutdown()
+
+    run(main())
+
+
+def test_stream_flow_control_bounds_buffering():
+    """Receiver-side buffering must stay near STREAM_WINDOW even when the
+    consumer is much slower than the producer (credit-based flow ctl)."""
+    from garage_tpu.net.conn import STREAM_WINDOW
+
+    async def main():
+        net = LocalNetwork()
+        a, b = make_local_node(net), make_local_node(net)
+        high_water = 0
+        done = asyncio.Event()
+
+        async def handler(from_node, payload, stream):
+            nonlocal high_water
+            total = 0
+            while True:
+                await asyncio.sleep(0.001)  # slow consumer
+                high_water = max(high_water, stream._size)
+                chunk = await stream.read_chunk(1 << 16)
+                if not chunk:
+                    break
+                total += len(chunk)
+            done.set()
+            return {"total": total}
+
+        b.endpoint("test/flow").set_handler(handler)
+        await a.try_connect(b.public_addr, b.id)
+
+        async def producer():
+            s = ByteStream()
+
+            async def pump():
+                for _ in range(24):  # 24 MiB total, 6x the window
+                    await s.write(b"\x00" * (1 << 20))
+                s.push_eof()
+
+            asyncio.ensure_future(pump())
+            return s
+
+        src = await producer()
+        resp, _ = await a.endpoint("test/flow").call(
+            b.id, {}, PRIO_NORMAL, stream=src, timeout=60
+        )
+        assert resp == {"total": 24 << 20}
+        assert high_water <= STREAM_WINDOW + (1 << 20), (
+            f"receiver buffered {high_water} bytes, window is {STREAM_WINDOW}"
+        )
+
+    run(main(), timeout=90)
+
+
+def test_ordered_cancel_does_not_stall_stream():
+    """A cancelled seq must be tombstoned so later seqs still run."""
+
+    async def main():
+        net = LocalNetwork()
+        a, b = make_local_node(net), make_local_node(net)
+        release0 = asyncio.Event()
+        ran = []
+
+        async def handler(from_node, payload, stream):
+            if payload["seq"] == 0:
+                await release0.wait()
+            ran.append(payload["seq"])
+            return {}
+
+        b.endpoint("test/ocancel").set_handler(handler)
+        await a.try_connect(b.public_addr, b.id)
+        sid = 99
+        t0 = asyncio.ensure_future(
+            a.endpoint("test/ocancel").call(b.id, {"seq": 0}, PRIO_NORMAL, order=(sid, 0), timeout=30)
+        )
+        await asyncio.sleep(0.05)
+        # seq 1 times out while gated behind seq 0
+        with pytest.raises(asyncio.TimeoutError):
+            await a.endpoint("test/ocancel").call(b.id, {"seq": 1}, PRIO_NORMAL, order=(sid, 1), timeout=0.2)
+        release0.set()
+        await t0
+        # seq 2 must still be dispatched despite the dead seq 1
+        await a.endpoint("test/ocancel").call(b.id, {"seq": 2}, PRIO_NORMAL, order=(sid, 2), timeout=5)
+        assert 0 in ran and 2 in ran
+
+    run(main(), timeout=60)
