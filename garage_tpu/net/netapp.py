@@ -50,11 +50,16 @@ class _OrderedDispatch:
         st = self._streams.get(key)
         if st is None:
             st = self._streams[key] = {
-                "next": 0, "finished": set(), "ev": asyncio.Event(), "t": time.monotonic(),
+                "next": 0, "finished": set(), "ev": asyncio.Event(),
+                "t": time.monotonic(), "waiters": 0,
             }
-        while st["next"] < seq:
-            st["ev"].clear()
-            await st["ev"].wait()
+        st["waiters"] += 1
+        try:
+            while st["next"] < seq:
+                st["ev"].clear()
+                await st["ev"].wait()
+        finally:
+            st["waiters"] -= 1
         st["t"] = time.monotonic()
 
     def done(self, peer: bytes, stream_id: int, seq: int):
@@ -65,11 +70,18 @@ class _OrderedDispatch:
         while st["next"] in st["finished"]:
             st["finished"].discard(st["next"])
             st["next"] += 1
+        st["t"] = time.monotonic()
         st["ev"].set()
 
     def prune(self, max_age: float = 600.0):
+        # never prune a stream someone is gated on — deleting the entry
+        # would orphan the waiter's event and hang it forever
         cutoff = time.monotonic() - max_age
-        for key in [k for k, v in self._streams.items() if v["t"] < cutoff]:
+        for key in [
+            k
+            for k, v in self._streams.items()
+            if v["t"] < cutoff and v["waiters"] == 0
+        ]:
             del self._streams[key]
 
 
@@ -108,6 +120,8 @@ class NetApp:
     # ---- listen / connect ---------------------------------------------
 
     async def listen(self) -> None:
+        if self._server is not None:
+            return  # already listening (idempotent for composition roots)
         assert self.bind_addr is not None, "no bind_addr configured"
         host, port = self.bind_addr
         self._server = await asyncio.start_server(self._accept, host, port)

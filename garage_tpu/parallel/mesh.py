@@ -116,15 +116,25 @@ def make_scrub_step(mesh, k: int, m: int, shard_len: int):
     bytes_sh = _sh(mesh, "dp", None, "tp")
     shards_sh = _sh(mesh, "dp", "tp", None)
 
+    if shard_len % treehash.CHUNK_LEN:
+        raise ValueError(f"shard_len must be a multiple of {treehash.CHUNK_LEN}")
+
     def step(shards, expected_hashes):
         shards = jax.lax.with_sharding_constraint(shards, shards_sh)
         hashes = _hash_all_shards(shards, n_chunks)
         hash_bad = jnp.any(hashes != expected_hashes, axis=-1)  # (B, n)
         # parity re-derivation: contraction over k crosses the tp axis in
-        # shard-split layout; the reshard is XLA's to insert
+        # shard-split layout; the reshard is XLA's to insert.
+        # Only meaningful when every data shard hash-checks: recomputing
+        # parity from a corrupt data shard mismatches ALL stored parity
+        # rows, which would smear one bad data shard over m healthy
+        # parity shards and make the mask useless for repair planning.
         data = jax.lax.with_sharding_constraint(shards[:, :k, :], bytes_sh)
         parity2 = gf256.bit_matmul_apply(parity_bits, data)
-        parity_bad = jnp.any(parity2 != shards[:, k:, :], axis=-1)  # (B, m)
+        data_clean = ~jnp.any(hash_bad[:, :k], axis=1)  # (B,)
+        parity_bad = (
+            jnp.any(parity2 != shards[:, k:, :], axis=-1) & data_clean[:, None]
+        )  # (B, m)
         bad = hash_bad | jnp.concatenate(
             [jnp.zeros((shards.shape[0], k), dtype=bool), parity_bad], axis=1
         )
@@ -147,6 +157,8 @@ def make_repair_step(
     erasure mode decodes any k of n on device."""
     import jax
 
+    if shard_len % treehash.CHUNK_LEN:
+        raise ValueError(f"shard_len must be a multiple of {treehash.CHUNK_LEN}")
     n_chunks = shard_len // treehash.CHUNK_LEN
     mat_bits = gf256.bitmat_t_for(rs.repair_matrix(k, m, present, missing))
     bytes_sh = _sh(mesh, "dp", None, "tp")

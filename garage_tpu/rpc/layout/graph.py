@@ -1,0 +1,139 @@
+"""Flow-network algorithms for layout assignment.
+
+Ref parity: src/rpc/layout/graph_algo.rs:14-405, re-implemented from the
+textbook algorithms (Dinic blocking-flow max-flow; Bellman-Ford
+negative-cycle cancellation for min-cost refinement). Graphs are small —
+O(256 + 256*zones + nodes) vertices — so pure Python is plenty; this is
+operator-triggered control-plane work, not the data plane.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Hashable
+
+
+class FlowGraph:
+    """Integer-capacity flow network with optional per-edge costs."""
+
+    def __init__(self):
+        self.ids: dict[Hashable, int] = {}
+        self.adj: list[list[int]] = []  # vertex -> edge indices
+        self.to: list[int] = []
+        self.cap: list[int] = []
+        self.cost: list[int] = []
+
+    def vertex(self, key: Hashable) -> int:
+        v = self.ids.get(key)
+        if v is None:
+            v = self.ids[key] = len(self.adj)
+            self.adj.append([])
+        return v
+
+    def add_edge(self, u: Hashable, v: Hashable, cap: int, cost: int = 0) -> int:
+        """Returns the forward edge index; the reverse edge is index^1."""
+        ui, vi = self.vertex(u), self.vertex(v)
+        e = len(self.to)
+        self.to.extend([vi, ui])
+        self.cap.extend([cap, 0])
+        self.cost.extend([cost, -cost])
+        self.adj[ui].append(e)
+        self.adj[vi].append(e + 1)
+        return e
+
+    def flow_on(self, e: int) -> int:
+        """Units pushed over forward edge e (== residual of its twin)."""
+        return self.cap[e + 1] if e % 2 == 0 else self.cap[e]
+
+    # ---- Dinic max-flow ------------------------------------------------
+
+    def max_flow(self, s: Hashable, t: Hashable) -> int:
+        si, ti = self.vertex(s), self.vertex(t)
+        total = 0
+        n = len(self.adj)
+        while True:
+            level = [-1] * n
+            level[si] = 0
+            q = deque([si])
+            while q:
+                u = q.popleft()
+                for e in self.adj[u]:
+                    v = self.to[e]
+                    if self.cap[e] > 0 and level[v] < 0:
+                        level[v] = level[u] + 1
+                        q.append(v)
+            if level[ti] < 0:
+                return total
+            it = [0] * n
+
+            def dfs(u: int, pushed: int) -> int:
+                if u == ti:
+                    return pushed
+                while it[u] < len(self.adj[u]):
+                    e = self.adj[u][it[u]]
+                    v = self.to[e]
+                    if self.cap[e] > 0 and level[v] == level[u] + 1:
+                        got = dfs(v, min(pushed, self.cap[e]))
+                        if got > 0:
+                            self.cap[e] -= got
+                            self.cap[e ^ 1] += got
+                            return got
+                    it[u] += 1
+                return 0
+
+            while True:
+                pushed = dfs(si, 1 << 62)
+                if pushed == 0:
+                    break
+                total += pushed
+
+    # ---- negative-cycle cancellation ----------------------------------
+
+    def cancel_negative_cycles(self) -> int:
+        """Repeatedly find a negative-cost cycle in the residual graph and
+        push one unit around it. Returns total cost reduction. Terminates
+        because each pass strictly reduces the (integer) total cost."""
+        reduced = 0
+        while True:
+            cyc = self._find_negative_cycle()
+            if cyc is None:
+                return reduced
+            push = min(self.cap[e] for e in cyc)
+            for e in cyc:
+                self.cap[e] -= push
+                self.cap[e ^ 1] += push
+            reduced += -sum(self.cost[e] for e in cyc) * push
+
+    def _find_negative_cycle(self):
+        """Bellman-Ford over residual edges; returns edge list of a
+        negative cycle or None."""
+        n = len(self.adj)
+        dist = [0] * n  # virtual super-source: all zeros
+        pred_edge = [-1] * n
+        x = -1
+        for _ in range(n):
+            x = -1
+            for e in range(len(self.to)):
+                if self.cap[e] <= 0:
+                    continue
+                u = self.to[e ^ 1]
+                v = self.to[e]
+                if dist[u] + self.cost[e] < dist[v]:
+                    dist[v] = dist[u] + self.cost[e]
+                    pred_edge[v] = e
+                    x = v
+            if x == -1:
+                return None
+        # x is on or reachable from a negative cycle; walk back n steps
+        for _ in range(n):
+            x = self.to[pred_edge[x] ^ 1]
+        cyc = []
+        v = x
+        while True:
+            e = pred_edge[v]
+            cyc.append(e)
+            v = self.to[e ^ 1]
+            if v == x:
+                break
+        cyc.reverse()
+        return cyc

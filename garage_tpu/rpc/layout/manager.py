@@ -1,0 +1,126 @@
+"""LayoutManager: persistence, gossip merge, broadcast, pull sync.
+
+Ref parity: src/rpc/layout/manager.rs:21-381. Owns the LayoutHistory
+CRDT: merges advertisements from peers (re-broadcasting on change),
+serves pulls, persists every change, and exposes the LayoutHelper to
+the table/block layers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Callable, Optional
+
+from ...utils.migrate import decode as migrate_decode, encode as migrate_encode
+from ...utils.persister import Persister
+from ..replication_mode import ReplicationMode
+from .helper import LayoutHelper
+from .history import LayoutHistory
+
+log = logging.getLogger("garage_tpu.rpc.layout")
+
+
+class LayoutManager:
+    def __init__(
+        self,
+        netapp,
+        meta_dir: str,
+        replication: ReplicationMode,
+    ):
+        self.netapp = netapp
+        self.replication = replication
+        self.persister: Persister = Persister(meta_dir, "cluster_layout", LayoutHistory)
+        history = self.persister.load()
+        if history is None:
+            history = LayoutHistory.new(replication.factor)
+        elif history.replication_factor != replication.factor:
+            raise RuntimeError(
+                f"persisted layout has replication_factor "
+                f"{history.replication_factor}, config says {replication.factor}"
+            )
+        self.helper = LayoutHelper(history, netapp.id)
+        self.ep = netapp.endpoint("garage_rpc/layout").set_handler(self._handle)
+        self.on_change: list[Callable[[], None]] = []  # table syncers hook in
+
+    @property
+    def history(self) -> LayoutHistory:
+        return self.helper.history
+
+    def digest(self) -> bytes:
+        return self.history.digest()
+
+    # ---- local updates -------------------------------------------------
+
+    def save(self) -> None:
+        self.persister.save(self.history)
+
+    def _changed(self) -> None:
+        self.save()
+        for cb in self.on_change:
+            try:
+                cb()
+            except Exception:
+                log.exception("layout on_change callback failed")
+        asyncio.ensure_future(self.broadcast())
+
+    def merge_remote(self, raw: bytes) -> bool:
+        remote = migrate_decode(LayoutHistory, raw)
+        changed = self.history.merge(remote)
+        # seeing a newer version may allow our own trackers to move
+        self.helper.advance_ack()
+        self.helper.advance_sync_ack()
+        if changed:
+            self._changed()
+        return changed
+
+    def apply_staged(self, version: Optional[int] = None) -> None:
+        self.history.apply_staged_changes(version)
+        self.helper.advance_ack()
+        self._changed()
+
+    def revert_staged(self) -> None:
+        self.history.revert_staged_changes()
+        self._changed()
+
+    def sync_table_until(self, version: int) -> None:
+        """Called by syncers when all data for layout `version` is in
+        place locally (ref: manager.rs:120-133)."""
+        if self.helper.sync_until(version):
+            self.helper.advance_sync_ack()
+            if self.history.cleanup_old_versions():
+                pass
+            self._changed()
+
+    # ---- gossip --------------------------------------------------------
+
+    async def broadcast(self) -> None:
+        raw = migrate_encode(self.history)
+        peers = [p for p in self.netapp.conns.keys()]
+        await asyncio.gather(
+            *(self._advertise_one(p, raw) for p in peers), return_exceptions=True
+        )
+
+    async def _advertise_one(self, node: bytes, raw: bytes) -> None:
+        try:
+            await self.ep.call(node, {"op": "advertise", "layout": raw}, 0x20, timeout=10.0)
+        except Exception as e:
+            log.debug("layout advertise to %s failed: %s", node[:4].hex(), e)
+
+    async def pull_from(self, node: bytes) -> bool:
+        try:
+            resp, _ = await self.ep.call(node, {"op": "pull"}, 0x20, timeout=10.0)
+            if resp and resp.get("layout"):
+                return self.merge_remote(resp["layout"])
+        except Exception as e:
+            log.debug("layout pull from %s failed: %s", node[:4].hex(), e)
+        return False
+
+    async def _handle(self, from_node, payload, stream):
+        op = payload.get("op")
+        if op == "pull":
+            return {"layout": migrate_encode(self.history)}
+        if op == "advertise":
+            changed = self.merge_remote(payload["layout"])
+            return {"changed": changed}
+        raise ValueError(f"unknown layout op {op}")

@@ -1,0 +1,253 @@
+"""RpcHelper: quorum call orchestration.
+
+Ref parity: src/rpc/rpc_helper.rs:160-766. The transport-agnostic quorum
+engine:
+
+- `call`: one node, with timeout + metrics.
+- `try_call_many`: N nodes, return at `quorum` successes. Adaptive send:
+  issue only `quorum` requests first (preferring self/same-zone/low-ping
+  nodes), adding replacements as errors come in; or all at once.
+- `try_write_many_sets`: write to multiple quorum sets during layout
+  transitions; succeeds when EVERY set reaches its write quorum;
+  remaining requests continue in the background.
+- `QuorumSetResultTracker`: the bookkeeping shared by both.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..net.message import PRIO_NORMAL
+from ..utils.error import QuorumError, RpcError
+from .system import System
+
+
+def _consume_task_result(t: asyncio.Task) -> None:
+    if t.cancelled():
+        return
+    e = t.exception()
+    if e is not None:
+        logging.getLogger(__name__).debug("straggler rpc failed: %s", e)
+
+log = logging.getLogger("garage_tpu.rpc.helper")
+
+DEFAULT_TIMEOUT = 30.0
+
+
+@dataclass
+class RequestStrategy:
+    """ref: rpc_helper.rs RequestStrategy."""
+
+    quorum: int = 1
+    prio: int = PRIO_NORMAL
+    timeout: float = DEFAULT_TIMEOUT
+    send_all_at_once: bool = False
+    interrupt_stragglers: bool = True  # reads cancel; writes let them finish
+
+
+class QuorumSetResultTracker:
+    """Per-set success/failure accounting over possibly-overlapping quorum
+    sets (ref: rpc_helper.rs:665-766)."""
+
+    def __init__(self, sets: list[list[bytes]], quorum: int):
+        self.sets = sets
+        self.quorum = quorum
+        self.nodes: list[bytes] = []
+        seen = set()
+        for s in sets:
+            for n in s:
+                if n not in seen:
+                    seen.add(n)
+                    self.nodes.append(n)
+        self.successes: dict[bytes, Any] = {}
+        self.failures: dict[bytes, Exception] = {}
+
+    def success(self, node: bytes, resp) -> None:
+        self.successes[node] = resp
+
+    def failure(self, node: bytes, err: Exception) -> None:
+        self.failures[node] = err
+
+    def set_counts(self) -> list[tuple[int, int]]:
+        """(successes, failures) per set."""
+        return [
+            (
+                sum(1 for n in s if n in self.successes),
+                sum(1 for n in s if n in self.failures),
+            )
+            for s in self.sets
+        ]
+
+    def all_quorums_ok(self) -> bool:
+        return all(ok >= self.quorum for ok, _ in self.set_counts())
+
+    def too_many_failures(self) -> bool:
+        return any(
+            fail > len(s) - self.quorum
+            for s, (_, fail) in zip(self.sets, self.set_counts())
+        )
+
+    def quorum_error(self) -> QuorumError:
+        return QuorumError(
+            quorum=self.quorum,
+            sets=len(self.sets),
+            ok=len(self.successes),
+            total=len(self.nodes),
+            errors=[str(e) for e in self.failures.values()],
+        )
+
+
+class RpcHelper:
+    def __init__(self, system: System):
+        self.system = system
+        self.netapp = system.netapp
+
+    # ---- node ordering (ref: rpc_helper.rs:621-660) --------------------
+
+    def request_order(self, nodes: list[bytes]) -> list[bytes]:
+        """self first, then same-zone, then by ping."""
+        my_zone = None
+        role = self.system.layout_helper.current().node_role(self.netapp.id)
+        if role is not None:
+            my_zone = role.zone
+
+        def key(n: bytes):
+            if n == self.netapp.id:
+                return (0, 0.0)
+            role = self.system.layout_helper.current().node_role(n)
+            same_zone = role is not None and my_zone is not None and role.zone == my_zone
+            ping = self.system.peering.ping_avg(n)
+            connected = self.system.is_up(n)
+            return (
+                1 if (same_zone and connected) else (2 if connected else 3),
+                ping if ping is not None else 1.0,
+            )
+
+        return sorted(nodes, key=key)
+
+    # ---- single call ---------------------------------------------------
+
+    async def call(
+        self,
+        endpoint,
+        node: bytes,
+        payload,
+        prio: int = PRIO_NORMAL,
+        timeout: float = DEFAULT_TIMEOUT,
+        stream=None,
+    ):
+        resp, rstream = await endpoint.call(
+            node, payload, prio, stream=stream, timeout=timeout
+        )
+        return (resp, rstream) if rstream is not None else resp
+
+    # ---- try_call_many (ref: rpc_helper.rs:290-411) --------------------
+
+    async def try_call_many(
+        self,
+        endpoint,
+        nodes: list[bytes],
+        payload,
+        strategy: RequestStrategy,
+        make_payload: Optional[Callable[[bytes], Any]] = None,
+    ) -> list:
+        """Returns >= quorum successful responses or raises QuorumError."""
+        quorum = strategy.quorum
+        if quorum > len(nodes):
+            raise QuorumError(quorum, 1, 0, len(nodes), ["not enough nodes"])
+        order = self.request_order(list(nodes))
+        successes: list = []
+        errors: list[Exception] = []
+        pending: dict[asyncio.Task, bytes] = {}
+        next_i = 0
+
+        def launch_one():
+            nonlocal next_i
+            node = order[next_i]
+            next_i += 1
+            pl = make_payload(node) if make_payload else payload
+            t = asyncio.create_task(
+                endpoint.call(node, pl, strategy.prio, timeout=strategy.timeout)
+            )
+            pending[t] = node
+
+        n_initial = len(order) if strategy.send_all_at_once else min(quorum, len(order))
+        for _ in range(n_initial):
+            launch_one()
+        try:
+            while len(successes) < quorum:
+                if not pending:
+                    raise QuorumError(
+                        quorum, 1, len(successes), len(nodes), [str(e) for e in errors]
+                    )
+                done, _ = await asyncio.wait(
+                    pending.keys(), return_when=asyncio.FIRST_COMPLETED
+                )
+                for t in done:
+                    node = pending.pop(t)
+                    try:
+                        resp, _stream = t.result()
+                        successes.append((node, resp))
+                    except Exception as e:
+                        errors.append(e)
+                        if next_i < len(order):
+                            launch_one()
+            return [r for _, r in successes]
+        finally:
+            for t in pending:
+                if strategy.interrupt_stragglers:
+                    t.cancel()
+                else:
+                    # left running so replicas converge; swallow the result
+                    # so a late failure doesn't log "never retrieved"
+                    t.add_done_callback(_consume_task_result)
+
+    # ---- try_write_many_sets (ref: rpc_helper.rs:413-538) --------------
+
+    async def try_write_many_sets(
+        self,
+        endpoint,
+        write_sets: list[list[bytes]],
+        payload,
+        strategy: RequestStrategy,
+        make_payload: Optional[Callable[[bytes], Any]] = None,
+        make_stream: Optional[Callable[[bytes], Any]] = None,
+    ) -> QuorumSetResultTracker:
+        """Write to every set with per-set quorum; left-over requests keep
+        running in the background after success (so all replicas converge
+        without blocking the caller)."""
+        tracker = QuorumSetResultTracker(write_sets, strategy.quorum)
+        if not tracker.nodes:
+            # empty/unassigned layout: fail fast instead of hanging on a
+            # future no task will ever resolve
+            raise tracker.quorum_error()
+        result = asyncio.get_event_loop().create_future()
+
+        async def one(node: bytes):
+            try:
+                pl = make_payload(node) if make_payload else payload
+                st = make_stream(node) if make_stream else None
+                resp, _ = await endpoint.call(
+                    node, pl, strategy.prio, stream=st, timeout=strategy.timeout
+                )
+                tracker.success(node, resp)
+            except Exception as e:
+                tracker.failure(node, e)
+            if not result.done():
+                if tracker.all_quorums_ok():
+                    result.set_result(True)
+                elif tracker.too_many_failures():
+                    result.set_exception(tracker.quorum_error())
+
+        tasks = [asyncio.create_task(one(n)) for n in tracker.nodes]
+        try:
+            await result
+            return tracker
+        except BaseException:
+            for t in tasks:
+                t.cancel()
+            raise
+        # on success, remaining tasks continue in background by design

@@ -38,7 +38,14 @@ def merge_auto(a: Any, b: Any) -> Any:
     """
     if isinstance(a, Crdt):
         return a.merge(b)
-    return max(a, b)
+    if a == b:
+        return a
+    try:
+        return max(a, b)
+    except TypeError:
+        # unordered values (dicts, mixed types): deterministic tie-break
+        # so merge stays commutative
+        return max(a, b, key=repr)
 
 
 def now_msec() -> int:

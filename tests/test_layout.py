@@ -1,0 +1,180 @@
+"""Layout tests: assignment optimality, movement, CRDT convergence.
+
+Mirrors the reference's layout tests (src/rpc/layout/test.rs:120
+check_against_naive + staged-update merge convergence).
+"""
+
+import os
+
+from garage_tpu.rpc.layout import (
+    LayoutHistory,
+    LayoutVersion,
+    N_PARTITIONS,
+    NodeRole,
+)
+from garage_tpu.rpc.layout.assign import compute_assignment
+from garage_tpu.utils import crdt, migrate
+
+
+def nid(i: int) -> bytes:
+    return bytes([i]) * 32
+
+
+def mkroles(spec):
+    """spec: {node_id: (zone, capacity)}"""
+    m = crdt.LwwMap()
+    for node, (zone, cap) in spec.items():
+        m = m.insert(node, NodeRole(zone=zone, capacity=cap))
+    return m
+
+
+def naive_partition_size(spec, rf):
+    """Greedy baseline: repeatedly give the next replica slot to the
+    storage node with the most remaining per-slot capacity, ignoring
+    zones. Returns min over nodes of capacity/slots — what an unoptimized
+    assignment would achieve."""
+    caps = {n: c for n, (z, c) in spec.items() if c is not None}
+    slots = {n: 0 for n in caps}
+    for _ in range(N_PARTITIONS * rf):
+        best = max(caps, key=lambda n: caps[n] / (slots[n] + 1))
+        slots[best] += 1
+    return min(caps[n] // slots[n] for n in caps if slots[n] > 0)
+
+
+def check_optimal(spec, rf, zone_redundancy="maximum"):
+    roles = list(mkroles(spec).items())
+    node_id_vec, ring, size = compute_assignment(roles, rf, zone_redundancy)
+    # structural invariants
+    assert len(ring) == N_PARTITIONS * rf
+    lv = LayoutVersion(1, rf, zone_redundancy, mkroles(spec), node_id_vec, ring, size)
+    zones = {n: z for n, (z, c) in spec.items()}
+    n_zones = len({z for z, c in spec.values() if c is not None})
+    zr = min(rf, n_zones) if zone_redundancy == "maximum" else zone_redundancy
+    for p in range(N_PARTITIONS):
+        nodes = lv.nodes_of(p)
+        assert len(set(nodes)) == rf, f"partition {p} has dup nodes"
+        assert len({zones[n] for n in nodes}) >= zr, f"partition {p} zone redundancy"
+    # load respects capacity at the claimed partition size
+    counts = {}
+    for b in ring:
+        counts[node_id_vec[b]] = counts.get(node_id_vec[b], 0) + 1
+    for n, cnt in counts.items():
+        assert cnt * size <= spec[n][1], f"node overloaded: {cnt} x {size}"
+    return size
+
+
+def test_assignment_optimal_beats_naive_uniform():
+    spec = {nid(i): ("z1", 1 << 30) for i in range(4)}
+    size = check_optimal(spec, 3)
+    assert size >= naive_partition_size(spec, 3)
+
+
+def test_assignment_optimal_beats_naive_heterogeneous():
+    spec = {
+        nid(1): ("z1", 4 << 30),
+        nid(2): ("z1", 2 << 30),
+        nid(3): ("z2", 1 << 30),
+        nid(4): ("z2", 4 << 30),
+        nid(5): ("z3", 2 << 30),
+    }
+    size = check_optimal(spec, 3)
+    # naive ignores zones, so the comparison is only meaningful as a
+    # lower bound sanity check when zones don't bind; still assert we're
+    # within a sane range of total/target
+    assert size > 0
+
+
+def test_assignment_three_zones_redundancy():
+    spec = {
+        nid(1): ("dc1", 1 << 30),
+        nid(2): ("dc1", 1 << 30),
+        nid(3): ("dc2", 1 << 30),
+        nid(4): ("dc2", 1 << 30),
+        nid(5): ("dc3", 1 << 30),
+        nid(6): ("dc3", 1 << 30),
+    }
+    check_optimal(spec, 3, zone_redundancy=3)
+
+
+def test_assignment_single_node_rf1():
+    spec = {nid(1): ("dc1", 1 << 30)}
+    size = check_optimal(spec, 1)
+    assert size >= (1 << 30) // N_PARTITIONS
+
+
+def test_movement_minimization():
+    spec3 = {nid(i): ("z1", 1 << 30) for i in (1, 2, 3)}
+    roles3 = mkroles(spec3)
+    vec3, ring3, size3 = compute_assignment(list(roles3.items()), 3, "maximum")
+    prev = LayoutVersion(1, 3, "maximum", roles3, vec3, ring3, size3)
+
+    spec4 = dict(spec3)
+    spec4[nid(4)] = ("z1", 1 << 30)
+    roles4 = mkroles(spec4)
+    vec4, ring4, size4 = compute_assignment(list(roles4.items()), 3, "maximum", prev=prev)
+    new = LayoutVersion(2, 3, "maximum", roles4, vec4, ring4, size4)
+
+    retained = sum(
+        len(set(prev.nodes_of(p)) & set(new.nodes_of(p))) for p in range(N_PARTITIONS)
+    )
+    total = N_PARTITIONS * 3
+    # optimal move: new node takes 1/4 of slots -> 75% retained; allow slack
+    assert retained / total >= 0.70, f"only {retained}/{total} replica slots kept"
+    # and the new node must actually carry ~1/4 of the data
+    cnt4 = sum(1 for b in ring4 if vec4[b] == nid(4))
+    assert cnt4 >= total // 8
+
+
+def test_history_staging_and_apply(tmp_path):
+    h = LayoutHistory.new(3)
+    for i in (1, 2, 3):
+        h.stage_role(nid(i), NodeRole(zone=f"z{i}", capacity=1 << 30))
+    h.apply_staged_changes()
+    assert h.current().version == 1
+    assert len(h.current().ring_assignment_data) == N_PARTITIONS * 3
+    # round-trip through the versioned encoding
+    data = migrate.encode(h)
+    h2 = migrate.decode(LayoutHistory, data)
+    assert h2.current().version == 1
+    assert h2.current().nodes_of(0) == h.current().nodes_of(0)
+
+
+def test_history_crdt_merge_convergence():
+    """Two operators stage different roles concurrently; both merge to the
+    same state regardless of order (ref: layout/test.rs CRDT checks)."""
+    base = LayoutHistory.new(3)
+    for i in (1, 2, 3):
+        base.stage_role(nid(i), NodeRole(zone="z", capacity=1 << 30))
+    base.apply_staged_changes()
+    raw = migrate.encode(base)
+
+    a = migrate.decode(LayoutHistory, raw)
+    b = migrate.decode(LayoutHistory, raw)
+    a.stage_role(nid(4), NodeRole(zone="z", capacity=2 << 30))
+    b.stage_role(nid(5), NodeRole(zone="z", capacity=3 << 30))
+
+    ab = migrate.decode(LayoutHistory, migrate.encode(a))
+    ab.merge(b)
+    ba = migrate.decode(LayoutHistory, migrate.encode(b))
+    ba.merge(a)
+    assert migrate.encode(ab) == migrate.encode(ba)
+    # apply on the merged state sees both staged roles
+    ab.apply_staged_changes()
+    assert nid(4) in ab.current().storage_nodes()
+    assert nid(5) in ab.current().storage_nodes()
+
+
+def test_tracker_gc_of_old_versions():
+    h = LayoutHistory.new(1)
+    h.stage_role(nid(1), NodeRole(zone="z", capacity=1 << 30))
+    h.apply_staged_changes()
+    h.stage_role(nid(2), NodeRole(zone="z", capacity=1 << 30))
+    h.apply_staged_changes()
+    assert [v.version for v in h.versions] == [0, 1, 2]
+    for n in (nid(1), nid(2)):
+        h.update_trackers.set_max("ack", n, 2)
+        h.update_trackers.set_max("sync", n, 2)
+        h.update_trackers.set_max("sync_ack", n, 2)
+    h.cleanup_old_versions()
+    assert h.min_stored() == 2
+    assert [v.version for v in h.old_versions] == [0, 1]

@@ -1,0 +1,231 @@
+"""System membership + quorum engine tests over the loopback network."""
+
+import asyncio
+
+import pytest
+
+from garage_tpu.net import LocalNetwork, NetApp
+from garage_tpu.net.message import PRIO_NORMAL
+from garage_tpu.rpc import ReplicationMode, RpcHelper, RequestStrategy, System
+from garage_tpu.rpc.layout import NodeRole
+from garage_tpu.rpc.rpc_helper import QuorumSetResultTracker
+from garage_tpu.rpc.system import ClusterHealthStatus
+from garage_tpu.utils.error import QuorumError
+
+NETID = b"rpc-test"
+
+
+def run(coro, timeout=60.0):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+async def make_cluster(tmp_path, n, rf=3, connect=True):
+    net = LocalNetwork()
+    systems = []
+    for i in range(n):
+        app = NetApp(NETID)
+        net.register(app)
+        meta = str(tmp_path / f"node{i}")
+        sys_ = System(
+            app,
+            ReplicationMode.parse(rf),
+            meta,
+            status_interval=0.2,
+            ping_interval=0.2,
+        )
+        systems.append(sys_)
+    tasks = [asyncio.create_task(s.run()) for s in systems]
+    if connect:
+        for s in systems[1:]:
+            await s.netapp.try_connect(systems[0].netapp.public_addr, systems[0].id)
+            s.peering.add_peer(systems[0].netapp.public_addr, systems[0].id)
+        # let the mesh converge via peer exchange
+        await _wait(lambda: all(len(s.netapp.conns) == n - 1 for s in systems), 15)
+    return net, systems, tasks
+
+
+async def _wait(cond, timeout):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if cond():
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError("condition not reached")
+
+
+async def stop_cluster(systems, tasks):
+    for s in systems:
+        await s.stop()
+    for t in tasks:
+        t.cancel()
+
+
+def apply_flat_layout(systems, rf=3):
+    """Stage all nodes with equal capacity on node 0 and apply."""
+    lm = systems[0].layout_manager
+    for s in systems:
+        lm.history.stage_role(s.id, NodeRole(zone="z1", capacity=1 << 30))
+    lm.apply_staged(None)
+
+
+def test_layout_gossip_convergence(tmp_path):
+    async def main():
+        net, systems, tasks = await make_cluster(tmp_path, 3)
+        try:
+            apply_flat_layout(systems)
+            await _wait(
+                lambda: all(
+                    s.layout_manager.history.current().version == 1 for s in systems
+                ),
+                10,
+            )
+            # ring identical everywhere
+            rings = {s.layout_manager.history.current().ring_assignment_data for s in systems}
+            assert len(rings) == 1
+        finally:
+            await stop_cluster(systems, tasks)
+
+    run(main())
+
+
+def test_cluster_health(tmp_path):
+    async def main():
+        net, systems, tasks = await make_cluster(tmp_path, 3)
+        try:
+            apply_flat_layout(systems)
+            await _wait(
+                lambda: all(
+                    s.layout_manager.history.current().version == 1 for s in systems
+                ),
+                10,
+            )
+            h = systems[0].health()
+            assert h.status == ClusterHealthStatus.HEALTHY
+            assert h.storage_nodes == 3 and h.storage_nodes_up == 3
+            # partition a node: health degrades (writes still have quorum 2/3)
+            net.partition(systems[0].id, systems[2].id)
+            net.partition(systems[1].id, systems[2].id)
+            await _wait(lambda: not systems[0].is_up(systems[2].id), 15)
+            h = systems[0].health()
+            assert h.status == ClusterHealthStatus.DEGRADED
+            assert h.storage_nodes_up == 2
+        finally:
+            await stop_cluster(systems, tasks)
+
+    run(main())
+
+
+def test_try_call_many_quorum(tmp_path):
+    async def main():
+        net, systems, tasks = await make_cluster(tmp_path, 3)
+        try:
+            apply_flat_layout(systems)
+            calls = []
+            for s in systems:
+                def mk(s=s):
+                    async def h(frm, payload, stream):
+                        calls.append(s.id)
+                        if payload.get("fail") == s.id:
+                            raise ValueError("injected failure")
+                        return {"node": s.id}
+                    return h
+                s.netapp.endpoint("test/q").set_handler(mk())
+            helper = RpcHelper(systems[0])
+            ep = systems[0].netapp.endpoint("test/q")
+            nodes = [s.id for s in systems]
+
+            # quorum 2 of 3, all healthy: adaptive send reaches quorum
+            rs = RequestStrategy(quorum=2, timeout=5)
+            resp = await helper.try_call_many(ep, nodes, {}, rs)
+            assert len(resp) == 2
+
+            # one node failing: replacement request still reaches quorum
+            resp = await helper.try_call_many(ep, nodes, {"fail": systems[0].id}, rs)
+            assert len(resp) == 2
+
+            # quorum 3 with one failing: QuorumError
+            rs3 = RequestStrategy(quorum=3, timeout=5)
+            with pytest.raises(QuorumError):
+                await helper.try_call_many(ep, nodes, {"fail": systems[1].id}, rs3)
+        finally:
+            await stop_cluster(systems, tasks)
+
+    run(main())
+
+
+def test_try_write_many_sets(tmp_path):
+    async def main():
+        net, systems, tasks = await make_cluster(tmp_path, 3)
+        try:
+            seen = []
+            for s in systems:
+                def mk(s=s):
+                    async def h(frm, payload, stream):
+                        seen.append(s.id)
+                        if payload.get("fail") == s.id:
+                            raise ValueError("nope")
+                        return {}
+                    return h
+                s.netapp.endpoint("test/w").set_handler(mk())
+            helper = RpcHelper(systems[0])
+            ep = systems[0].netapp.endpoint("test/w")
+            ids = [s.id for s in systems]
+            # two overlapping sets (layout transition shape)
+            sets = [[ids[0], ids[1]], [ids[1], ids[2]]]
+            rs = RequestStrategy(quorum=2, timeout=5)
+            tracker = await helper.try_write_many_sets(ep, sets, {}, rs)
+            assert tracker.all_quorums_ok()
+
+            # failure of a node breaks only quorum-2 of both sets
+            with pytest.raises(QuorumError):
+                await helper.try_write_many_sets(ep, sets, {"fail": ids[1]}, rs)
+        finally:
+            await stop_cluster(systems, tasks)
+
+    run(main())
+
+
+def test_quorum_set_tracker_counts():
+    a, b, c = b"a" * 32, b"b" * 32, b"c" * 32
+    t = QuorumSetResultTracker([[a, b], [b, c]], 2)
+    assert t.nodes == [a, b, c]
+    t.success(a, {})
+    t.success(b, {})
+    assert not t.all_quorums_ok()
+    t.failure(c, RuntimeError("x"))
+    assert t.too_many_failures()
+    err = t.quorum_error()
+    assert err.quorum == 2 and err.ok == 2
+
+
+def test_peer_list_persisted_across_restart(tmp_path):
+    async def main():
+        net, systems, tasks = await make_cluster(tmp_path, 2)
+        try:
+            await _wait(
+                lambda: all(len(s.netapp.conns) == 1 for s in systems), 10
+            )
+            await systems[0]._advertise_status()
+        finally:
+            await stop_cluster(systems, tasks)
+        # restart node 0 with no bootstrap: must reconnect from persisted list
+        app = NetApp(NETID)
+        net.register(app)
+        meta = str(tmp_path / "node0")
+        s0 = System(app, ReplicationMode.parse(3), meta, status_interval=0.2, ping_interval=0.2)
+        assert any(p.addr is not None for p in s0.peering.peers.values() if p.id != s0.id)
+
+    run(main())
+
+
+def test_quorums_by_consistency_mode():
+    # write quorum always derives from the CONSISTENT read quorum so that
+    # degraded mode relaxes reads without inflating writes
+    # (ref: src/rpc/replication_mode.rs:45-59)
+    for n, r, w in [(1, 1, 1), (2, 2, 1), (3, 2, 2), (5, 3, 3)]:
+        m = ReplicationMode.parse(n)
+        assert (m.read_quorum, m.write_quorum) == (r, w)
+        deg = ReplicationMode.parse(n, consistency_mode="degraded")
+        assert (deg.read_quorum, deg.write_quorum) == (1, w)
+        dang = ReplicationMode.parse(n, consistency_mode="dangerous")
+        assert (dang.read_quorum, dang.write_quorum) == (1, 1)
