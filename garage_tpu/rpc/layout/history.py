@@ -152,7 +152,15 @@ class LayoutHistory(Migratable):
         return self.all_storage_nodes()
 
     def digest(self) -> bytes:
-        return blake2sum(migrate_encode(self))
+        """Digest for gossip comparison. Excludes old_versions: they are
+        node-local bookkeeping (never merged), so including them would
+        make digests permanently diverge between nodes and re-send the
+        full layout on every status exchange."""
+        import msgpack
+
+        o = self.pack()
+        del o["old"]
+        return blake2sum(msgpack.packb(o, use_bin_type=True))
 
     # ---- staging -------------------------------------------------------
 
@@ -233,12 +241,23 @@ class LayoutHistory(Migratable):
         return changed
 
     def cleanup_old_versions(self) -> bool:
-        """Drop versions fully sync-acked by every storage node
-        (ref: history.rs:79)."""
+        """Drop versions fully sync-acked by every storage node; leading
+        invalid versions (no storage nodes, e.g. the empty bootstrap v0)
+        go as soon as a valid one exists (ref: history.rs:79-115)."""
         changed = False
+        if self.current().storage_nodes():
+            # invalid leading versions (no storage nodes) are discarded
+            # outright, not archived — they hold no data anyone reads
+            # (ref: history.rs:80-89)
+            while len(self.versions) > 1 and not self.versions[0].storage_nodes():
+                self.versions.pop(0)
+                changed = True
         while len(self.versions) > 1:
             v = self.versions[0].version
-            nodes = self.all_storage_nodes()
+            # only the CURRENT version's nodes gate GC: nodes removed by a
+            # newer layout are being discarded and must not pin old
+            # versions forever (ref: history.rs:94-108 ASSUMPTION)
+            nodes = self.current().storage_nodes()
             min_sync_ack = UpdateTrackers.min_among(
                 self.update_trackers.sync_ack, nodes, self.min_stored()
             )

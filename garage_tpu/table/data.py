@@ -1,0 +1,194 @@
+"""TableData: node-local table storage with CRDT merge-on-write.
+
+Ref parity: src/table/data.rs. Every mutation happens inside a db
+transaction: decode incoming entry, merge with what's stored, run the
+schema's `updated()` trigger, append the row to the Merkle todo queue,
+and (for tombstones, on the partition leader) enqueue a GC entry.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Iterator, Optional
+
+from ..db import Db, TxAbort
+from ..utils.data import blake2sum
+from .replication import TableReplication
+from .schema import Entry, TableSchema, partition_hash, tree_key
+
+log = logging.getLogger("garage_tpu.table.data")
+
+
+def _prefix_upper_bound(prefix: bytes) -> Optional[bytes]:
+    """Smallest byte string greater than every string with this prefix,
+    or None if the prefix is all 0xFF (no upper bound)."""
+    b = bytearray(prefix)
+    while b:
+        if b[-1] != 0xFF:
+            b[-1] += 1
+            return bytes(b)
+        b.pop()
+    return None
+
+
+class TableData:
+    def __init__(self, db: Db, schema: TableSchema, replication: TableReplication,
+                 system_id: bytes):
+        self.db = db
+        self.schema = schema
+        self.replication = replication
+        self.system_id = system_id
+        name = schema.TABLE_NAME
+        self.name = name
+        self.store = db.open_tree(f"table:{name}")
+        self.merkle_todo = db.open_tree(f"{name}:merkle_todo")
+        self.merkle_tree = db.open_tree(f"{name}:merkle_tree")
+        self.gc_todo = db.open_tree(f"{name}:gc_todo")
+        self.insert_queue = db.open_tree(f"{name}:insert_queue")
+        self.merkle_todo_notify = threading.Event()
+        self.insert_queue_notify = threading.Event()
+        from .gc import TABLE_GC_DELAY
+
+        self.gc_delay = TABLE_GC_DELAY  # tunable (tests, config)
+        # listeners called (outside the tx) after local changes; used by
+        # k2v-style subscriptions and tests
+        self.changed_hooks: list[Callable[[Entry], None]] = []
+
+    # ---- reads ---------------------------------------------------------
+
+    def read_entry(self, pk: bytes, sk: bytes) -> Optional[bytes]:
+        return self.store.get(tree_key(pk, sk))
+
+    def decode_stored(self, raw: bytes) -> Entry:
+        return self.schema.decode_entry(raw)
+
+    def read_range(self, pk: bytes, start_sk: Optional[bytes], flt,
+                   limit: int, reverse: bool = False) -> list[bytes]:
+        """Rows of one partition key, from start_sk, filtered, ≤ limit.
+        ref: table/data.rs read_range."""
+        prefix = tree_key(pk, b"")
+        start = tree_key(pk, start_sk) if start_sk is not None else prefix
+        end_excl = _prefix_upper_bound(prefix)
+        out = []
+        if reverse:
+            rev_end = start + b"\x00" if start_sk is not None else end_excl
+            it = self.store.iter(start=prefix, end=rev_end, reverse=True)
+        else:
+            it = self.store.iter(start=start, end=end_excl)
+        for k, v in it:
+            if not k.startswith(prefix):
+                break
+            e = self.schema.decode_entry(v)
+            if flt is None or self.schema.matches_filter(e, flt):
+                out.append(v)
+            if len(out) >= limit:
+                break
+        return out
+
+    def iter_all(self) -> Iterator[tuple[bytes, bytes]]:
+        return self.store.iter()
+
+    # ---- writes --------------------------------------------------------
+
+    def update_entry(self, raw: bytes) -> Optional[Entry]:
+        """Merge one incoming encoded entry; returns the new merged entry
+        if the stored value changed, else None. ref: data.rs:178-268."""
+        entry = self.schema.decode_entry(raw)
+        return self.update_entry_decoded(entry)
+
+    def update_entry_decoded(self, entry: Entry) -> Optional[Entry]:
+        k = tree_key(entry.partition_key(), entry.sort_key())
+
+        def body(tx):
+            old_raw = tx.get(self.store, k)
+            if old_raw is not None:
+                old = self.schema.decode_entry(old_raw)
+                new = old.merge(entry)
+            else:
+                old = None
+                new = entry
+            new_raw = self.schema.encode_entry(new)
+            if old_raw == new_raw:
+                return None
+            tx.insert(self.store, k, new_raw)
+            tx.insert(self.merkle_todo, k, blake2sum(new_raw))
+            self.schema.updated(tx, old, new)
+            self._maybe_gc_todo(tx, entry, new, k, new_raw)
+            return new
+
+        new = self.db.transaction(body)
+        if new is not None:
+            self.merkle_todo_notify.set()
+            for h in self.changed_hooks:
+                try:
+                    h(new)
+                except Exception:
+                    log.exception("changed hook failed")
+        return new
+
+    def update_many(self, raws: list[bytes]) -> int:
+        n = 0
+        for raw in raws:
+            if self.update_entry(raw) is not None:
+                n += 1
+        return n
+
+    def _maybe_gc_todo(self, tx, incoming: Entry, new: Entry, k: bytes,
+                       new_raw: bytes) -> None:
+        """Tombstones get a GC-todo entry on the partition leader
+        (ref: data.rs:242-257)."""
+        if not new.is_tombstone():
+            return
+        ph = partition_hash(new.partition_key())
+        nodes = self.replication.storage_nodes(ph)
+        if nodes and nodes[0] == self.system_id:
+            from .gc import GcTodoEntry
+
+            GcTodoEntry.new(k, blake2sum(new_raw), delay=self.gc_delay).save(
+                tx, self.gc_todo
+            )
+
+    def delete_if_equal_hash(self, k: bytes, vhash: bytes) -> bool:
+        """Remove row k only if its stored encoding hashes to vhash
+        (phase 3 of GC; ref: data.rs:280-310)."""
+
+        def body(tx):
+            cur = tx.get(self.store, k)
+            if cur is None or blake2sum(cur) != vhash:
+                return False
+            old = self.schema.decode_entry(cur)
+            tx.remove(self.store, k)
+            tx.insert(self.merkle_todo, k, b"")
+            self.schema.updated(tx, old, None)
+            return True
+
+        changed = self.db.transaction(body)
+        if changed:
+            self.merkle_todo_notify.set()
+        return changed
+
+    # ---- async insert queue (ref: table/queue.rs) ----------------------
+
+    def queue_insert(self, tx, entry: Entry) -> None:
+        """Enqueue an entry for asynchronous insertion via the normal
+        quorum path; called from inside `updated()` triggers so the
+        enqueue commits atomically with the triggering write. Keyed by
+        the full row key; a second enqueue for the same row CRDT-merges
+        into the pending one (ref: data.rs:322-336)."""
+        k = tree_key(entry.partition_key(), entry.sort_key())
+        cur = tx.get(self.insert_queue, k)
+        if cur is not None:
+            entry = self.schema.decode_entry(cur).merge(entry)
+        tx.insert(self.insert_queue, k, self.schema.encode_entry(entry))
+        tx.on_commit(self.insert_queue_notify.set)
+
+    # ---- stats ---------------------------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            "rows": len(self.store),
+            "merkle_todo": len(self.merkle_todo),
+            "gc_todo": len(self.gc_todo),
+            "insert_queue": len(self.insert_queue),
+        }

@@ -1,0 +1,252 @@
+"""Merkle trie per table partition, driving anti-entropy sync.
+
+Ref parity: src/table/merkle.rs. A background worker drains the
+merkle_todo queue (row key -> new item hash, or empty = deleted) and
+folds each change up a 256-ary trie stored in the `{table}:merkle_tree`
+db tree. Node kinds mirror the reference (merkle.rs:55-67): Empty,
+Leaf(row key, item-hash), Intermediate(children).
+
+The trie descends along the bytes of blake2(row key) — fixed 32 bytes,
+so no key is ever a prefix of another — while leaves carry the full row
+key (merkle.rs:131-247, `key.next_key(khash)`). Intermediates that drop
+to a single leaf child collapse upward, so the trie shape is a pure
+function of the stored key set: equal content ⇒ equal root hash on
+every replica, regardless of write order.
+
+Trie storage keys: 2-byte big-endian partition ++ khash prefix.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..utils import migrate
+from ..utils.background import Worker, WState
+from ..utils.data import blake2sum
+from .data import TableData
+
+log = logging.getLogger("garage_tpu.table.merkle")
+
+EMPTY, LEAF, INTERMEDIATE = 0, 1, 2
+EMPTY_HASH = b"\x00" * 32
+
+
+class MerkleNode:
+    """ref: merkle.rs:55-67."""
+
+    __slots__ = ("kind", "key", "hash", "children")
+
+    def __init__(self, kind: int, key: bytes = b"", h: bytes = b"",
+                 children: Optional[list] = None):
+        self.kind = kind
+        self.key = key  # LEAF: full row key
+        self.hash = h  # LEAF: item hash
+        self.children = children or []  # INTERMEDIATE: [(byte, child-hash)]
+
+    @classmethod
+    def empty(cls) -> "MerkleNode":
+        return cls(EMPTY)
+
+    @classmethod
+    def leaf(cls, key: bytes, h: bytes) -> "MerkleNode":
+        return cls(LEAF, key=key, h=h)
+
+    @classmethod
+    def intermediate(cls, children: list) -> "MerkleNode":
+        return cls(INTERMEDIATE, children=sorted(children))
+
+    def is_empty(self) -> bool:
+        return self.kind == EMPTY
+
+    def child(self, byte: int) -> Optional[bytes]:
+        for b, h in self.children:
+            if b == byte:
+                return h
+        return None
+
+    def with_child(self, byte: int, h: Optional[bytes]) -> "MerkleNode":
+        ch = [(b, x) for b, x in self.children if b != byte]
+        if h is not None:
+            ch.append((byte, h))
+        if not ch:
+            return MerkleNode.empty()
+        return MerkleNode.intermediate(ch)
+
+    def pack(self) -> bytes:
+        if self.kind == EMPTY:
+            o = [EMPTY]
+        elif self.kind == LEAF:
+            o = [LEAF, self.key, self.hash]
+        else:
+            o = [INTERMEDIATE, [[b, h] for b, h in self.children]]
+        return migrate.msgpack.packb(o, use_bin_type=True)
+
+    @classmethod
+    def unpack(cls, raw: Optional[bytes]) -> "MerkleNode":
+        if raw is None:
+            return cls.empty()
+        o = migrate.msgpack.unpackb(raw, raw=True)
+        if o[0] == EMPTY:
+            return cls.empty()
+        if o[0] == LEAF:
+            return cls.leaf(o[1], o[2])
+        return cls.intermediate([(b, h) for b, h in o[1]])
+
+    def node_hash(self) -> bytes:
+        """Hash of this (sub)tree; the empty tree hashes to zeros."""
+        if self.kind == EMPTY:
+            return EMPTY_HASH
+        return blake2sum(self.pack())
+
+
+def node_key(partition: int, prefix: bytes) -> bytes:
+    return partition.to_bytes(2, "big") + prefix
+
+
+class MerkleUpdater(Worker):
+    """Drains merkle_todo into the trie (ref: merkle.rs worker)."""
+
+    BATCH = 128
+
+    def __init__(self, data: TableData):
+        self.data = data
+        self.name = f"{data.name} merkle"
+
+    # ---- trie read api (used by sync) ----------------------------------
+
+    def read_node(self, partition: int, prefix: bytes) -> MerkleNode:
+        return MerkleNode.unpack(
+            self.data.merkle_tree.get(node_key(partition, prefix))
+        )
+
+    def root_hash(self, partition: int) -> bytes:
+        return self.read_node(partition, b"").node_hash()
+
+    def leaf_rows(self, partition: int, prefix: bytes,
+                  limit: int = 1 << 30) -> list[bytes]:
+        """Row keys of all leaves under a trie prefix (ref: sync.rs uses
+        the subtree itself to enumerate items to push)."""
+        out: list[bytes] = []
+        stack = [prefix]
+        while stack and len(out) < limit:
+            p = stack.pop()
+            n = self.read_node(partition, p)
+            if n.kind == LEAF:
+                out.append(n.key)
+            elif n.kind == INTERMEDIATE:
+                stack.extend(p + bytes([b]) for b, _ in reversed(n.children))
+        return out
+
+    # ---- updates -------------------------------------------------------
+
+    def _partition_of_row(self, row_key: bytes) -> int:
+        # row keys start with blake2(P); replication decides how many
+        # partition bits matter (sharded: top byte; fullcopy: single 0)
+        return self.data.replication.partition_of(row_key[:32])
+
+    def update_item(self, row_key: bytes, new_hash: bytes) -> None:
+        """Apply one todo entry (new_hash empty = row deleted), folding
+        hashes up the trie inside one db transaction."""
+        partition = self._partition_of_row(row_key)
+        khash = blake2sum(row_key)
+
+        def body(tx):
+            self._update_rec(tx, partition, b"", row_key, khash,
+                             new_hash if new_hash else None)
+            # only clear the todo entry if it hasn't changed since we
+            # read it (a concurrent write may have requeued the row)
+            cur = tx.get(self.data.merkle_todo, row_key)
+            if cur == (new_hash if new_hash else b""):
+                tx.remove(self.data.merkle_todo, row_key)
+
+        self.data.db.transaction(body)
+
+    def _update_rec(self, tx, partition: int, prefix: bytes, row_key: bytes,
+                    khash: bytes, new_vhash: Optional[bytes]) -> Optional[bytes]:
+        """Returns the node's new hash (EMPTY_HASH if it vanished), or
+        None if the subtree was unchanged. ref: merkle.rs:131-247."""
+        i = len(prefix)
+        k = node_key(partition, prefix)
+        node = MerkleNode.unpack(tx.get(self.data.merkle_tree, k))
+        mutate: Optional[MerkleNode]
+
+        if node.kind == EMPTY:
+            mutate = MerkleNode.leaf(row_key, new_vhash) if new_vhash else None
+        elif node.kind == INTERMEDIATE:
+            byte = khash[i]
+            sub = self._update_rec(tx, partition, prefix + bytes([byte]),
+                                   row_key, khash, new_vhash)
+            if sub is None:
+                mutate = None
+            else:
+                node = node.with_child(byte, None if sub == EMPTY_HASH else sub)
+                if node.is_empty():
+                    mutate = node
+                elif len(node.children) == 1:
+                    # single child left: if it's a leaf, pull it up
+                    # (canonical shape; ref: merkle.rs:164-183)
+                    cb = node.children[0][0]
+                    ck = node_key(partition, prefix + bytes([cb]))
+                    child = MerkleNode.unpack(tx.get(self.data.merkle_tree, ck))
+                    if child.kind == LEAF:
+                        tx.remove(self.data.merkle_tree, ck)
+                        mutate = child
+                    else:
+                        mutate = node
+                else:
+                    mutate = node
+        else:  # LEAF
+            if node.key == row_key:
+                if new_vhash is None:
+                    mutate = MerkleNode.empty()
+                elif node.hash == new_vhash:
+                    mutate = None
+                else:
+                    mutate = MerkleNode.leaf(row_key, new_vhash)
+            elif new_vhash is None:
+                mutate = None  # deleting a key we don't hold here
+            else:
+                # split: push the existing leaf down one level, then
+                # insert ours; shared khash bytes recurse further down
+                exk = node.key
+                exkhash = blake2sum(exk)
+                sub1 = self._update_rec(tx, partition,
+                                        prefix + bytes([exkhash[i]]),
+                                        exk, exkhash, node.hash)
+                inter = MerkleNode.intermediate([(exkhash[i], sub1)])
+                sub2 = self._update_rec(tx, partition,
+                                        prefix + bytes([khash[i]]),
+                                        row_key, khash, new_vhash)
+                mutate = inter.with_child(khash[i], sub2)
+
+        if mutate is None:
+            return None
+        if mutate.is_empty():
+            tx.remove(self.data.merkle_tree, k)
+            return EMPTY_HASH
+        tx.insert(self.data.merkle_tree, k, mutate.pack())
+        return mutate.node_hash()
+
+    # ---- worker loop ---------------------------------------------------
+
+    async def work(self):
+        import asyncio
+
+        todo = list(self.data.merkle_todo.iter())[: self.BATCH]
+        if not todo:
+            return WState.IDLE
+        for k, v in todo:
+            await asyncio.to_thread(self.update_item, k, v)
+        return WState.BUSY
+
+    async def wait_for_work(self):
+        import asyncio
+
+        while not len(self.data.merkle_todo):
+            await asyncio.sleep(0.1)
+
+    def info(self):
+        from ..utils.background import WorkerInfo
+
+        return WorkerInfo(name=self.name, queue_length=len(self.data.merkle_todo))

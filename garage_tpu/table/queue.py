@@ -1,0 +1,51 @@
+"""Async insert queue: local writes that propagate via normal quorum path.
+
+Ref parity: src/table/queue.rs:1-77. Triggers (`TableSchema.updated`)
+often need to insert into *other* tables; doing a quorum RPC inside a db
+transaction would deadlock, so they enqueue locally (atomic with the
+triggering commit) and this worker drains the queue through
+`Table.insert_many` in batches, removing entries only if unchanged.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..utils.background import Worker, WState
+
+log = logging.getLogger("garage_tpu.table.queue")
+
+BATCH_SIZE = 1024
+
+
+class InsertQueueWorker(Worker):
+    def __init__(self, table):
+        self.table = table
+        self.data = table.data
+        self.name = f"{table.name} queue"
+
+    async def work(self):
+        batch = list(self.data.insert_queue.iter())[:BATCH_SIZE]
+        if not batch:
+            return WState.IDLE
+        entries = [self.data.schema.decode_entry(v) for _, v in batch]
+        await self.table.insert_many(entries)
+
+        def body(tx):
+            for k, v in batch:
+                if tx.get(self.data.insert_queue, k) == v:
+                    tx.remove(self.data.insert_queue, k)
+
+        self.data.db.transaction(body)
+        return WState.BUSY
+
+    async def wait_for_work(self):
+        while not len(self.data.insert_queue):
+            await asyncio.sleep(0.1)
+
+    def info(self):
+        from ..utils.background import WorkerInfo
+
+        return WorkerInfo(name=self.name,
+                          queue_length=len(self.data.insert_queue))

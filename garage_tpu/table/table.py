@@ -1,0 +1,207 @@
+"""Table: quorum-replicated CRDT table operations.
+
+Ref parity: src/table/table.rs. insert/insert_many write encoded entries
+to every live write set with per-set quorum (layout transitions are
+covered by writing to old+new sets under the ack lock); get/get_range
+read-quorum from the ring, CRDT-merge the responses, and schedule a
+background read-repair when replicas disagree.
+
+RPC ops (payload dicts on endpoint "garage_tpu/table:{name}"):
+  {op: "update", entries: [raw,..]}
+  {op: "read_entry", pk, sk}
+  {op: "read_range", pk, start_sk, limit, reverse}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from ..net.message import PRIO_NORMAL
+from ..rpc.rpc_helper import RequestStrategy, RpcHelper
+from ..utils.error import QuorumError
+from .data import TableData
+from .merkle import MerkleUpdater
+from .replication import TableReplication
+from .schema import Entry, TableSchema, partition_hash
+
+log = logging.getLogger("garage_tpu.table")
+
+
+class Table:
+    def __init__(self, schema: TableSchema, replication: TableReplication,
+                 rpc_helper: RpcHelper, db):
+        self.schema = schema
+        self.replication = replication
+        self.rpc = rpc_helper
+        self.system = rpc_helper.system
+        self.data = TableData(db, schema, replication, self.system.id)
+        self.merkle = MerkleUpdater(self.data)
+        self.name = schema.TABLE_NAME
+        self.endpoint = self.system.netapp.endpoint(
+            f"garage_tpu/table:{self.name}"
+        ).set_handler(self._handle)
+        # background read-repair tasks (kept so tests/shutdown can drain)
+        self._repairs: set[asyncio.Task] = set()
+
+    def spawn_workers(self, runner) -> None:
+        from .gc import TableGc
+        from .queue import InsertQueueWorker
+        from .sync import TableSyncer
+
+        self.syncer = TableSyncer(self)
+        runner.spawn_worker(self.merkle)
+        runner.spawn_worker(self.syncer)
+        runner.spawn_worker(TableGc(self))
+        runner.spawn_worker(InsertQueueWorker(self))
+
+    # ---- client ops ----------------------------------------------------
+
+    async def insert(self, entry: Entry) -> None:
+        """ref: table/table.rs:106-144."""
+        raw = self.schema.encode_entry(entry)
+        ph = partition_hash(entry.partition_key())
+        with self.replication.write_lock():
+            sets = self.replication.write_sets(ph)
+            await self.rpc.try_write_many_sets(
+                self.endpoint,
+                sets,
+                {"op": "update", "entries": [raw]},
+                RequestStrategy(quorum=self.replication.write_quorum(),
+                                prio=PRIO_NORMAL),
+            )
+
+    async def insert_many(self, entries: list[Entry]) -> None:
+        """Batch insert: one RPC per node carrying all entries destined
+        to it; per-write-set quorum accounting (ref: table.rs:164-285)."""
+        if not entries:
+            return
+        with self.replication.write_lock():
+            per_node: dict[bytes, list[bytes]] = {}
+            all_sets: list[list[bytes]] = []
+            seen_sets: set[tuple] = set()
+            for e in entries:
+                raw = self.schema.encode_entry(e)
+                ph = partition_hash(e.partition_key())
+                sets = self.replication.write_sets(ph)
+                # each entry goes once per node, even when the node sits
+                # in several (old+new) write sets (ref: table.rs:198-236)
+                dest = {n for s in sets for n in s}
+                for s in sets:
+                    key = tuple(sorted(s))
+                    if key not in seen_sets:
+                        seen_sets.add(key)
+                        all_sets.append(s)
+                for n in dest:
+                    per_node.setdefault(n, []).append(raw)
+            await self.rpc.try_write_many_sets(
+                self.endpoint,
+                all_sets,
+                None,
+                RequestStrategy(quorum=self.replication.write_quorum(),
+                                prio=PRIO_NORMAL),
+                make_payload=lambda n: {"op": "update",
+                                        "entries": per_node.get(n, [])},
+            )
+
+    async def get(self, pk: bytes, sk: bytes) -> Optional[Entry]:
+        """Read-quorum get with CRDT merge + background read-repair.
+        ref: table.rs:287-361."""
+        ph = partition_hash(pk)
+        nodes = self.replication.read_nodes(ph)
+        resps = await self.rpc.try_call_many(
+            self.endpoint,
+            nodes,
+            {"op": "read_entry", "pk": pk, "sk": sk},
+            RequestStrategy(quorum=self.replication.read_quorum()),
+        )
+        ret: Optional[Entry] = None
+        raws = []
+        for r in resps:
+            raw = r.get("entry")
+            raws.append(raw)
+            if raw is not None:
+                e = self.schema.decode_entry(raw)
+                ret = e if ret is None else ret.merge(e)
+        if ret is not None:
+            merged_raw = self.schema.encode_entry(ret)
+            if any(r != merged_raw for r in raws):
+                self._spawn_repair([ret])
+        return ret
+
+    async def get_range(self, pk: bytes, start_sk: Optional[bytes] = None,
+                        flt=None, limit: int = 100,
+                        reverse: bool = False) -> list[Entry]:
+        """ref: table.rs:363-483."""
+        ph = partition_hash(pk)
+        nodes = self.replication.read_nodes(ph)
+        resps = await self.rpc.try_call_many(
+            self.endpoint,
+            nodes,
+            {"op": "read_range", "pk": pk, "start_sk": start_sk,
+             "limit": limit, "reverse": reverse, "filter": flt},
+            RequestStrategy(quorum=self.replication.read_quorum()),
+        )
+        by_key: dict[tuple, Entry] = {}
+        raw_seen: dict[tuple, set] = {}
+        appearances: dict[tuple, int] = {}
+        for r in resps:
+            for raw in r.get("entries", []):
+                e = self.schema.decode_entry(raw)
+                kk = (e.partition_key(), e.sort_key())
+                by_key[kk] = e if kk not in by_key else by_key[kk].merge(e)
+                raw_seen.setdefault(kk, set()).add(raw)
+                appearances[kk] = appearances.get(kk, 0) + 1
+        # repair keys whose replicas returned divergent values or that
+        # some replica was missing entirely (ref: table.rs:449-471; the
+        # missing-entry check is approximate near the limit boundary,
+        # where absence may just mean "past that replica's window")
+        to_repair = [
+            e for kk, e in by_key.items()
+            if len(raw_seen[kk]) > 1 or appearances[kk] < len(resps)
+        ]
+        if to_repair:
+            self._spawn_repair(to_repair)
+        out = sorted(by_key.values(),
+                     key=lambda e: e.sort_key(), reverse=reverse)
+        return out[:limit]
+
+    def _spawn_repair(self, entries: list[Entry]) -> None:
+        async def repair():
+            try:
+                await self.insert_many(entries)
+            except Exception as e:
+                log.debug("%s read-repair failed: %s", self.name, e)
+
+        t = asyncio.create_task(repair())
+        self._repairs.add(t)
+        t.add_done_callback(self._repairs.discard)
+
+    # ---- local (trigger-path) ops --------------------------------------
+
+    def queue_insert(self, tx, entry: Entry) -> None:
+        self.data.queue_insert(tx, entry)
+
+    async def get_local(self, pk: bytes, sk: bytes) -> Optional[Entry]:
+        raw = self.data.read_entry(pk, sk)
+        return self.schema.decode_entry(raw) if raw is not None else None
+
+    # ---- server side ---------------------------------------------------
+
+    async def _handle(self, from_node: bytes, payload, stream):
+        op = payload["op"]
+        if op == "update":
+            await asyncio.to_thread(self.data.update_many, payload["entries"])
+            return {"ok": True}
+        if op == "read_entry":
+            raw = self.data.read_entry(payload["pk"], payload["sk"])
+            return {"entry": raw}
+        if op == "read_range":
+            entries = await asyncio.to_thread(
+                self.data.read_range,
+                payload["pk"], payload.get("start_sk"), payload.get("filter"),
+                payload.get("limit", 100), payload.get("reverse", False),
+            )
+            return {"entries": entries}
+        raise ValueError(f"unknown table op {op!r}")

@@ -170,11 +170,14 @@ def test_tracker_gc_of_old_versions():
     h.apply_staged_changes()
     h.stage_role(nid(2), NodeRole(zone="z", capacity=1 << 30))
     h.apply_staged_changes()
-    assert [v.version for v in h.versions] == [0, 1, 2]
+    # the empty bootstrap v0 is pruned as soon as a valid version exists
+    # (ref: history.rs:81-89); v1 stays until sync-acked by all
+    assert [v.version for v in h.versions] == [1, 2]
     for n in (nid(1), nid(2)):
         h.update_trackers.set_max("ack", n, 2)
         h.update_trackers.set_max("sync", n, 2)
         h.update_trackers.set_max("sync_ack", n, 2)
     h.cleanup_old_versions()
     assert h.min_stored() == 2
-    assert [v.version for v in h.old_versions] == [0, 1]
+    # v0 was discarded (invalid/empty); v1 is archived for block lookup
+    assert [v.version for v in h.old_versions] == [1]
