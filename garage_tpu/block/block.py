@@ -1,0 +1,66 @@
+"""DataBlock: the on-wire/on-disk representation of one block or shard.
+
+Ref parity: src/block/block.rs:12-106. A block travels either plain or
+compressed; the content hash always refers to the PLAIN bytes, and a
+compressed block is checked by decompressing and hashing. The reference
+uses zstd level 1; this build uses zlib level 1 (no zstd in the runtime
+— the header byte records the scheme so formats can coexist).
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass
+
+from ..utils.data import blake2sum
+from ..utils.error import CorruptData
+
+COMPRESSION_NONE = 0
+COMPRESSION_ZLIB = 1
+
+COMPRESSION_LEVEL = 1  # ref: util/config.rs:280 (zstd level 1 default)
+
+
+@dataclass
+class DataBlock:
+    compression: int
+    bytes: bytes
+
+    @classmethod
+    def plain(cls, data: bytes) -> "DataBlock":
+        return cls(COMPRESSION_NONE, data)
+
+    @classmethod
+    def compress(cls, data: bytes, level: int = COMPRESSION_LEVEL) -> "DataBlock":
+        """Compress if it helps; otherwise keep plain
+        (ref: block.rs:85-99 from_buffer)."""
+        c = zlib.compress(data, level)
+        if len(c) < len(data):
+            return cls(COMPRESSION_ZLIB, c)
+        return cls(COMPRESSION_NONE, data)
+
+    def plain_bytes(self) -> bytes:
+        if self.compression == COMPRESSION_NONE:
+            return self.bytes
+        return zlib.decompress(self.bytes)
+
+    def verify(self, hash32: bytes) -> None:
+        """ref: block.rs:69-83 (plain: blake2 check; compressed: integrity
+        of the decompression stream + blake2 of the result)."""
+        try:
+            plain = self.plain_bytes()
+        except zlib.error as e:
+            raise CorruptData(hash32) from e
+        if blake2sum(plain) != hash32:
+            raise CorruptData(hash32)
+
+    # wire format: 1 header byte + payload
+    def pack(self) -> bytes:
+        return bytes([self.compression]) + self.bytes
+
+    @classmethod
+    def unpack(cls, raw: bytes) -> "DataBlock":
+        return cls(raw[0], raw[1:])
+
+    def file_suffix(self) -> str:
+        return ".zlib" if self.compression == COMPRESSION_ZLIB else ""

@@ -1,0 +1,415 @@
+"""BlockManager: the content-addressed block data path.
+
+Ref parity: src/block/manager.rs. Public surface mirrors the reference
+(`rpc_put_block`, `rpc_get_block`, `block_incref/decref`) but the write
+path is generic over the BlockCodec: replicate-N sends the whole
+(optionally compressed) block to every node of the hash's write sets;
+erasure(k, m) RS-encodes the packed block into k+m shards (TPU math)
+placed on k+m distinct ring nodes, and reads gather any k.
+
+Local files (under the DataLayout path scheme):
+  whole blocks:  {hex}[.zlib]      content = DataBlock payload
+  shards:        {hex}.s{i}        content = shard file (len+checksum hdr)
+
+RPC ops on endpoint "garage_tpu/block":
+  {op: "put", hash, part|None, data}      part=None -> whole block
+  {op: "get", hash, part|None}
+  {op: "need", hash}                      -> {needed: bool}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import Optional
+
+from ..net.message import PRIO_BACKGROUND, PRIO_NORMAL
+from ..rpc.rpc_helper import RequestStrategy, RpcHelper
+from ..utils.data import blake2sum
+from ..utils.error import CorruptData, MissingBlock, QuorumError, RpcError
+from .block import DataBlock
+from .codec import BlockCodec, ErasureCodec, ReplicateCodec, shard_nodes_of
+from .layout import DataLayout
+from .rc import BlockRc
+
+log = logging.getLogger("garage_tpu.block")
+
+INLINE_THRESHOLD = 3072  # ref: block/manager.rs:46
+
+_SHARD_MAGIC = b"GTS1"
+
+
+def pack_shard(data: bytes, packed_len: int) -> bytes:
+    """Shard file: magic + whole-block packed length + shard checksum +
+    shard bytes (the checksum lets scrub verify a shard without its k-1
+    siblings)."""
+    return (_SHARD_MAGIC + packed_len.to_bytes(8, "big")
+            + blake2sum(data) + data)
+
+
+def unpack_shard(raw: bytes) -> tuple[bytes, int]:
+    """-> (shard bytes, whole-block packed length); raises CorruptData."""
+    if raw[:4] != _SHARD_MAGIC:
+        raise CorruptData(b"")
+    packed_len = int.from_bytes(raw[4:12], "big")
+    ck, data = raw[12:44], raw[44:]
+    if blake2sum(data) != ck:
+        raise CorruptData(b"")
+    return data, packed_len
+
+
+class BlockManager:
+    def __init__(self, system, db, data_layout: DataLayout,
+                 codec: Optional[BlockCodec] = None,
+                 compression: bool = True, fsync: bool = False):
+        self.system = system
+        self.db = db
+        self.data_layout = data_layout
+        self.compression = compression
+        self.fsync = fsync
+        self.rc = BlockRc(db)
+        self.rpc = RpcHelper(system)
+        if codec is None:
+            rm = system.replication
+            if rm.erasure is not None:
+                codec = ErasureCodec(*rm.erasure,
+                                     write_quorum=rm.block_write_quorum)
+            else:
+                codec = ReplicateCodec(rm.factor,
+                                       write_quorum=rm.write_quorum)
+        self.codec = codec
+        self.endpoint = system.netapp.endpoint("garage_tpu/block").set_handler(
+            self._handle
+        )
+        from .resync import BlockResyncManager
+
+        self.resync = BlockResyncManager(self, db)
+        self.metrics = {"bytes_read": 0, "bytes_written": 0,
+                        "corruptions": 0, "resync_sent": 0, "resync_recv": 0}
+
+    @property
+    def erasure(self) -> bool:
+        return isinstance(self.codec, ErasureCodec)
+
+    def spawn_workers(self, runner, scrub: bool = True) -> None:
+        from .repair import ScrubWorker
+
+        self.resync.spawn_workers(runner)
+        if scrub:
+            runner.spawn_worker(ScrubWorker(self))
+
+    # ==== cluster write path (ref: manager.rs:366-450) ==================
+
+    async def rpc_put_block(self, hash32: bytes, data: bytes) -> None:
+        blk = DataBlock.compress(data) if self.compression else DataBlock.plain(data)
+        packed = blk.pack()
+        if self.erasure:
+            await self._put_erasure(hash32, packed)
+        else:
+            await self._put_replicate(hash32, packed)
+
+    async def _put_replicate(self, hash32: bytes, packed: bytes) -> None:
+        helper = self.system.layout_helper
+        with helper.write_lock():
+            sets = helper.write_sets_of(hash32)
+            await self.rpc.try_write_many_sets(
+                self.endpoint, sets,
+                {"op": "put", "hash": hash32, "part": None, "data": packed},
+                RequestStrategy(quorum=self.codec.write_quorum,
+                                prio=PRIO_NORMAL,
+                                timeout=60.0),
+            )
+
+    async def _put_erasure(self, hash32: bytes, packed: bytes) -> None:
+        parts = self.codec.encode(packed)
+        helper = self.system.layout_helper
+        with helper.write_lock():
+            placement = shard_nodes_of(helper.current(), hash32,
+                                       self.codec.width)
+            if len(placement) < self.codec.write_quorum:
+                raise QuorumError(self.codec.write_quorum, 1, 0,
+                                  len(placement), ["cluster too small"])
+            part_of = {n: i for i, n in enumerate(placement)}
+            await self.rpc.try_call_many(
+                self.endpoint, placement, None,
+                RequestStrategy(quorum=self.codec.write_quorum,
+                                prio=PRIO_NORMAL, timeout=60.0,
+                                send_all_at_once=True,
+                                interrupt_stragglers=False),
+                make_payload=lambda n: {
+                    "op": "put", "hash": hash32, "part": part_of[n],
+                    "data": pack_shard(parts[part_of[n]], len(packed)),
+                },
+            )
+
+    # ==== cluster read path (ref: manager.rs:243-363) ===================
+
+    async def rpc_get_block(self, hash32: bytes) -> bytes:
+        if self.erasure:
+            packed = await self._get_erasure(hash32)
+        else:
+            packed = await self._get_replicate(hash32)
+        blk = DataBlock.unpack(packed)
+        blk.verify(hash32)
+        return blk.plain_bytes()
+
+    async def _get_replicate(self, hash32: bytes) -> bytes:
+        me = self.system.id
+        errs = []
+        for node in self.system.layout_helper.block_read_nodes_of(hash32):
+            try:
+                if node == me:
+                    local = self.read_local(hash32)
+                    if local is not None:
+                        return local
+                    continue
+                resp, _ = await self.endpoint.call(
+                    node, {"op": "get", "hash": hash32, "part": None},
+                    PRIO_NORMAL, timeout=60.0,
+                )
+                if resp.get("data") is not None:
+                    return resp["data"]
+            except Exception as e:
+                errs.append(e)
+        raise MissingBlock(hash32)
+
+    async def _get_erasure(self, hash32: bytes) -> bytes:
+        helper = self.system.layout_helper
+        versions = list(reversed(
+            helper.history.versions + helper.history.old_versions
+        ))
+        tried = set()
+        for v in versions:
+            placement = shard_nodes_of(v, hash32, self.codec.width)
+            key = tuple(placement)
+            if key in tried or not placement:
+                continue
+            tried.add(key)
+            got = await self._gather_parts(hash32, placement,
+                                           self.codec.read_need)
+            if got is not None:
+                parts, packed_len = got
+                return self.codec.decode(parts, packed_len)
+        raise MissingBlock(hash32)
+
+    async def _gather_parts(self, hash32: bytes, placement: list[bytes],
+                            need: int):
+        """Fetch parts concurrently until `need` distinct indices are in
+        hand; over-request nothing (systematic shards first, then the
+        rest on failure)."""
+        me = self.system.id
+
+        async def fetch(node, idx):
+            try:
+                if node == me:
+                    raw = self.read_local_shard(hash32, idx)
+                    if raw is None:
+                        return None
+                    return unpack_shard(raw)
+                resp, _ = await self.endpoint.call(
+                    node, {"op": "get", "hash": hash32, "part": idx},
+                    PRIO_NORMAL, timeout=60.0,
+                )
+                if resp.get("data") is None:
+                    return None
+                return unpack_shard(resp["data"])
+            except Exception:
+                return None
+
+        parts: dict[int, bytes] = {}
+        packed_len = None
+        order = list(enumerate(placement))  # systematic first by design
+        i = 0
+        pending: dict[asyncio.Task, int] = {}
+        while len(parts) < need and (pending or i < len(order)):
+            while i < len(order) and len(pending) < need - len(parts):
+                idx, node = order[i]
+                pending[asyncio.create_task(fetch(node, idx))] = idx
+                i += 1
+            if not pending:
+                break
+            done, _ = await asyncio.wait(
+                pending.keys(), return_when=asyncio.FIRST_COMPLETED
+            )
+            for t in done:
+                idx = pending.pop(t)
+                r = t.result()
+                if r is not None:
+                    parts[idx] = r[0]
+                    packed_len = r[1]
+        if len(parts) < need:
+            return None
+        return parts, packed_len
+
+    # ==== refcount hooks (called from block_ref table trigger) ==========
+
+    def block_incref(self, tx, hash32: bytes) -> None:
+        if self.rc.block_incref(tx, hash32):
+            tx.on_commit(lambda: self.resync.push_now(hash32))
+
+    def block_decref(self, tx, hash32: bytes) -> None:
+        if self.rc.block_decref(tx, hash32):
+            tx.on_commit(
+                lambda: self.resync.push_at(hash32,
+                                            time.time() + self.rc.gc_delay)
+            )
+
+    # ==== local file store (ref: manager.rs:709-805) ====================
+
+    def _find(self, hash32: bytes, suffixes) -> Optional[str]:
+        for d in self.data_layout.candidate_dirs(hash32):
+            for sfx in suffixes:
+                p = os.path.join(d, hash32.hex() + sfx)
+                if os.path.exists(p):
+                    return p
+        return None
+
+    def _write_file(self, path: str, content: bytes) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(content)
+            if self.fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+        if self.fsync:
+            dirfd = os.open(os.path.dirname(path), os.O_RDONLY)
+            try:
+                os.fsync(dirfd)
+            finally:
+                os.close(dirfd)
+        self.metrics["bytes_written"] += len(content)
+
+    def write_local(self, hash32: bytes, packed: bytes) -> None:
+        """Store a whole packed DataBlock."""
+        blk = DataBlock.unpack(packed)
+        path = self.data_layout.block_path(hash32, blk.file_suffix())
+        self._write_file(path, blk.bytes)
+        # drop the other-compression variant if present (ref: manager.rs
+        # write_block replaces regardless of compression state)
+        other = self.data_layout.block_path(
+            hash32, "" if blk.file_suffix() else ".zlib"
+        )
+        if os.path.exists(other):
+            os.remove(other)
+
+    def read_local(self, hash32: bytes) -> Optional[bytes]:
+        """-> packed DataBlock bytes, verifying content hash
+        (ref: manager.rs:554-609)."""
+        p = self._find(hash32, ["", ".zlib"])
+        if p is None:
+            return None
+        with open(p, "rb") as f:
+            raw = f.read()
+        self.metrics["bytes_read"] += len(raw)
+        comp = 1 if p.endswith(".zlib") else 0
+        blk = DataBlock(comp, raw)
+        try:
+            blk.verify(hash32)
+        except CorruptData:
+            self._quarantine(p, hash32)
+            return None
+        return blk.pack()
+
+    def write_local_shard(self, hash32: bytes, part: int, raw: bytes) -> None:
+        unpack_shard(raw)  # validate before storing
+        self._write_file(self.data_layout.block_path(hash32, f".s{part}"), raw)
+
+    def read_local_shard(self, hash32: bytes, part: int) -> Optional[bytes]:
+        p = self._find(hash32, [f".s{part}"])
+        if p is None:
+            return None
+        with open(p, "rb") as f:
+            raw = f.read()
+        self.metrics["bytes_read"] += len(raw)
+        try:
+            unpack_shard(raw)
+        except CorruptData:
+            self._quarantine(p, hash32)
+            return None
+        return raw
+
+    def local_parts(self, hash32: bytes) -> list[int]:
+        """Shard indices stored here."""
+        out = []
+        for d in self.data_layout.candidate_dirs(hash32):
+            if not os.path.isdir(d):
+                continue
+            pre = hash32.hex() + ".s"
+            for fn in os.listdir(d):
+                if fn.startswith(pre) and not fn.endswith(".tmp") \
+                        and not fn.endswith(".corrupted"):
+                    try:
+                        out.append(int(fn[len(pre):]))
+                    except ValueError:
+                        pass
+        return sorted(set(out))
+
+    def has_local(self, hash32: bytes) -> bool:
+        if self.erasure:
+            return bool(self.local_parts(hash32))
+        return self._find(hash32, ["", ".zlib"]) is not None
+
+    def delete_local(self, hash32: bytes) -> None:
+        for d in self.data_layout.candidate_dirs(hash32):
+            if not os.path.isdir(d):
+                continue
+            for fn in os.listdir(d):
+                if fn.startswith(hash32.hex()) and not fn.endswith(".corrupted"):
+                    os.remove(os.path.join(d, fn))
+
+    def _quarantine(self, path: str, hash32: bytes) -> None:
+        """Corrupted file: move aside + queue resync
+        (ref: manager.rs:586-601)."""
+        log.warning("corrupted block file %s", path)
+        self.metrics["corruptions"] += 1
+        try:
+            os.replace(path, path + ".corrupted")
+        except OSError:
+            pass
+        self.resync.push_now(hash32)
+
+    def iter_local_blocks(self):
+        """Yield (hash32, path) for every stored block/shard file."""
+        seen = set()
+        for d in self.data_layout.dirs:
+            for root, _, files in os.walk(d.path):
+                for fn in files:
+                    if fn.endswith((".tmp", ".corrupted")):
+                        continue
+                    hexpart = fn.split(".")[0]
+                    try:
+                        h = bytes.fromhex(hexpart)
+                    except ValueError:
+                        continue
+                    if len(h) == 32 and h not in seen:
+                        seen.add(h)
+                        yield h, os.path.join(root, fn)
+
+    # ==== server side ===================================================
+
+    async def _handle(self, from_node: bytes, payload, stream):
+        op = payload["op"]
+        h = payload.get("hash", b"")
+        if op == "put":
+            part = payload.get("part")
+            if part is None:
+                await asyncio.to_thread(self.write_local, h, payload["data"])
+            else:
+                await asyncio.to_thread(self.write_local_shard, h, part,
+                                        payload["data"])
+            return {"ok": True}
+        if op == "get":
+            part = payload.get("part")
+            if part is None:
+                data = await asyncio.to_thread(self.read_local, h)
+            else:
+                data = await asyncio.to_thread(self.read_local_shard, h, part)
+            return {"data": data}
+        if op == "need":
+            needed = self.rc.is_needed(h) and not self.has_local(h)
+            return {"needed": needed}
+        raise RpcError(f"unknown block op {op!r}")

@@ -1,0 +1,156 @@
+"""Scrub and repair workers for the block store.
+
+Ref parity: src/block/repair.rs. ScrubWorker reads every stored
+block/shard, verifies integrity (whole blocks: blake2 of plain content;
+shards: header checksum + optional cross-shard parity check through the
+TPU RS math), quarantines corrupt files and queues resync. RepairWorker
+is the one-shot full pass: every RC-known and every on-disk block gets a
+resync examination (used after disasters / layout surgery).
+
+The scrub cursor persists so a restart resumes mid-pass
+(ref: repair.rs:169-232 persisted BlockStoreIterator).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+
+from ..utils import migrate
+from ..utils.background import Throttled, Worker, WState
+from ..utils.persister import Persister
+
+log = logging.getLogger("garage_tpu.block.repair")
+
+SCRUB_INTERVAL = 25 * 86400.0  # ~25 days, ref: repair.rs:24-27
+
+
+class ScrubState(migrate.Migratable):
+    VERSION_MARKER = b"GTscrb01"
+
+    def __init__(self, cursor: bytes = b"", last_completed: float = 0.0,
+                 corruptions: int = 0, tranquility: float = 4.0,
+                 paused: bool = False):
+        self.cursor = cursor
+        self.last_completed = last_completed
+        self.corruptions = corruptions
+        self.tranquility = tranquility
+        self.paused = paused
+
+    def pack(self):
+        return [self.cursor, self.last_completed, self.corruptions,
+                self.tranquility, self.paused]
+
+    @classmethod
+    def unpack(cls, o):
+        return cls(*o)
+
+
+class ScrubWorker(Worker):
+    BATCH = 16
+
+    def __init__(self, manager, interval: float = SCRUB_INTERVAL):
+        self.manager = manager
+        self.name = "block scrub"
+        self.interval = interval
+        self.persister = Persister(manager.system.meta_dir, "scrub_state",
+                                   ScrubState)
+        self.state = self.persister.load() or ScrubState()
+        self._jitter = random.random() * 0.4 + 0.8  # ±20%
+
+    def _due(self) -> bool:
+        return (time.time() - self.state.last_completed
+                >= self.interval * self._jitter)
+
+    async def work(self):
+        if self.state.paused or not self._due():
+            return WState.IDLE
+        import heapq
+
+        # disk iteration order is arbitrary; resume = smallest hashes
+        # above the persisted cursor
+        batch = heapq.nsmallest(
+            self.BATCH,
+            (h for h, _ in self.manager.iter_local_blocks()
+             if h > self.state.cursor),
+        )
+        if not batch:
+            self.state.cursor = b""
+            self.state.last_completed = time.time()
+            self.persister.save(self.state)
+            log.info("scrub pass complete, %d corruptions total",
+                     self.state.corruptions)
+            return WState.IDLE
+        t0 = time.monotonic()
+        for h in batch:
+            ok = await asyncio.to_thread(self.scrub_one, h)
+            if not ok:
+                self.state.corruptions += 1
+            self.state.cursor = h
+        self.persister.save(self.state)
+        dt = time.monotonic() - t0
+        if self.state.tranquility > 0:
+            return Throttled(self.state.tranquility * dt / max(len(batch), 1))
+        return WState.BUSY
+
+    def scrub_one(self, hash32: bytes) -> bool:
+        """Verify one block's local storage; quarantine+resync happen
+        inside read_local/read_local_shard on corruption."""
+        m = self.manager
+        if m.erasure:
+            ok = True
+            for part in m.local_parts(hash32):
+                if m.read_local_shard(hash32, part) is None:
+                    ok = False
+            return ok
+        return m.read_local(hash32) is not None
+
+    async def wait_for_work(self):
+        await asyncio.sleep(60.0)
+
+    def info(self):
+        from ..utils.background import WorkerInfo
+
+        return WorkerInfo(
+            name=self.name,
+            progress=self.state.cursor[:4].hex() if self.state.cursor else "-",
+            tranquility=int(self.state.tranquility),
+        )
+
+
+class RepairWorker(Worker):
+    """One-shot: resync-examine every block we know of
+    (ref: repair.rs:35-165)."""
+
+    def __init__(self, manager):
+        self.manager = manager
+        self.name = "block repair"
+        self._phase = 0  # 0: rc table, 1: disk, 2: done
+        self._iter = None
+
+    async def work(self):
+        m = self.manager
+        if self._phase == 0:
+            if self._iter is None:
+                self._iter = m.rc.all_hashes()
+            n = 0
+            for h in self._iter:
+                m.resync.push_now(h)
+                n += 1
+                if n >= 256:
+                    return WState.BUSY
+            self._phase, self._iter = 1, None
+            return WState.BUSY
+        if self._phase == 1:
+            if self._iter is None:
+                self._iter = m.iter_local_blocks()
+            n = 0
+            for h, _ in self._iter:
+                m.resync.push_now(h)
+                n += 1
+                if n >= 256:
+                    return WState.BUSY
+            self._phase = 2
+        return WState.DONE

@@ -1,0 +1,267 @@
+"""Block resync: the self-healing queue of the block store.
+
+Ref parity: src/block/resync.rs. A persistent queue (db tree keyed by
+due-time ++ hash) drives re-examination of blocks: a block this node
+needs but lacks is fetched from a holder (or, in erasure mode, its shard
+is rebuilt from any k others — TPU repair matmul); a block held but no
+longer needed is offered to nodes that still need it, then deleted.
+Failures back off exponentially 1 min -> 64 min in a persistent error
+tree, so a dead peer doesn't melt the queue.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+from ..net.message import PRIO_BACKGROUND
+from ..utils.background import Worker, WState
+from ..utils.error import MissingBlock
+from .codec import shard_nodes_of
+from .manager import pack_shard, unpack_shard
+
+log = logging.getLogger("garage_tpu.block.resync")
+
+RESYNC_RETRY_DELAY = 60.0  # doubles up to 64x (ref: resync.rs:37-40)
+MAX_RESYNC_WORKERS = 8
+
+
+class BlockResyncManager:
+    def __init__(self, manager, db):
+        self.manager = manager
+        self.db = db
+        self.queue = db.open_tree("block_resync_queue")  # due_ms ++ hash -> b""
+        self.errors = db.open_tree("block_resync_errors")  # hash -> (count, next_ms)
+        self.n_workers = 1
+        self.tranquility = 0.0
+
+    # ---- queue ---------------------------------------------------------
+
+    @staticmethod
+    def _qkey(at: float, hash32: bytes) -> bytes:
+        return int(at * 1000).to_bytes(8, "big") + hash32
+
+    def push_now(self, hash32: bytes) -> None:
+        self.queue.insert(self._qkey(time.time(), hash32), b"")
+
+    def push_at(self, hash32: bytes, at: float) -> None:
+        self.queue.insert(self._qkey(at, hash32), b"")
+
+    def queue_len(self) -> int:
+        return len(self.queue)
+
+    def errors_len(self) -> int:
+        return len(self.errors)
+
+    def _pop_due(self) -> Optional[bytes]:
+        now = time.time()
+        for k, _ in self.queue.iter():
+            if int.from_bytes(k[:8], "big") > now * 1000:
+                return None
+            self.queue.remove(k)
+            h = k[8:]
+            # skip if errored and not yet due for retry
+            e = self.errors.get(h)
+            if e is not None:
+                _, next_ms = self._parse_err(e)
+                if next_ms > now * 1000:
+                    self.queue.insert(self._qkey(next_ms / 1000, h), b"")
+                    continue
+            return h
+        return None
+
+    @staticmethod
+    def _parse_err(raw: bytes) -> tuple[int, int]:
+        return int.from_bytes(raw[:4], "big"), int.from_bytes(raw[4:], "big")
+
+    def _record_error(self, hash32: bytes) -> None:
+        e = self.errors.get(hash32)
+        count = self._parse_err(e)[0] + 1 if e else 1
+        delay = RESYNC_RETRY_DELAY * (2 ** min(count - 1, 6))
+        next_ms = int((time.time() + delay) * 1000)
+        self.errors.insert(
+            hash32, count.to_bytes(4, "big") + next_ms.to_bytes(8, "big")
+        )
+        self.queue.insert(self._qkey(next_ms / 1000, hash32), b"")
+
+    def _clear_error(self, hash32: bytes) -> None:
+        self.errors.remove(hash32)
+
+    def spawn_workers(self, runner) -> None:
+        for i in range(self.n_workers):
+            runner.spawn_worker(ResyncWorker(self, i))
+
+    # ---- the resync decision (ref: resync.rs:354-505) ------------------
+
+    async def resync_block(self, hash32: bytes) -> None:
+        m = self.manager
+        needed = m.rc.is_needed(hash32)
+        have = m.has_local(hash32)
+
+        if have and not needed and m.rc.is_deletable_now(hash32):
+            await self._offload(hash32)
+            return
+        if needed and not have:
+            await self._fetch(hash32)
+            return
+        if needed and have and m.erasure:
+            # do we hold the RIGHT shard for the current layout?
+            await self._fix_shard_placement(hash32)
+
+    async def _offload(self, hash32: bytes) -> None:
+        """Not needed here: give our copy/shard to nodes that need it,
+        then delete (ref: resync.rs:404-460)."""
+        m = self.manager
+        me = m.system.id
+        if m.erasure:
+            placement = shard_nodes_of(m.system.layout_helper.current(),
+                                       hash32, m.codec.width)
+        else:
+            placement = m.system.layout_helper.current_storage_nodes_of(hash32)
+        for node in placement:
+            if node == me:
+                continue
+            try:
+                resp, _ = await m.endpoint.call(
+                    node, {"op": "need", "hash": hash32}, PRIO_BACKGROUND
+                )
+                if not resp.get("needed"):
+                    continue
+                if m.erasure:
+                    want = placement.index(node)
+                    raw = m.read_local_shard(hash32, want)
+                    if raw is None:
+                        # rebuild their shard from what we can gather
+                        raw = await self._rebuild_shard(hash32, want)
+                    if raw is not None:
+                        await m.endpoint.call(
+                            node, {"op": "put", "hash": hash32,
+                                   "part": want, "data": raw},
+                            PRIO_BACKGROUND,
+                        )
+                else:
+                    packed = m.read_local(hash32)
+                    if packed is not None:
+                        await m.endpoint.call(
+                            node, {"op": "put", "hash": hash32,
+                                   "part": None, "data": packed},
+                            PRIO_BACKGROUND,
+                        )
+                m.metrics["resync_sent"] += 1
+            except Exception as e:
+                log.info("offload %s to %s failed: %s",
+                         hash32[:4].hex(), node[:4].hex(), e)
+                raise
+        m.delete_local(hash32)
+        m.rc.clear_deletable(hash32)
+
+    async def _fetch(self, hash32: bytes) -> None:
+        """Needed but absent: get it (ref: resync.rs:462-505)."""
+        m = self.manager
+        if not m.erasure:
+            packed = await m._get_replicate(hash32)
+            m.write_local(hash32, packed)
+            m.metrics["resync_recv"] += 1
+            return
+        # erasure: our assigned shard, fetched or rebuilt
+        placement = shard_nodes_of(m.system.layout_helper.current(),
+                                   hash32, m.codec.width)
+        me = m.system.id
+        if me not in placement:
+            return  # not a holder anymore; nothing to fetch
+        want = placement.index(me)
+        raw = await self._fetch_shard(hash32, placement, want)
+        if raw is None:
+            raw = await self._rebuild_shard(hash32, want)
+        if raw is None:
+            raise MissingBlock(hash32)
+        m.write_local_shard(hash32, want, raw)
+        m.metrics["resync_recv"] += 1
+
+    async def _fix_shard_placement(self, hash32: bytes) -> None:
+        """After a layout change we may hold shard j but be assigned
+        shard i: fetch/rebuild i; the stale j is dropped once rc says
+        deletable (or by offload on the next pass)."""
+        m = self.manager
+        placement = shard_nodes_of(m.system.layout_helper.current(),
+                                   hash32, m.codec.width)
+        me = m.system.id
+        if me not in placement:
+            return
+        want = placement.index(me)
+        if want in m.local_parts(hash32):
+            return
+        raw = await self._fetch_shard(hash32, placement, want)
+        if raw is None:
+            raw = await self._rebuild_shard(hash32, want)
+        if raw is not None:
+            m.write_local_shard(hash32, want, raw)
+
+    async def _fetch_shard(self, hash32: bytes, placement: list[bytes],
+                           idx: int) -> Optional[bytes]:
+        """Ask everyone for shard idx (an old holder may have it)."""
+        m = self.manager
+        for node in placement:
+            if node == m.system.id:
+                continue
+            try:
+                resp, _ = await m.endpoint.call(
+                    node, {"op": "get", "hash": hash32, "part": idx},
+                    PRIO_BACKGROUND,
+                )
+                if resp.get("data") is not None:
+                    return resp["data"]
+            except Exception:
+                continue
+        return None
+
+    async def _rebuild_shard(self, hash32: bytes, idx: int) -> Optional[bytes]:
+        """RS repair: gather any k parts, recompute shard idx (the TPU
+        repair matmul, ops/rs.py repair)."""
+        m = self.manager
+        placement = shard_nodes_of(m.system.layout_helper.current(),
+                                   hash32, m.codec.width)
+        got = await m._gather_parts(hash32, placement, m.codec.read_need)
+        if got is None:
+            return None
+        parts, packed_len = got
+        if idx in parts:
+            return pack_shard(parts[idx], packed_len)
+        rebuilt = m.codec.repair_parts(parts, (idx,))
+        return pack_shard(rebuilt[idx], packed_len)
+
+
+class ResyncWorker(Worker):
+    def __init__(self, resync: BlockResyncManager, i: int):
+        self.resync = resync
+        self.name = f"block resync {i}"
+
+    async def work(self):
+        h = self.resync._pop_due()
+        if h is None:
+            return WState.IDLE
+        try:
+            await self.resync.resync_block(h)
+            self.resync._clear_error(h)
+        except Exception as e:
+            log.info("resync %s failed: %s", h[:4].hex(), e)
+            self.resync._record_error(h)
+        if self.resync.tranquility > 0:
+            from ..utils.background import Throttled
+
+            return Throttled(self.resync.tranquility)
+        return WState.BUSY
+
+    async def wait_for_work(self):
+        await asyncio.sleep(1.0)
+
+    def info(self):
+        from ..utils.background import WorkerInfo
+
+        return WorkerInfo(
+            name=self.name,
+            queue_length=self.resync.queue_len(),
+            persistent_errors=self.resync.errors_len(),
+        )
