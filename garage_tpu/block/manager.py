@@ -126,22 +126,32 @@ class BlockManager:
         parts = self.codec.encode(packed)
         helper = self.system.layout_helper
         with helper.write_lock():
-            placement = shard_nodes_of(helper.current(), hash32,
-                                       self.codec.width)
-            if len(placement) < self.codec.write_quorum:
-                raise QuorumError(self.codec.write_quorum, 1, 0,
-                                  len(placement), ["cluster too small"])
-            part_of = {n: i for i, n in enumerate(placement)}
-            await self.rpc.try_call_many(
-                self.endpoint, placement, None,
+            # One shard placement per live layout version, mirroring
+            # try_write_many_sets: the write is acked only once EVERY
+            # version's placement holds a write quorum of shards, so a
+            # layout transition never weakens the ack-lock guarantee.
+            sets: list[list[tuple[bytes, int]]] = []
+            for v in helper.versions_for_writes():
+                placement = shard_nodes_of(v, hash32, self.codec.width)
+                if len(placement) < self.codec.write_quorum:
+                    raise QuorumError(self.codec.write_quorum, 1, 0,
+                                      len(placement), ["cluster too small"])
+                s = [(n, i) for i, n in enumerate(placement)]
+                if s not in sets:
+                    sets.append(s)
+            # quorum unit = placement entry (node, shard index): a node
+            # may be assigned different shard indices under different
+            # layout versions, so keys are tuples, not bare node ids
+            await self.rpc.try_write_many_sets(
+                self.endpoint, sets, None,
                 RequestStrategy(quorum=self.codec.write_quorum,
-                                prio=PRIO_NORMAL, timeout=60.0,
-                                send_all_at_once=True,
-                                interrupt_stragglers=False),
-                make_payload=lambda n: {
-                    "op": "put", "hash": hash32, "part": part_of[n],
-                    "data": pack_shard(parts[part_of[n]], len(packed)),
-                },
+                                prio=PRIO_NORMAL, timeout=60.0),
+                make_call=lambda key: self.endpoint.call(
+                    key[0],
+                    {"op": "put", "hash": hash32, "part": key[1],
+                     "data": pack_shard(parts[key[1]], len(packed))},
+                    PRIO_NORMAL, timeout=60.0,
+                ),
             )
 
     # ==== cluster read path (ref: manager.rs:243-363) ===================
@@ -353,6 +363,22 @@ class BlockManager:
             return bool(self.local_parts(hash32))
         return self._find(hash32, ["", ".zlib"]) is not None
 
+    def is_shard_needed(self, hash32: bytes) -> bool:
+        """Answer to the 'need' RPC: does this node still want data for
+        this block? In erasure mode, needed = rc-referenced AND our
+        layout-assigned shard index is missing (holding some *other*
+        stale shard doesn't satisfy the assignment)."""
+        if not self.rc.is_needed(hash32):
+            return False
+        if not self.erasure:
+            return not self.has_local(hash32)
+        placement = shard_nodes_of(self.system.layout_helper.current(),
+                                   hash32, self.codec.width)
+        me = self.system.id
+        if me not in placement:
+            return False
+        return placement.index(me) not in self.local_parts(hash32)
+
     def delete_local(self, hash32: bytes) -> None:
         for d in self.data_layout.candidate_dirs(hash32):
             if not os.path.isdir(d):
@@ -389,6 +415,61 @@ class BlockManager:
                         seen.add(h)
                         yield h, os.path.join(root, fn)
 
+    def iter_local_blocks_sorted(self, start: bytes = b""):
+        """Yield distinct hash32 in ascending hash order, resuming after
+        `start`. One pass over the tree: the on-disk layout is keyed by
+        hash prefix ({h[0]}/{h[1]}/{hex}), so walking the two prefix
+        levels in sorted order gives global hash order without holding
+        the whole listing in memory (scrub cursor resume, ref
+        repair.rs:169-232 BlockStoreIterator)."""
+        roots = [d.path for d in self.data_layout.dirs]
+        # discover which prefix dirs actually exist (a sparse store has
+        # few) instead of probing all 65,536 combinations
+        lvl1_of: dict[str, list[str]] = {}
+        for r in roots:
+            try:
+                l1s = os.listdir(r)
+            except OSError:
+                continue
+            for l1 in l1s:
+                if len(l1) == 2:
+                    lvl1_of.setdefault(l1, []).append(r)
+        start_l1 = start[:1].hex() if start else ""
+        start_l2 = start[1:2].hex() if len(start) >= 2 else ""
+        for lvl1 in sorted(lvl1_of):
+            if lvl1 < start_l1:
+                continue
+            lvl2s: dict[str, list[str]] = {}
+            for r in lvl1_of[lvl1]:
+                try:
+                    l2s = os.listdir(os.path.join(r, lvl1))
+                except OSError:
+                    continue
+                for l2 in l2s:
+                    if len(l2) == 2:
+                        lvl2s.setdefault(l2, []).append(r)
+            for lvl2 in sorted(lvl2s):
+                if lvl1 == start_l1 and lvl2 < start_l2:
+                    continue
+                names = set()
+                for r in lvl2s[lvl2]:
+                    d = os.path.join(r, lvl1, lvl2)
+                    try:
+                        names.update(os.listdir(d))
+                    except OSError:
+                        pass
+                hashes = set()
+                for fn in names:
+                    if fn.endswith((".tmp", ".corrupted")):
+                        continue
+                    try:
+                        h = bytes.fromhex(fn.split(".")[0])
+                    except ValueError:
+                        continue
+                    if len(h) == 32 and h > start:
+                        hashes.add(h)
+                yield from sorted(hashes)
+
     # ==== server side ===================================================
 
     async def _handle(self, from_node: bytes, payload, stream):
@@ -410,6 +491,5 @@ class BlockManager:
                 data = await asyncio.to_thread(self.read_local_shard, h, part)
             return {"data": data}
         if op == "need":
-            needed = self.rc.is_needed(h) and not self.has_local(h)
-            return {"needed": needed}
+            return {"needed": self.is_shard_needed(h)}
         raise RpcError(f"unknown block op {op!r}")

@@ -51,7 +51,9 @@ class BlockRc:
             tx.insert(self.tree, hash32, self._pack_count(int(v) + 1))
             return False
         tx.insert(self.tree, hash32, self._pack_count(1))
-        return state == "absent"
+        # both absent->present and deletable->present need a resync
+        # examination (ref rc.rs: old_rc.is_zero() covers Deletable too)
+        return True
 
     def block_decref(self, tx, hash32: bytes) -> bool:
         """Returns True if the block became deletable (count hit 0), so

@@ -59,6 +59,7 @@ class ScrubWorker(Worker):
                                    ScrubState)
         self.state = self.persister.load() or ScrubState()
         self._jitter = random.random() * 0.4 + 0.8  # ±20%
+        self._iter = None  # live sorted walk; rebuilt from cursor on restart
 
     def _due(self) -> bool:
         return (time.time() - self.state.last_completed
@@ -67,16 +68,28 @@ class ScrubWorker(Worker):
     async def work(self):
         if self.state.paused or not self._due():
             return WState.IDLE
-        import heapq
+        if self._iter is None:
+            # single ordered walk per pass; on restart resume after the
+            # persisted cursor instead of rescanning from the front
+            self._iter = self.manager.iter_local_blocks_sorted(
+                self.state.cursor
+            )
 
-        # disk iteration order is arbitrary; resume = smallest hashes
-        # above the persisted cursor
-        batch = heapq.nsmallest(
-            self.BATCH,
-            (h for h, _ in self.manager.iter_local_blocks()
-             if h > self.state.cursor),
-        )
+        def pull_batch():
+            batch = []
+            for h in self._iter:
+                batch.append(h)
+                if len(batch) >= self.BATCH:
+                    break
+            return batch
+
+        try:
+            batch = await asyncio.to_thread(pull_batch)
+        except Exception:
+            self._iter = None  # re-derive from cursor on retry
+            raise
         if not batch:
+            self._iter = None
             self.state.cursor = b""
             self.state.last_completed = time.time()
             self.persister.save(self.state)
@@ -84,16 +97,24 @@ class ScrubWorker(Worker):
                      self.state.corruptions)
             return WState.IDLE
         t0 = time.monotonic()
-        for h in batch:
-            ok = await asyncio.to_thread(self.scrub_one, h)
-            if not ok:
-                self.state.corruptions += 1
-            self.state.cursor = h
+        try:
+            bad = await asyncio.to_thread(self.scrub_batch, batch)
+        except Exception:
+            # the live iterator has advanced past this batch; drop it so
+            # the retry re-derives the batch from the persisted cursor
+            self._iter = None
+            raise
+        self.state.corruptions += bad
+        self.state.cursor = batch[-1]
         self.persister.save(self.state)
         dt = time.monotonic() - t0
         if self.state.tranquility > 0:
             return Throttled(self.state.tranquility * dt / max(len(batch), 1))
         return WState.BUSY
+
+    def scrub_batch(self, batch: list[bytes]) -> int:
+        """Verify a batch; returns number of corrupt blocks."""
+        return sum(0 if self.scrub_one(h) else 1 for h in batch)
 
     def scrub_one(self, hash32: bytes) -> bool:
         """Verify one block's local storage; quarantine+resync happen

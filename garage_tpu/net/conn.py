@@ -73,7 +73,10 @@ def _hmac(key: bytes, *parts: bytes) -> bytes:
 
 
 def _hkdf(secret: bytes, info: bytes) -> bytes:
-    return hashlib.blake2b(secret, key=info[:64], digest_size=32).digest()
+    # compress info (label + both ephemerals, >64 B) into a full-width
+    # key so the whole transcript context feeds key derivation
+    ikey = hashlib.blake2b(info, digest_size=64).digest()
+    return hashlib.blake2b(secret, key=ikey, digest_size=32).digest()
 
 
 class HandshakeError(RpcError):

@@ -210,15 +210,20 @@ class RpcHelper:
     async def try_write_many_sets(
         self,
         endpoint,
-        write_sets: list[list[bytes]],
+        write_sets: list[list],
         payload,
         strategy: RequestStrategy,
-        make_payload: Optional[Callable[[bytes], Any]] = None,
-        make_stream: Optional[Callable[[bytes], Any]] = None,
+        make_payload: Optional[Callable[[Any], Any]] = None,
+        make_stream: Optional[Callable[[Any], Any]] = None,
+        make_call: Optional[Callable[[Any], Any]] = None,
     ) -> QuorumSetResultTracker:
         """Write to every set with per-set quorum; left-over requests keep
         running in the background after success (so all replicas converge
-        without blocking the caller)."""
+        without blocking the caller).
+
+        Set entries are opaque quorum keys — normally node ids, but e.g.
+        the erasure block path uses (node, shard_index) tuples with a
+        `make_call` that issues the per-key RPC itself."""
         tracker = QuorumSetResultTracker(write_sets, strategy.quorum)
         if not tracker.nodes:
             # empty/unassigned layout: fail fast instead of hanging on a
@@ -226,16 +231,20 @@ class RpcHelper:
             raise tracker.quorum_error()
         result = asyncio.get_event_loop().create_future()
 
-        async def one(node: bytes):
+        async def one(key):
             try:
-                pl = make_payload(node) if make_payload else payload
-                st = make_stream(node) if make_stream else None
-                resp, _ = await endpoint.call(
-                    node, pl, strategy.prio, stream=st, timeout=strategy.timeout
-                )
-                tracker.success(node, resp)
+                if make_call is not None:
+                    resp, _ = await make_call(key)
+                else:
+                    pl = make_payload(key) if make_payload else payload
+                    st = make_stream(key) if make_stream else None
+                    resp, _ = await endpoint.call(
+                        key, pl, strategy.prio, stream=st,
+                        timeout=strategy.timeout
+                    )
+                tracker.success(key, resp)
             except Exception as e:
-                tracker.failure(node, e)
+                tracker.failure(key, e)
             if not result.done():
                 if tracker.all_quorums_ok():
                     result.set_result(True)

@@ -216,7 +216,13 @@ def test_replicate_put_get(tmp_path):
             data = os.urandom(200_000)
             h = blake2sum(data)
             await managers[0].rpc_put_block(h, data)
-            # stored on all 3 (rf=3, 3 nodes)
+            # put returns at write quorum (2/3); the third write keeps
+            # running in background by design — await convergence
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline:
+                if sum(1 for m in managers if m.has_local(h)) == 3:
+                    break
+                await asyncio.sleep(0.02)
             assert sum(1 for m in managers if m.has_local(h)) == 3
             got = await managers[2].rpc_get_block(h)
             assert got == data

@@ -209,11 +209,19 @@ def test_quorum_insert_get(tmp_path):
             # visible via any node
             got = await tables[2].get(b"bucket", b"obj1")
             assert got is not None and got.value.value == "hello"
-            # all three replicas hold it locally (rf=3, 3 nodes)
-            held = sum(
-                1 for t in tables if t.data.read_entry(b"bucket", b"obj1") is not None
-            )
-            assert held == 3
+            # all three replicas hold it locally (rf=3, 3 nodes); the
+            # insert acks at quorum 2/3 and the third write lands in
+            # background, so await convergence
+            def held():
+                return sum(
+                    1 for t in tables
+                    if t.data.read_entry(b"bucket", b"obj1") is not None
+                )
+
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline and held() < 3:
+                await asyncio.sleep(0.02)
+            assert held() == 3
         finally:
             await stop_all(systems, tasks)
 
