@@ -34,7 +34,14 @@ class Entry(migrate.Migratable):
 
 
 def partition_hash(pk: bytes) -> Hash:
-    """Ring position of a partition key (blake2, ref: util/data.rs)."""
+    """Ring position of a partition key. 32-byte keys (uuids, block
+    hashes) are already uniformly random and index the ring directly —
+    crucially this co-locates block_ref rows with their block's shard
+    placement (ref: table/schema.rs PartitionKey: identity for
+    FixedBytes32, blake2 for String). Row keys written before this rule
+    existed (pre-model-layer dev databases) are not migrated."""
+    if len(pk) == 32:
+        return pk
     return blake2sum(pk)
 
 

@@ -1,0 +1,372 @@
+"""Model-layer tests: schema CRDT/encoding round-trips, and the
+end-to-end trigger chain object -> version -> block_ref -> block rc ->
+resync deletion, on a multi-node loopback cluster (the VERDICT round-1
+done-criterion for the model layer)."""
+
+import asyncio
+import os
+
+import pytest
+
+from garage_tpu.model import (
+    Bucket,
+    BucketAlias,
+    BucketKeyPerm,
+    Garage,
+    Key,
+    is_valid_bucket_name,
+)
+from garage_tpu.model.s3 import (
+    BlockRef,
+    MultipartUpload,
+    Object,
+    ObjectVersion,
+    ObjectVersionData,
+    ObjectVersionMeta,
+    ObjectVersionState,
+    Version,
+    object_upload_version,
+)
+from garage_tpu.net import LocalNetwork
+from garage_tpu.utils import migrate
+from garage_tpu.utils.config import Config, DataDir
+from garage_tpu.utils.data import blake2sum, gen_uuid
+
+
+def run(coro, timeout=120.0):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+async def wait_until(cond, timeout=20.0, interval=0.05):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(interval)
+    return cond()
+
+
+async def make_garage_cluster(tmp_path, n=3, rf=3, erasure=None):
+    net = LocalNetwork()
+    garages = []
+    for i in range(n):
+        cfg = Config(
+            metadata_dir=str(tmp_path / f"node{i}" / "meta"),
+            data_dir=[DataDir(path=str(tmp_path / f"node{i}" / "data"))],
+            db_engine="memory",
+            replication_factor=rf,
+            erasure_coding="%d,%d" % erasure if erasure else None,
+        )
+        garages.append(Garage(cfg, local_net=net,
+                              status_interval=0.2, ping_interval=0.2))
+    tasks = [asyncio.create_task(g.run()) for g in garages]
+    for g in garages[1:]:
+        await g.netapp.try_connect(garages[0].netapp.public_addr,
+                                   garages[0].system.id)
+        g.system.peering.add_peer(garages[0].netapp.public_addr,
+                                  garages[0].system.id)
+    assert await wait_until(
+        lambda: all(len(g.netapp.conns) == n - 1 for g in garages)
+    )
+    lm = garages[0].system.layout_manager
+    from garage_tpu.rpc.layout import NodeRole
+
+    for g in garages:
+        lm.history.stage_role(g.system.id,
+                              NodeRole(zone="z1", capacity=1 << 30))
+    lm.apply_staged(None)
+    assert await wait_until(
+        lambda: all(
+            g.system.layout_manager.history.current().version == 1
+            for g in garages
+        )
+    )
+    return net, garages, tasks
+
+
+async def stop_all(garages, tasks):
+    for g in garages:
+        await g.stop()
+    for t in tasks:
+        t.cancel()
+
+
+async def put_object_like_api(g: Garage, bucket_id: bytes, key: str,
+                              data: bytes):
+    """Mimic the PUT path (api/s3/put.rs:122-300) for one-block objects:
+    object Uploading -> version -> block_ref + block -> object Complete."""
+    uuid = gen_uuid()
+    h = blake2sum(data)
+    up = object_upload_version(bucket_id, key, uuid,
+                               {"content-type": "application/octet-stream"})
+    await g.object_table.insert(up)
+    version = Version.new(uuid, ("object", bucket_id, key))
+    await g.version_table.insert(version)
+    await g.block_ref_table.insert(BlockRef.new(h, uuid))
+    await g.block_manager.rpc_put_block(h, data)
+    await g.version_table.insert(version.with_block(0, 0, h, len(data)))
+    meta = ObjectVersionMeta({"content-type": "application/octet-stream"},
+                             len(data), '"%s"' % blake2sum(data).hex())
+    ts = up.versions[0].timestamp
+    done = Object(bucket_id, key, [ObjectVersion(
+        uuid, ts,
+        ObjectVersionState.complete(ObjectVersionData.first_block(meta, h)),
+    )])
+    await g.object_table.insert(done)
+    return uuid, h
+
+
+async def delete_object_like_api(g: Garage, bucket_id: bytes, key: str):
+    """A DeleteMarker version supersedes all prior versions
+    (api/s3/delete.rs)."""
+    uuid = gen_uuid()
+    obj = Object(bucket_id, key, [ObjectVersion(
+        uuid, __import__("garage_tpu.utils.crdt", fromlist=["now_msec"]).now_msec(),
+        ObjectVersionState.complete(ObjectVersionData.delete_marker()),
+    )])
+    await g.object_table.insert(obj)
+    return uuid
+
+
+# ---- pure schema tests --------------------------------------------------
+
+
+def test_object_schema_roundtrip_and_merge():
+    bid, uid = gen_uuid(), gen_uuid()
+    meta = ObjectVersionMeta({"content-type": "text/plain"}, 11, '"abc"')
+    v_up = ObjectVersion(uid, 100, ObjectVersionState.uploading({}, False))
+    v_done = ObjectVersion(
+        uid, 100,
+        ObjectVersionState.complete(ObjectVersionData.inline(meta, b"hello world")),
+    )
+    o1 = Object(bid, "k", [v_up])
+    o2 = Object(bid, "k", [v_done])
+    m = o1.merge(o2)
+    assert len(m.versions) == 1 and m.versions[0].is_data
+    # commutative
+    m2 = o2.merge(o1)
+    assert migrate.encode(m) == migrate.encode(m2)
+    # roundtrip
+    rt = migrate.decode(Object, migrate.encode(m))
+    assert rt.key == "k" and rt.versions[0].state.data.blob == b"hello world"
+    assert rt.versions[0].state.data.meta.size == 11
+    # aborted wins
+    o3 = Object(bid, "k", [ObjectVersion(uid, 100, ObjectVersionState.aborted())])
+    assert o2.merge(o3).versions[0].state.kind == "aborted"
+    # newer complete version drops older ones
+    uid2 = gen_uuid()
+    v2 = ObjectVersion(
+        uid2, 200,
+        ObjectVersionState.complete(ObjectVersionData.delete_marker()),
+    )
+    m3 = m.merge(Object(bid, "k", [v2]))
+    assert [v.timestamp for v in m3.versions] == [200]
+    assert m3.counts() == [("objects", 0), ("unfinished_uploads", 0), ("bytes", 0)]
+
+
+def test_version_and_blockref_roundtrip():
+    uid = gen_uuid()
+    v = Version.new(uid, ("object", gen_uuid(), "some/key"))
+    v = v.with_block(1, 0, blake2sum(b"a"), 100)
+    v = v.with_block(1, 100, blake2sum(b"b"), 50)
+    rt = migrate.decode(Version, migrate.encode(v))
+    assert rt.total_size() == 150 and rt.n_parts() == 1
+    assert rt.has_part_number(1) and not rt.has_part_number(2)
+    # deleted clears blocks
+    d = rt.merge(Version(uid, __import__("garage_tpu.utils.crdt",
+                                         fromlist=["Bool"]).Bool(True),
+                         rt.blocks.clear(), rt.backlink))
+    assert d.is_tombstone() and len(d.blocks) == 0
+    br = BlockRef.new(blake2sum(b"a"), uid)
+    rt2 = migrate.decode(BlockRef, migrate.encode(br))
+    assert rt2.block == br.block and not rt2.is_tombstone()
+
+
+def test_bucket_key_schema():
+    assert is_valid_bucket_name("my-bucket.data")
+    assert not is_valid_bucket_name("My_Bucket")
+    assert not is_valid_bucket_name("ab")
+    assert not is_valid_bucket_name("192.168.1.1")
+
+    b = Bucket.new()
+    params = b.params
+    params.authorized_keys = params.authorized_keys.put(
+        "GK" + "0" * 24, BucketKeyPerm(1, True, True, False))
+    b = b.with_params(params)
+    rt = migrate.decode(Bucket, migrate.encode(b))
+    assert rt.authorized("GK" + "0" * 24).allow_write
+    assert not rt.authorized("GK" + "1" * 24).allow_read
+
+    k = Key.new("test-key")
+    assert k.key_id.startswith("GK") and len(k.key_id) == 26
+    rt = migrate.decode(Key, migrate.encode(k))
+    assert rt.params.name.value == "test-key"
+    assert not rt.allow_read(b.id)
+    # permission tie-break: most restricted
+    p1 = BucketKeyPerm(5, True, True, True)
+    p2 = BucketKeyPerm(5, True, False, True)
+    assert p1.merge(p2) == BucketKeyPerm(5, True, False, True)
+
+    a = BucketAlias.new("my-bucket", b.id)
+    rt = migrate.decode(BucketAlias, migrate.encode(a))
+    assert rt.bucket_id == b.id and not rt.is_deleted
+    assert BucketAlias.new("Bad_Name", b.id) is None
+
+
+def test_mpu_schema():
+    up = MultipartUpload.new(gen_uuid(), 123, gen_uuid(), "key")
+    ts = up.next_timestamp(1)
+    from garage_tpu.model.s3 import MpuPart
+
+    up.parts = up.parts.put((1, ts), MpuPart(gen_uuid(), '"e1"', 500))
+    rt = migrate.decode(MultipartUpload, migrate.encode(up))
+    assert rt.counts() == [("uploads", 1), ("parts", 1), ("bytes", 500)]
+    # deletion clears parts
+    tomb = MultipartUpload.new(up.upload_id, 123, up.bucket_id, "key",
+                               deleted=True)
+    m = rt.merge(tomb)
+    assert m.is_tombstone() and len(m.parts) == 0
+
+
+# ---- cluster tests ------------------------------------------------------
+
+
+def test_object_lifecycle_end_to_end(tmp_path):
+    """Insert an object -> block refs + rc appear on all holders;
+    delete it -> versions/block_refs tombstone, rc hits deletable,
+    resync removes the data files (VERDICT item 1 done-criterion)."""
+
+    async def main():
+        net, garages, tasks = await make_garage_cluster(tmp_path)
+        try:
+            for g in garages:
+                g.block_manager.rc.gc_delay = 0.0
+            bucket_id = gen_uuid()
+            data = os.urandom(100_000)
+            uuid, h = await put_object_like_api(garages[0], bucket_id,
+                                                "hello.bin", data)
+            # all 3 nodes hold the block and a present rc
+            assert await wait_until(lambda: all(
+                g.block_manager.has_local(h) for g in garages))
+            assert await wait_until(lambda: all(
+                g.block_manager.rc.get(h)[0] == "present" for g in garages))
+            # object readable from any node
+            got = await garages[2].object_table.get(bucket_id, b"hello.bin")
+            assert got is not None and got.last_data() is not None
+            assert got.last_data().state.data.blob == h
+            blk = await garages[1].block_manager.rpc_get_block(h)
+            assert blk == data
+
+            # delete: marker supersedes -> triggers cascade
+            await delete_object_like_api(garages[0], bucket_id, "hello.bin")
+            assert await wait_until(lambda: all(
+                g.block_manager.rc.get(h)[0] != "present" for g in garages))
+            # resync workers offload+delete the now-unneeded files
+            assert await wait_until(lambda: not any(
+                g.block_manager.has_local(h) for g in garages), timeout=30)
+        finally:
+            await stop_all(garages, tasks)
+
+    run(main())
+
+
+def test_object_counter_counts(tmp_path):
+    async def main():
+        net, garages, tasks = await make_garage_cluster(tmp_path)
+        try:
+            bucket_id = gen_uuid()
+            await put_object_like_api(garages[0], bucket_id, "a", b"x" * 1000)
+            await put_object_like_api(garages[0], bucket_id, "b", b"y" * 500)
+            nodes = [g.system.id for g in garages]
+            counter = garages[0].object_counter
+
+            async def totals():
+                return await counter.read(bucket_id, b"", nodes)
+
+            async def check():
+                t = await totals()
+                return t.get("objects") == 2 and t.get("bytes") == 1500
+
+            deadline = asyncio.get_event_loop().time() + 20
+            ok = False
+            while asyncio.get_event_loop().time() < deadline and not ok:
+                ok = await check()
+                if not ok:
+                    await asyncio.sleep(0.1)
+            assert ok, await totals()
+        finally:
+            await stop_all(garages, tasks)
+
+    run(main())
+
+
+def test_erasure_block_ref_reaches_all_shard_holders(tmp_path):
+    """ADVICE round-1 medium: with erasure(k,m) where k+m > rf, block_ref
+    rows (and therefore rc state) must reach all k+m shard holders so
+    each holder manages its shard lifecycle."""
+
+    async def main():
+        net, garages, tasks = await make_garage_cluster(
+            tmp_path, n=6, rf=3, erasure=(4, 2))
+        try:
+            bucket_id = gen_uuid()
+            data = os.urandom(64_000)
+            uuid, h = await put_object_like_api(garages[0], bucket_id,
+                                               "wide.bin", data)
+            # every node holds exactly one shard, and every holder's rc
+            # is present (block_ref replicated to the full width)
+            assert await wait_until(lambda: sorted(
+                i for g in garages for i in g.block_manager.local_parts(h)
+            ) == [0, 1, 2, 3, 4, 5], timeout=30)
+            assert await wait_until(lambda: all(
+                g.block_manager.rc.get(h)[0] == "present" for g in garages),
+                timeout=30)
+            # destroy one shard; its holder heals itself via resync
+            victim = next(g for g in garages
+                          if 2 in g.block_manager.local_parts(h))
+            victim.block_manager.delete_local(h)
+            victim.block_manager.resync.push_now(h)
+            assert await wait_until(
+                lambda: victim.block_manager.local_parts(h) == [2],
+                timeout=30)
+            got = await garages[5].block_manager.rpc_get_block(h)
+            assert got == data
+        finally:
+            await stop_all(garages, tasks)
+
+    run(main())
+
+
+def test_bucket_key_tables_fullcopy(tmp_path):
+    async def main():
+        net, garages, tasks = await make_garage_cluster(tmp_path)
+        try:
+            b = Bucket.new()
+            await garages[0].bucket_table.insert(b)
+            k = Key.new("app")
+            await garages[0].key_table.insert(k)
+            a = BucketAlias.new("my-bucket", b.id)
+            await garages[0].bucket_alias_table.insert(a)
+
+            # full-copy: local read on any node sees them (after sync)
+            async def visible():
+                got_b = await garages[2].bucket_table.get(b.id, b"")
+                got_k = await garages[1].key_table.get(
+                    b"", k.key_id.encode())
+                got_a = await garages[2].bucket_alias_table.get(
+                    b"", b"my-bucket")
+                return (got_b is not None and got_k is not None
+                        and got_a is not None
+                        and got_a.bucket_id == b.id)
+
+            deadline = asyncio.get_event_loop().time() + 20
+            ok = False
+            while asyncio.get_event_loop().time() < deadline and not ok:
+                ok = await visible()
+                if not ok:
+                    await asyncio.sleep(0.1)
+            assert ok
+        finally:
+            await stop_all(garages, tasks)
+
+    run(main())
