@@ -1,0 +1,5 @@
+"""Admin control surface: in-cluster RPC + HTTP admin/metrics API."""
+
+from .rpc import AdminRpcHandler
+
+__all__ = ["AdminRpcHandler"]

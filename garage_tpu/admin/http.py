@@ -1,0 +1,112 @@
+"""Admin HTTP API: /health, /metrics (Prometheus text), /status.
+
+Ref parity: src/api/admin/api_server.rs:232-330 + rpc/system_metrics.rs.
+Bearer-token auth via admin_token/metrics_token config; /health is
+always public (used by load balancers).
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..api.http import HttpServer, Request, Response
+
+
+class AdminHttpServer:
+    def __init__(self, garage):
+        self.garage = garage
+        self.http = HttpServer(self.handle, name="admin")
+
+    async def start(self, host: str, port: int) -> None:
+        await self.http.start(host, port)
+
+    async def stop(self) -> None:
+        await self.http.stop()
+
+    def _authorized(self, req: Request, token) -> bool:
+        if token is None:
+            return True
+        return req.header("authorization") == f"Bearer {token}"
+
+    async def handle(self, req: Request) -> Response:
+        path = req.path
+        if path == "/health":
+            h = self.garage.system.health()
+            status = 200 if h.status.value != "unavailable" else 503
+            return Response(status, [("content-type", "text/plain")],
+                            f"{h.status.value}\n".encode())
+        if path == "/metrics":
+            if not self._authorized(req, self.garage.config.metrics_token):
+                return Response(403, [], b"forbidden")
+            return Response(200,
+                            [("content-type",
+                              "text/plain; version=0.0.4")],
+                            self.render_metrics().encode())
+        if path in ("/status", "/v1/status"):
+            if not self._authorized(req, self.garage.config.admin_token):
+                return Response(403, [], b"forbidden")
+            from .rpc import AdminRpcHandler
+
+            h = self.garage.system.health()
+            body = {
+                "node": self.garage.system.id.hex(),
+                "garageVersion": "garage-tpu-0.2",
+                "clusterHealth": h.status.value,
+                "knownNodes": h.known_nodes,
+                "connectedNodes": h.connected_nodes,
+                "layoutVersion":
+                    self.garage.system.layout_manager.history.current().version,
+            }
+            return Response(200, [("content-type", "application/json")],
+                            json.dumps(body).encode())
+        return Response(404, [], b"not found")
+
+    def render_metrics(self) -> str:
+        """Prometheus text exposition from live counters
+        (ref: rpc/system_metrics.rs, block/metrics.rs,
+        table/metrics.rs)."""
+        g = self.garage
+        out = []
+
+        def gauge(name, value, help_="", **labels):
+            if help_:
+                out.append(f"# HELP {name} {help_}")
+                out.append(f"# TYPE {name} gauge")
+            lab = ",".join(f'{k}="{v}"' for k, v in labels.items())
+            out.append(f"{name}{{{lab}}} {value}" if lab
+                       else f"{name} {value}")
+
+        h = g.system.health()
+        gauge("cluster_healthy", 1 if h.status.value == "healthy" else 0,
+              "Whether the cluster is fully healthy")
+        gauge("cluster_available", 1 if h.status.value != "unavailable" else 0)
+        gauge("cluster_known_nodes", h.known_nodes)
+        gauge("cluster_connected_nodes", h.connected_nodes)
+        gauge("cluster_storage_nodes", h.storage_nodes)
+        gauge("cluster_storage_nodes_up", h.storage_nodes_up)
+        gauge("cluster_partitions_quorum", h.partitions_quorum)
+        gauge("cluster_layout_version",
+              g.system.layout_manager.history.current().version)
+
+        out.append("# TYPE block_manager_bytes counter")
+        for k, v in g.block_manager.metrics.items():
+            gauge(f"block_{k}", v)
+        gauge("block_resync_queue_length",
+              g.block_manager.resync.queue_len(),
+              "Number of blocks in the resync queue")
+        gauge("block_resync_errored_blocks",
+              g.block_manager.resync.errors_len())
+
+        for t in g.all_tables():
+            s = t.data.stats()
+            for k, v in s.items():
+                gauge(f"table_{k}", v, table=t.name)
+
+        for wid, info in g.runner.worker_info().items():
+            gauge("worker_busy", 1 if info.state == "busy" else 0,
+                  worker=info.name)
+            if info.queue_length is not None:
+                gauge("worker_queue_length", info.queue_length,
+                      worker=info.name)
+            gauge("worker_errors", info.errors, worker=info.name)
+        return "\n".join(out) + "\n"

@@ -1,0 +1,244 @@
+"""Admin RPC: the operator control surface of a node.
+
+Ref parity: src/garage/admin/mod.rs:42-530 (AdminRpcHandler). The CLI
+connects to any node over the normal net layer and drives cluster
+management ops: status, layout staging/apply, bucket/key CRUD, worker
+and stats introspection. Endpoint: "garage_tpu/admin".
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..model.helper import GarageHelper, allow_all
+from ..rpc.layout.version import NodeRole
+from ..utils.error import BadRequest, GarageError
+
+log = logging.getLogger("garage_tpu.admin")
+
+
+class AdminRpcHandler:
+    def __init__(self, garage):
+        self.garage = garage
+        self.helper = GarageHelper(garage)
+        self.endpoint = garage.netapp.endpoint("garage_tpu/admin")
+        self.endpoint.set_handler(self._handle)
+
+    async def _handle(self, from_node, payload, stream):
+        op = payload.get("op")
+        fn = getattr(self, f"op_{op}", None)
+        if fn is None:
+            raise GarageError(f"unknown admin op {op!r}")
+        return await fn(payload)
+
+    # ---- cluster -------------------------------------------------------
+
+    async def op_status(self, p):
+        sys = self.garage.system
+        h = sys.health()
+        nodes = [
+            {"id": n.id, "addr": list(n.addr) if n.addr else None,
+             "is_up": n.is_up,
+             "hostname": n.status.hostname if n.status else "",
+             "role": self._role_of(n.id)}
+            for n in sys.get_known_nodes()
+        ]
+        return {
+            "node_id": sys.id,
+            "health": {
+                "status": h.status.value,
+                "known_nodes": h.known_nodes,
+                "connected_nodes": h.connected_nodes,
+                "storage_nodes": h.storage_nodes,
+                "storage_nodes_up": h.storage_nodes_up,
+                "partitions_quorum": h.partitions_quorum,
+            },
+            "layout_version": sys.layout_manager.history.current().version,
+            "nodes": nodes,
+        }
+
+    def _role_of(self, node_id):
+        role = self.garage.system.layout_manager.history.current().node_role(
+            node_id)
+        if role is None:
+            return None
+        return {"zone": role.zone, "capacity": role.capacity,
+                "tags": list(role.tags)}
+
+    async def op_connect(self, p):
+        addr = tuple(p["addr"])
+        await self.garage.netapp.try_connect(
+            addr, bytes(p["id"]) if p.get("id") else None)
+        self.garage.system.peering.add_peer(
+            addr, bytes(p["id"]) if p.get("id") else None)
+        return {"ok": True}
+
+    # ---- layout --------------------------------------------------------
+
+    async def op_layout_show(self, p):
+        hist = self.garage.system.layout_manager.history
+        cur = hist.current()
+        roles = {}
+        for nid in hist.all_storage_nodes():
+            r = cur.node_role(nid)
+            if r:
+                roles[nid.hex()] = {"zone": r.zone, "capacity": r.capacity,
+                                    "tags": list(r.tags)}
+        staged = {
+            nid.hex(): ({"zone": r.zone, "capacity": r.capacity,
+                         "tags": list(r.tags)} if r else None)
+            for nid, r in hist.staged_roles().items()
+        }
+        return {"version": cur.version, "roles": roles, "staged": staged}
+
+    async def op_layout_assign(self, p):
+        lm = self.garage.system.layout_manager
+        role = NodeRole(zone=p.get("zone", "dc1"),
+                        capacity=p.get("capacity"),
+                        tags=tuple(p.get("tags", [])))
+        lm.history.stage_role(bytes(p["node"]), role)
+        await lm.broadcast()
+        return {"ok": True}
+
+    async def op_layout_remove(self, p):
+        lm = self.garage.system.layout_manager
+        lm.history.stage_role(bytes(p["node"]), None)
+        await lm.broadcast()
+        return {"ok": True}
+
+    async def op_layout_apply(self, p):
+        lm = self.garage.system.layout_manager
+        lm.apply_staged(p.get("version"))
+        return {"version": lm.history.current().version}
+
+    # ---- buckets -------------------------------------------------------
+
+    async def op_bucket_list(self, p):
+        aliases = await self.helper.list_buckets()
+        return {"buckets": [
+            {"name": a.name, "id": a.bucket_id.hex()} for a in aliases
+        ]}
+
+    async def op_bucket_create(self, p):
+        b = await self.helper.create_bucket(p["name"])
+        return {"id": b.id.hex()}
+
+    async def op_bucket_delete(self, p):
+        bid = await self.helper.resolve_global_bucket_name(p["name"])
+        if bid is None:
+            raise BadRequest(f"no bucket {p['name']!r}")
+        await self.helper.delete_bucket(bid)
+        return {"ok": True}
+
+    async def op_bucket_info(self, p):
+        bid = await self.helper.resolve_global_bucket_name(p["name"])
+        if bid is None:
+            raise BadRequest(f"no bucket {p['name']!r}")
+        b = await self.helper.get_existing_bucket(bid)
+        counters = await self.garage.object_counter.read(
+            bid, b"", list(self.garage.system.layout_manager.history
+                           .all_nongateway_nodes()))
+        return {
+            "id": bid.hex(),
+            "aliases": [a for a, v in b.params.aliases.items() if v],
+            "keys": [k for k, perm in b.params.authorized_keys.items()
+                     if perm.is_any],
+            "objects": counters.get("objects", 0),
+            "bytes": counters.get("bytes", 0),
+            "unfinished_uploads": counters.get("unfinished_uploads", 0),
+        }
+
+    async def op_bucket_allow(self, p):
+        bid = await self.helper.resolve_global_bucket_name(p["bucket"])
+        if bid is None:
+            raise BadRequest(f"no bucket {p['bucket']!r}")
+        key = await self.helper.get_existing_key(p["key"])
+        from ..model.permission import BucketKeyPerm
+        from ..utils.crdt import now_msec
+
+        perm = key.bucket_permissions(bid)
+        new = BucketKeyPerm(
+            now_msec(),
+            perm.allow_read or bool(p.get("read")),
+            perm.allow_write or bool(p.get("write")),
+            perm.allow_owner or bool(p.get("owner")),
+        )
+        await self.helper.set_bucket_key_permissions(bid, key.key_id, new)
+        return {"ok": True}
+
+    async def op_bucket_deny(self, p):
+        bid = await self.helper.resolve_global_bucket_name(p["bucket"])
+        if bid is None:
+            raise BadRequest(f"no bucket {p['bucket']!r}")
+        key = await self.helper.get_existing_key(p["key"])
+        from ..model.permission import BucketKeyPerm
+        from ..utils.crdt import now_msec
+
+        perm = key.bucket_permissions(bid)
+        new = BucketKeyPerm(
+            now_msec(),
+            perm.allow_read and not p.get("read"),
+            perm.allow_write and not p.get("write"),
+            perm.allow_owner and not p.get("owner"),
+        )
+        await self.helper.set_bucket_key_permissions(bid, key.key_id, new)
+        return {"ok": True}
+
+    # ---- keys ----------------------------------------------------------
+
+    async def op_key_new(self, p):
+        k = await self.helper.create_key(p.get("name", ""))
+        return {"key_id": k.key_id, "secret_key": k.params.secret_key}
+
+    async def op_key_list(self, p):
+        keys = await self.helper.list_keys()
+        return {"keys": [
+            {"id": k.key_id,
+             "name": k.params.name.value if k.params else ""}
+            for k in keys
+        ]}
+
+    async def op_key_info(self, p):
+        k = await self.helper.get_existing_key(p["key"])
+        return {
+            "id": k.key_id,
+            "name": k.params.name.value,
+            "secret_key": k.params.secret_key if p.get("show_secret") else None,
+            "buckets": {bid.hex(): {"read": perm.allow_read,
+                                    "write": perm.allow_write,
+                                    "owner": perm.allow_owner}
+                        for bid, perm in k.params.authorized_buckets.items()},
+        }
+
+    async def op_key_delete(self, p):
+        await self.helper.delete_key(p["key"])
+        return {"ok": True}
+
+    async def op_key_import(self, p):
+        from ..model.key_table import Key
+
+        k = Key.import_key(p["key_id"], p["secret_key"], p.get("name", ""))
+        await self.garage.key_table.insert(k)
+        return {"key_id": k.key_id}
+
+    # ---- workers / stats ----------------------------------------------
+
+    async def op_worker_list(self, p):
+        infos = self.garage.runner.worker_info()
+        return {"workers": [
+            {"id": wid, "name": i.name, "state": getattr(i, "state", ""),
+             "queue": i.queue_length, "errors": i.persistent_errors,
+             "tranquility": i.tranquility, "progress": i.progress}
+            for wid, i in sorted(infos.items())
+        ]}
+
+    async def op_stats(self, p):
+        g = self.garage
+        tables = {t.name: t.data.stats() for t in g.all_tables()}
+        return {
+            "tables": tables,
+            "block": dict(g.block_manager.metrics),
+            "resync_queue": g.block_manager.resync.queue_len(),
+            "resync_errors": g.block_manager.resync.errors_len(),
+            "http": {},
+        }

@@ -1,0 +1,7 @@
+"""HTTP API layer (S3, admin; ref: src/api/)."""
+
+from .http import HttpError, HttpServer, Request, Response
+from .signature import verify_request
+
+__all__ = ["HttpError", "HttpServer", "Request", "Response",
+           "verify_request"]

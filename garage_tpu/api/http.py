@@ -1,0 +1,306 @@
+"""Minimal asyncio HTTP/1.1 server for the API frontends.
+
+Ref parity: src/api/common/generic_server.rs:48-330 (there: hyper). No
+third-party HTTP dependency: requests are parsed from the stream, bodies
+are exposed as a bounded async reader (content-length or chunked), and
+responses stream either bytes or an async byte-chunk generator.
+Keep-alive and graceful shutdown (drain live connections) included.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import AsyncIterator, Callable, Optional
+from urllib.parse import unquote_plus
+
+log = logging.getLogger("garage_tpu.api.http")
+
+MAX_HEADER_BYTES = 64 * 1024
+MAX_LINE = 16 * 1024
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, reason: str = ""):
+        self.status = status
+        self.reason = reason or STATUS_REASONS.get(status, "Error")
+        super().__init__(f"{status} {self.reason}")
+
+
+STATUS_REASONS = {
+    100: "Continue", 200: "OK", 204: "No Content", 206: "Partial Content",
+    301: "Moved Permanently", 304: "Not Modified", 307: "Temporary Redirect",
+    400: "Bad Request", 403: "Forbidden", 404: "Not Found",
+    405: "Method Not Allowed", 409: "Conflict", 411: "Length Required",
+    412: "Precondition Failed", 413: "Payload Too Large",
+    416: "Range Not Satisfiable", 500: "Internal Server Error",
+    501: "Not Implemented", 503: "Service Unavailable",
+}
+
+
+class BodyReader:
+    """Bounded body reader over the connection stream."""
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 content_length: Optional[int], chunked: bool):
+        self.r = reader
+        self.remaining = content_length
+        self.chunked = chunked
+        self._chunk_left = 0
+        self._done = content_length == 0 and not chunked
+
+    async def read(self, n: int = 65536) -> bytes:
+        """Next ≤ n body bytes; b"" at end."""
+        if self._done:
+            return b""
+        if self.chunked:
+            return await self._read_chunked(n)
+        take = min(n, self.remaining)
+        data = await self.r.read(take)
+        if not data:
+            raise HttpError(400, "truncated body")
+        self.remaining -= len(data)
+        if self.remaining == 0:
+            self._done = True
+        return data
+
+    async def _read_chunked(self, n: int) -> bytes:
+        if self._chunk_left == 0:
+            line = await self.r.readline()
+            if not line:
+                raise HttpError(400, "truncated chunked body")
+            try:
+                size = int(line.split(b";")[0].strip(), 16)
+            except ValueError:
+                raise HttpError(400, "bad chunk size")
+            if size == 0:
+                # trailers until blank line
+                while True:
+                    t = await self.r.readline()
+                    if t in (b"\r\n", b"\n", b""):
+                        break
+                self._done = True
+                return b""
+            self._chunk_left = size
+        data = await self.r.read(min(n, self._chunk_left))
+        if not data:
+            raise HttpError(400, "truncated chunk")
+        self._chunk_left -= len(data)
+        if self._chunk_left == 0:
+            await self.r.readexactly(2)  # CRLF
+        return data
+
+    async def read_all(self, limit: int = 1 << 30) -> bytes:
+        out = bytearray()
+        while True:
+            chunk = await self.read()
+            if not chunk:
+                return bytes(out)
+            out.extend(chunk)
+            if len(out) > limit:
+                raise HttpError(413)
+
+    async def drain(self) -> None:
+        try:
+            while await self.read(1 << 20):
+                pass
+        except HttpError:
+            pass
+
+
+class Request:
+    __slots__ = ("method", "raw_path", "raw_query", "path", "query",
+                 "headers", "body", "peer", "version")
+
+    def __init__(self, method: str, raw_path: str, raw_query: str, path: str,
+                 query: dict[str, str], headers: dict[str, str],
+                 body: BodyReader, peer, version: str):
+        self.method = method
+        self.raw_path = raw_path  # undecoded path, needed for SigV4
+        self.raw_query = raw_query  # undecoded query string, for SigV4
+        self.path = path
+        self.query = query  # decoded; empty-valued keys present as ""
+        self.headers = headers  # lowercased names
+        self.body = body
+        self.peer = peer
+        self.version = version
+
+    def header(self, name: str, default: Optional[str] = None) -> Optional[str]:
+        return self.headers.get(name.lower(), default)
+
+
+class Response:
+    def __init__(self, status: int = 200,
+                 headers: Optional[list[tuple[str, str]]] = None,
+                 body: bytes | AsyncIterator[bytes] = b""):
+        self.status = status
+        self.headers = headers or []
+        self.body = body
+
+
+def parse_query(qs: str) -> tuple[dict[str, str], list[tuple[str, str]]]:
+    """-> (decoded dict, raw pair list in order). Keys with no '=' map
+    to ""."""
+    d: dict[str, str] = {}
+    raw: list[tuple[str, str]] = []
+    if not qs:
+        return d, raw
+    for part in qs.split("&"):
+        if not part:
+            continue
+        if "=" in part:
+            k, _, v = part.partition("=")
+        else:
+            k, v = part, ""
+        raw.append((k, v))
+        d[unquote_plus(k)] = unquote_plus(v)
+    return d, raw
+
+
+async def read_request(reader: asyncio.StreamReader,
+                       peer) -> Optional[Request]:
+    """Parse one request head; None on clean EOF."""
+    try:
+        line = await reader.readline()
+    except (ConnectionError, asyncio.IncompleteReadError):
+        return None
+    if not line:
+        return None
+    if len(line) > MAX_LINE:
+        raise HttpError(400, "request line too long")
+    try:
+        method, target, version = line.decode("ascii").split()
+    except ValueError:
+        raise HttpError(400, "malformed request line")
+    headers: dict[str, str] = {}
+    total = 0
+    while True:
+        h = await reader.readline()
+        total += len(h)
+        if total > MAX_HEADER_BYTES:
+            raise HttpError(400, "headers too large")
+        if h in (b"\r\n", b"\n"):
+            break
+        if not h:
+            raise HttpError(400, "truncated headers")
+        name, _, value = h.decode("latin-1").partition(":")
+        name = name.strip().lower()
+        value = value.strip()
+        if name in headers:
+            headers[name] += "," + value
+        else:
+            headers[name] = value
+    raw_path, _, qs = target.partition("?")
+    query, _ = parse_query(qs)
+    te = headers.get("transfer-encoding", "").lower()
+    chunked = "chunked" in te
+    cl = headers.get("content-length")
+    clen = int(cl) if cl is not None and not chunked else (None if chunked else 0)
+    body = BodyReader(reader, clen, chunked)
+    # decode path segments (keep raw for signing)
+    from urllib.parse import unquote
+
+    path = unquote(raw_path)
+    return Request(method, raw_path, qs, path, query, headers, body, peer,
+                   version)
+
+
+async def write_response(writer: asyncio.StreamWriter, req: Optional[Request],
+                         resp: Response, keep_alive: bool) -> None:
+    head = [f"HTTP/1.1 {resp.status} {STATUS_REASONS.get(resp.status, 'X')}"]
+    names = {n.lower() for n, _ in resp.headers}
+    body = resp.body
+    fixed = isinstance(body, (bytes, bytearray))
+    if fixed and "content-length" not in names:
+        resp.headers.append(("content-length", str(len(body))))
+    if not fixed:
+        resp.headers.append(("transfer-encoding", "chunked"))
+    if "connection" not in names:
+        resp.headers.append(("connection", "keep-alive" if keep_alive else "close"))
+    for n, v in resp.headers:
+        head.append(f"{n}: {v}")
+    writer.write(("\r\n".join(head) + "\r\n\r\n").encode("latin-1"))
+    if req is not None and req.method == "HEAD":
+        await writer.drain()
+        return
+    if fixed:
+        writer.write(bytes(body))
+        await writer.drain()
+    else:
+        async for chunk in body:
+            if chunk:
+                writer.write(b"%x\r\n" % len(chunk) + bytes(chunk) + b"\r\n")
+                await writer.drain()
+        writer.write(b"0\r\n\r\n")
+        await writer.drain()
+
+
+class HttpServer:
+    """ref: generic_server.rs ApiServer::run_server."""
+
+    def __init__(self, handler: Callable, name: str = "api"):
+        self.handler = handler  # async (Request) -> Response
+        self.name = name
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._conns: set[asyncio.Task] = set()
+        self.bound_port: Optional[int] = None
+        self.metrics = {"requests": 0, "errors": 0}
+
+    async def start(self, host: str, port: int) -> None:
+        self._server = await asyncio.start_server(self._conn, host, port)
+        self.bound_port = self._server.sockets[0].getsockname()[1]
+        log.info("%s server listening on %s:%d", self.name, host, self.bound_port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for t in list(self._conns):
+            t.cancel()
+
+    async def _conn(self, reader: asyncio.StreamReader,
+                    writer: asyncio.StreamWriter) -> None:
+        t = asyncio.current_task()
+        self._conns.add(t)
+        peer = writer.get_extra_info("peername")
+        try:
+            while True:
+                try:
+                    req = await read_request(reader, peer)
+                except HttpError as e:
+                    await write_response(
+                        writer, None, Response(e.status), False)
+                    break
+                if req is None:
+                    break
+                if req.header("expect", "").lower() == "100-continue":
+                    writer.write(b"HTTP/1.1 100 Continue\r\n\r\n")
+                    await writer.drain()
+                keep = req.header("connection", "").lower() != "close"
+                self.metrics["requests"] += 1
+                try:
+                    resp = await self.handler(req)
+                except HttpError as e:
+                    resp = Response(e.status, [("content-type", "text/plain")],
+                                    e.reason.encode())
+                except Exception:
+                    log.exception("%s handler error", self.name)
+                    self.metrics["errors"] += 1
+                    resp = Response(500, [("content-type", "text/plain")],
+                                    b"internal error")
+                try:
+                    await req.body.drain()  # finish consuming the body
+                except Exception:
+                    keep = False
+                try:
+                    await write_response(writer, req, resp, keep)
+                except (ConnectionError, asyncio.CancelledError):
+                    break
+                if not keep:
+                    break
+        finally:
+            self._conns.discard(t)
+            try:
+                writer.close()
+            except Exception:
+                pass

@@ -1,0 +1,184 @@
+"""S3 API server: routing, auth, and dispatch.
+
+Ref parity: src/api/s3/api_server.rs + router.rs:20-1109 (routing is by
+method + path + query markers). Bucket addressing is path-style
+(`/bucket/key...`) or vhost-style (`bucket.root_domain`).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ...model.helper import GarageHelper
+from ...utils.error import BadRequest, NoSuchBucket, NoSuchKey
+from ..http import HttpError, HttpServer, Request, Response
+from ..signature import verify_request, wrap_body
+from . import bucket as bucket_handlers
+from . import delete as delete_handlers
+from . import get as get_handlers
+from . import list as list_handlers
+from . import multipart as multipart_handlers
+from . import put as put_handlers
+from .xml import S3Error, access_denied, no_such_bucket
+
+log = logging.getLogger("garage_tpu.api.s3")
+
+
+class ReqCtx:
+    """Per-request context handed to handlers (ref: api_server.rs
+    ReqCtx)."""
+
+    __slots__ = ("garage", "bucket_id", "bucket_name", "bucket", "key",
+                 "api_key", "verified")
+
+    def __init__(self, garage, bucket_id, bucket_name, bucket, key,
+                 api_key, verified):
+        self.garage = garage
+        self.bucket_id = bucket_id
+        self.bucket_name = bucket_name
+        self.bucket = bucket
+        self.key = key  # object key (str) or None
+        self.api_key = api_key
+        self.verified = verified
+
+
+class S3ApiServer:
+    def __init__(self, garage, region: Optional[str] = None,
+                 root_domain: Optional[str] = None):
+        self.garage = garage
+        self.helper = GarageHelper(garage)
+        self.region = region or garage.config.s3_region
+        self.root_domain = root_domain or garage.config.root_domain
+        self.http = HttpServer(self.handle, name="s3")
+
+    async def start(self, host: str, port: int) -> None:
+        await self.http.start(host, port)
+
+    async def stop(self) -> None:
+        await self.http.stop()
+
+    # ---- request entry -------------------------------------------------
+
+    def _split_bucket_key(self, req: Request) -> tuple[Optional[str], Optional[str]]:
+        host = (req.header("host") or "").split(":")[0]
+        path = req.path.lstrip("/")
+        if host.endswith(self.root_domain) and host != self.root_domain.lstrip("."):
+            bucket = host[: -len(self.root_domain)]
+            return bucket, (path or None)
+        if not path:
+            return None, None
+        bucket, _, key = path.partition("/")
+        return bucket, (key or None)
+
+    async def handle(self, req: Request) -> Response:
+        try:
+            return await self._handle(req)
+        except S3Error as e:
+            return e.response()
+        except HttpError as e:
+            return S3Error("InvalidRequest", e.status, e.reason).response()
+        except NoSuchBucket as e:
+            return no_such_bucket(str(e)).response()
+        except NoSuchKey as e:
+            return S3Error("NoSuchKey", 404, str(e)).response()
+        except BadRequest as e:
+            return S3Error("InvalidRequest", 400, str(e)).response()
+
+    async def _handle(self, req: Request) -> Response:
+        verified = await verify_request(req, self.region,
+                                        self.helper.key_secret)
+        req.body = wrap_body(req, verified, self.region)
+        bucket_name, key = self._split_bucket_key(req)
+
+        api_key = None
+        if verified is not None:
+            api_key = await self.helper.get_existing_key(verified.key_id)
+
+        if bucket_name is None:
+            if req.method == "GET":
+                if api_key is None:
+                    raise access_denied("authentication required")
+                return await list_handlers.handle_list_buckets(
+                    self.helper, api_key)
+            raise S3Error("InvalidRequest", 400, "no bucket specified")
+
+        # CreateBucket resolves no existing bucket
+        if req.method == "PUT" and key is None and not req.query:
+            if api_key is None:
+                raise access_denied("authentication required")
+            return await bucket_handlers.handle_create_bucket(
+                self.helper, bucket_name, api_key, self.region, req)
+
+        bucket_id = await self.helper.resolve_global_bucket_name(bucket_name)
+        if bucket_id is None:
+            raise no_such_bucket(bucket_name)
+        bucket = await self.helper.get_existing_bucket(bucket_id)
+
+        # authorization (ref: api_server.rs:96-171)
+        if api_key is not None:
+            allowed = (api_key.allow_read(bucket_id)
+                       if req.method in ("GET", "HEAD")
+                       else api_key.allow_write(bucket_id))
+            if req.method == "DELETE" and key is None:
+                allowed = api_key.allow_owner(bucket_id)
+        else:
+            allowed = False  # no anonymous access (website server differs)
+        if not allowed:
+            raise access_denied()
+
+        ctx = ReqCtx(self.garage, bucket_id, bucket_name, bucket, key,
+                     api_key, verified)
+        return await self._route(req, ctx)
+
+    # ---- router (ref: router.rs:20-1109) -------------------------------
+
+    async def _route(self, req: Request, ctx: ReqCtx) -> Response:
+        m, q = req.method, req.query
+        if ctx.key is None:
+            # bucket-level ops
+            if m in ("GET", "HEAD"):
+                if "uploads" in q:
+                    return await list_handlers.handle_list_multipart_uploads(
+                        ctx, req)
+                if "location" in q:
+                    return bucket_handlers.handle_get_bucket_location(
+                        self.region)
+                if "versioning" in q:
+                    return bucket_handlers.handle_get_bucket_versioning()
+                if m == "HEAD":
+                    return Response(200)
+                if q.get("list-type") == "2":
+                    return await list_handlers.handle_list_objects_v2(ctx, req)
+                return await list_handlers.handle_list_objects_v1(ctx, req)
+            if m == "DELETE":
+                return await bucket_handlers.handle_delete_bucket(
+                    self.helper, ctx)
+            if m == "POST" and "delete" in q:
+                return await delete_handlers.handle_delete_objects(ctx, req)
+            raise S3Error("NotImplemented", 501,
+                          f"unsupported bucket operation {m} {sorted(q)}")
+        # object-level ops
+        if m == "GET" or m == "HEAD":
+            if "uploadId" in q:
+                return await list_handlers.handle_list_parts(ctx, req)
+            return await get_handlers.handle_get(ctx, req, head=(m == "HEAD"))
+        if m == "PUT":
+            if "partNumber" in q and "uploadId" in q:
+                return await multipart_handlers.handle_put_part(ctx, req)
+            if "x-amz-copy-source" in req.headers:
+                return await put_handlers.handle_copy(ctx, req)
+            return await put_handlers.handle_put(ctx, req)
+        if m == "POST":
+            if "uploads" in q:
+                return await multipart_handlers.handle_create_multipart(
+                    ctx, req)
+            if "uploadId" in q:
+                return await multipart_handlers.handle_complete_multipart(
+                    ctx, req)
+        if m == "DELETE":
+            if "uploadId" in q:
+                return await multipart_handlers.handle_abort_multipart(
+                    ctx, req)
+            return await delete_handlers.handle_delete_object(ctx, req)
+        raise S3Error("NotImplemented", 501, f"unsupported operation {m}")

@@ -1,0 +1,258 @@
+"""Listing endpoints: ListBuckets, ListObjects v1/v2, uploads, parts.
+
+Ref parity: src/api/s3/list.rs (the pagination state machine) — here
+the range reads page through the object table per partition key with
+prefix / delimiter / common-prefix folding and continuation tokens.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Optional
+
+from ..http import Request, Response
+from .get import http_date
+from .xml import S3Error, xml, xml_response
+
+PAGE = 1000
+
+
+def _enc_token(s: str) -> str:
+    return base64.urlsafe_b64encode(s.encode()).decode()
+
+
+def _dec_token(s: str) -> str:
+    try:
+        return base64.urlsafe_b64decode(s.encode()).decode()
+    except Exception:
+        raise S3Error("InvalidArgument", 400, "bad continuation token")
+
+
+async def handle_list_buckets(helper, api_key) -> Response:
+    """ref: api/s3/bucket.rs handle_list_buckets — buckets this key may
+    read, with their global aliases."""
+    aliases = await helper.list_buckets(limit=10000)
+    entries = []
+    for a in aliases:
+        if a.bucket_id is None:
+            continue
+        if not (api_key.allow_read(a.bucket_id)
+                or api_key.allow_owner(a.bucket_id)):
+            continue
+        try:
+            b = await helper.get_existing_bucket(a.bucket_id)
+        except Exception:
+            continue
+        created = b.params.creation_date if b.params else 0
+        entries.append(xml("Bucket",
+                           xml("Name", a.name),
+                           xml("CreationDate", _iso(created))))
+    return xml_response(
+        xml("ListAllMyBucketsResult",
+            xml("Owner", xml("ID", api_key.key_id),
+                xml("DisplayName", api_key.params.name.value
+                    if api_key.params else "")),
+            xml("Buckets", *entries)))
+
+
+def _iso(ts_msec: int) -> str:
+    import datetime
+
+    return datetime.datetime.fromtimestamp(
+        ts_msec / 1000, datetime.timezone.utc
+    ).strftime("%Y-%m-%dT%H:%M:%S.000Z")
+
+
+def _prefix_upper_bound(b: bytes):
+    bb = bytearray(b)
+    while bb:
+        if bb[-1] != 0xFF:
+            bb[-1] += 1
+            return bytes(bb)
+        bb.pop()
+    return None
+
+
+async def _collect_objects(ctx, prefix: str, resume, delimiter: str,
+                           max_keys: int):
+    """Shared lister. `resume` is None or ("k", last_key) /
+    ("p", last_common_prefix) — the last item the previous page
+    returned. Folds keys under `delimiter` into common prefixes.
+    Returns (contents, common_prefixes, next_token, truncated)."""
+    garage = ctx.garage
+    contents = []  # (key, ObjectVersion) rows
+    prefixes: set[str] = set()
+    last_token = None  # last RETURNED item, for the continuation token
+
+    if resume is None:
+        sk = prefix.encode() if prefix else None
+    elif resume[0] == "p":
+        # skip everything under the already-returned common prefix
+        sk = _prefix_upper_bound(resume[1].encode())
+        if sk is None:
+            return contents, [], None, False
+    else:
+        sk = resume[1].encode() + b"\x00"
+    while True:
+        entries = await garage.object_table.get_range(
+            ctx.bucket_id, start_sk=sk, flt={"type": "data"}, limit=PAGE,
+        )
+        if not entries:
+            return contents, sorted(prefixes), None, False
+        for o in entries:
+            key = o.key
+            sk = key.encode() + b"\x00"
+            if not key.startswith(prefix):
+                if key > prefix:  # past the prefix window: done
+                    return contents, sorted(prefixes), None, False
+                continue
+            if delimiter:
+                rest = key[len(prefix):]
+                if delimiter in rest:
+                    cp = prefix + rest.split(delimiter)[0] + delimiter
+                    if cp in prefixes:
+                        continue
+                    if len(contents) + len(prefixes) >= max_keys:
+                        return contents, sorted(prefixes), last_token, True
+                    prefixes.add(cp)
+                    last_token = ("p", cp)
+                    continue
+            v = o.last_data()
+            if v is None:
+                continue
+            if len(contents) + len(prefixes) >= max_keys:
+                return contents, sorted(prefixes), last_token, True
+            contents.append((key, v))
+            last_token = ("k", key)
+        if len(entries) < PAGE:
+            return contents, sorted(prefixes), None, False
+
+
+async def handle_list_objects_v2(ctx, req: Request) -> Response:
+    q = req.query
+    prefix = q.get("prefix", "")
+    delimiter = q.get("delimiter", "")
+    max_keys = min(int(q.get("max-keys", "1000") or 1000), 1000)
+    token = q.get("continuation-token")
+    start_after = q.get("start-after", "")
+    if token:
+        raw = _dec_token(token)
+        resume = (raw[:1], raw[1:]) if raw[:1] in ("k", "p") else None
+    elif start_after:
+        resume = ("k", start_after)
+    else:
+        resume = None
+    contents, prefixes, next_token, truncated = await _collect_objects(
+        ctx, prefix, resume, delimiter, max_keys)
+
+    nodes = [xml("Name", ctx.bucket_name), xml("Prefix", prefix),
+             xml("KeyCount", str(len(contents) + len(prefixes))),
+             xml("MaxKeys", str(max_keys)),
+             xml("IsTruncated", "true" if truncated else "false")]
+    if delimiter:
+        nodes.append(xml("Delimiter", delimiter))
+    if truncated and next_token is not None:
+        nodes.append(xml("NextContinuationToken",
+                         _enc_token(next_token[0] + next_token[1])))
+    for key, v in contents:
+        nodes.append(xml("Contents",
+                         xml("Key", key),
+                         xml("LastModified", _iso(v.timestamp)),
+                         xml("ETag", f'"{v.state.data.meta.etag}"'),
+                         xml("Size", str(v.state.data.meta.size)),
+                         xml("StorageClass", "STANDARD")))
+    for cp in prefixes:
+        nodes.append(xml("CommonPrefixes", xml("Prefix", cp)))
+    return xml_response(xml("ListBucketResult", *nodes))
+
+
+async def handle_list_objects_v1(ctx, req: Request) -> Response:
+    q = req.query
+    prefix = q.get("prefix", "")
+    delimiter = q.get("delimiter", "")
+    max_keys = min(int(q.get("max-keys", "1000") or 1000), 1000)
+    marker = q.get("marker", "")
+    if marker and delimiter and marker.endswith(delimiter):
+        resume = ("p", marker)  # marker was a folded common prefix
+    elif marker:
+        resume = ("k", marker)
+    else:
+        resume = None
+    contents, prefixes, next_token, truncated = await _collect_objects(
+        ctx, prefix, resume, delimiter, max_keys)
+    nodes = [xml("Name", ctx.bucket_name), xml("Prefix", prefix),
+             xml("Marker", marker), xml("MaxKeys", str(max_keys)),
+             xml("IsTruncated", "true" if truncated else "false")]
+    if delimiter:
+        nodes.append(xml("Delimiter", delimiter))
+    if truncated and next_token:
+        nodes.append(xml("NextMarker", next_token[1]))
+    for key, v in contents:
+        nodes.append(xml("Contents",
+                         xml("Key", key),
+                         xml("LastModified", _iso(v.timestamp)),
+                         xml("ETag", f'"{v.state.data.meta.etag}"'),
+                         xml("Size", str(v.state.data.meta.size)),
+                         xml("StorageClass", "STANDARD")))
+    for cp in prefixes:
+        nodes.append(xml("CommonPrefixes", xml("Prefix", cp)))
+    return xml_response(xml("ListBucketResult", *nodes))
+
+
+async def handle_list_multipart_uploads(ctx, req: Request) -> Response:
+    """ref: list.rs handle_list_multipart_upload (simplified paging)."""
+    q = req.query
+    prefix = q.get("prefix", "")
+    max_uploads = min(int(q.get("max-uploads", "1000") or 1000), 1000)
+    entries = await ctx.garage.object_table.get_range(
+        ctx.bucket_id, flt={"type": "uploading", "multipart": True},
+        limit=PAGE,
+    )
+    ups = []
+    for o in entries:
+        if not o.key.startswith(prefix):
+            continue
+        for v in o.versions:
+            if v.is_uploading(True):
+                ups.append((o.key, v))
+    ups = ups[:max_uploads]
+    nodes = [xml("Bucket", ctx.bucket_name), xml("Prefix", prefix),
+             xml("MaxUploads", str(max_uploads)),
+             xml("IsTruncated", "false")]
+    for key, v in ups:
+        nodes.append(xml("Upload",
+                         xml("Key", key),
+                         xml("UploadId", v.uuid.hex()),
+                         xml("Initiated", _iso(v.timestamp))))
+    return xml_response(xml("ListMultipartUploadsResult", *nodes))
+
+
+async def handle_list_parts(ctx, req: Request) -> Response:
+    """ref: list.rs handle_list_parts."""
+    upload_id = req.query.get("uploadId", "")
+    try:
+        uid = bytes.fromhex(upload_id)
+    except ValueError:
+        raise S3Error("NoSuchUpload", 404, upload_id)
+    mpu = await ctx.garage.mpu_table.get(uid, b"")
+    if mpu is None or mpu.is_tombstone():
+        raise S3Error("NoSuchUpload", 404, upload_id)
+    marker = int(req.query.get("part-number-marker", "0") or 0)
+    max_parts = min(int(req.query.get("max-parts", "1000") or 1000), 1000)
+    # newest record per part number with a finished etag
+    best = {}
+    for (pn, ts), part in mpu.parts.items():
+        if part.etag is not None and pn > marker:
+            if pn not in best or ts > best[pn][0]:
+                best[pn] = (ts, part)
+    parts = sorted(best.items())[:max_parts]
+    nodes = [xml("Bucket", ctx.bucket_name), xml("Key", ctx.key),
+             xml("UploadId", upload_id),
+             xml("MaxParts", str(max_parts)),
+             xml("IsTruncated", "false")]
+    for pn, (_ts, part) in parts:
+        nodes.append(xml("Part",
+                         xml("PartNumber", str(pn)),
+                         xml("ETag", f'"{part.etag}"'),
+                         xml("Size", str(part.size or 0))))
+    return xml_response(xml("ListPartsResult", *nodes))

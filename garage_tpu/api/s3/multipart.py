@@ -1,0 +1,188 @@
+"""Multipart upload endpoints.
+
+Ref parity: src/api/s3/multipart.rs:36-506. Create registers an
+Uploading{multipart} object version + MPU row; each part gets its own
+Version (keyed by a fresh uuid) whose blocks it streams; Complete
+validates the client's part list against stored parts, splices all part
+versions into one final Version (renumbered by part), and writes the
+Complete object; Abort tombstones.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from ...model.s3.block_ref_table import BlockRef
+from ...model.s3.mpu_table import MpuPart, MultipartUpload, MultipartUploadTable
+from ...model.s3.object_table import (Object, ObjectVersion,
+                                      ObjectVersionData, ObjectVersionMeta,
+                                      ObjectVersionState)
+from ...model.s3.version_table import BACKLINK_MPU, BACKLINK_OBJECT, Version
+from ...utils.crdt import now_msec
+from ...utils.data import gen_uuid
+from ..http import Request, Response
+from .put import Chunker, extract_metadata_headers, read_and_put_blocks
+from .xml import S3Error, xml, xml_response
+
+
+async def _get_upload(ctx, upload_id_hex: str):
+    """-> (mpu, object_version) or raises NoSuchUpload
+    (ref: multipart.rs get_upload)."""
+    try:
+        uid = bytes.fromhex(upload_id_hex)
+        if len(uid) != 32:
+            raise ValueError
+    except ValueError:
+        raise S3Error("NoSuchUpload", 404, upload_id_hex)
+    mpu = await ctx.garage.mpu_table.get(uid, b"")
+    obj = await ctx.garage.object_table.get(ctx.bucket_id,
+                                            ctx.key.encode())
+    ov = obj.version(uid) if obj is not None else None
+    if (mpu is None or mpu.is_tombstone() or ov is None
+            or not ov.is_uploading(check_multipart=True)):
+        raise S3Error("NoSuchUpload", 404, upload_id_hex)
+    return mpu, ov
+
+
+async def handle_create_multipart(ctx, req: Request) -> Response:
+    """ref: multipart.rs handle_create_multipart_upload."""
+    await req.body.drain()
+    headers = extract_metadata_headers(req)
+    uuid = gen_uuid()
+    ts = now_msec()
+    obj = Object(ctx.bucket_id, ctx.key, [ObjectVersion(
+        uuid, ts, ObjectVersionState.uploading(headers, multipart=True))])
+    await ctx.garage.object_table.insert(obj)
+    mpu = MultipartUpload.new(uuid, ts, ctx.bucket_id, ctx.key)
+    await ctx.garage.mpu_table.insert(mpu)
+    return xml_response(xml("InitiateMultipartUploadResult",
+                            xml("Bucket", ctx.bucket_name),
+                            xml("Key", ctx.key),
+                            xml("UploadId", uuid.hex())))
+
+
+async def handle_put_part(ctx, req: Request) -> Response:
+    """ref: multipart.rs handle_put_part."""
+    q = req.query
+    try:
+        part_number = int(q["partNumber"])
+        if not (1 <= part_number <= 10000):
+            raise ValueError
+    except (KeyError, ValueError):
+        raise S3Error("InvalidArgument", 400, "bad partNumber")
+    mpu, _ov = await _get_upload(ctx, q.get("uploadId", ""))
+
+    ts = mpu.next_timestamp(part_number)
+    version_uuid = gen_uuid()
+    # register the part (etag/size unset until data is stored)
+    mpu2 = MultipartUpload.new(mpu.upload_id, mpu.timestamp,
+                               ctx.bucket_id, ctx.key)
+    mpu2.parts = mpu2.parts.put((part_number, ts), MpuPart(version_uuid))
+    await ctx.garage.mpu_table.insert(mpu2)
+    version = Version.new(version_uuid, (BACKLINK_MPU, mpu.upload_id))
+    await ctx.garage.version_table.insert(version)
+
+    chunker = Chunker(req.body, ctx.garage.config.block_size)
+    first = await chunker.next()
+    if first is None:
+        raise S3Error("EntityTooSmall", 400, "empty part")
+    md5 = hashlib.md5()
+    total, etag, _first_hash = await read_and_put_blocks(
+        ctx.garage, version, part_number, first, chunker, md5)
+
+    # record the finished part
+    done = MultipartUpload.new(mpu.upload_id, mpu.timestamp,
+                               ctx.bucket_id, ctx.key)
+    done.parts = done.parts.put((part_number, ts),
+                                MpuPart(version_uuid, etag, total))
+    await ctx.garage.mpu_table.insert(done)
+    return Response(200, [("etag", f'"{etag}"')])
+
+
+async def handle_complete_multipart(ctx, req: Request) -> Response:
+    """ref: multipart.rs handle_complete_multipart_upload."""
+    import xml.etree.ElementTree as ET
+
+    body = await req.body.read_all(limit=1 << 20)
+    try:
+        root = ET.fromstring(body.decode())
+    except ET.ParseError:
+        raise S3Error("MalformedXML", 400, "cannot parse request")
+    asked = []  # [(part_number, etag)]
+    for part in root:
+        if not part.tag.endswith("Part"):
+            continue
+        pn = etag = None
+        for c in part:
+            if c.tag.endswith("PartNumber"):
+                pn = int(c.text)
+            elif c.tag.endswith("ETag"):
+                etag = (c.text or "").strip().strip('"')
+        if pn is not None:
+            asked.append((pn, etag))
+    if not asked or asked != sorted(asked, key=lambda x: x[0]) \
+            or len({p for p, _ in asked}) != len(asked):
+        raise S3Error("InvalidPartOrder", 400,
+                      "parts must be ordered and unique")
+
+    upload_id = req.query.get("uploadId", "")
+    mpu, ov = await _get_upload(ctx, upload_id)
+
+    # newest stored record per part number that has completed
+    stored = {}
+    for (pn, ts), part in mpu.parts.items():
+        if part.etag is not None:
+            if pn not in stored or ts > stored[pn][0]:
+                stored[pn] = (ts, part)
+    parts = []
+    for pn, etag in asked:
+        if pn not in stored or (etag and stored[pn][1].etag != etag):
+            raise S3Error("InvalidPart", 400, f"part {pn} not found")
+        parts.append((pn, stored[pn][1]))
+
+    # splice all part versions into the final object version
+    # (ref: multipart.rs:260-330)
+    final = Version.new(ov.uuid, (BACKLINK_OBJECT, ctx.bucket_id, ctx.key))
+    total_size = 0
+    etag_md5 = hashlib.md5()
+    for pn, part in parts:
+        pv = await ctx.garage.version_table.get(part.version, b"")
+        if pv is None or pv.is_tombstone():
+            raise S3Error("InvalidPart", 400, f"part {pn} lost")
+        for (_p, off), (h, sz) in pv.blocks.items():
+            final = Version(final.uuid, final.deleted,
+                            final.blocks.put((pn, off), (h, sz)),
+                            final.backlink)
+            total_size += sz
+        etag_md5.update(bytes.fromhex(part.etag))
+    await ctx.garage.version_table.insert(final)
+    # re-point block refs from part versions to the final version
+    for pn, part in parts:
+        pv = await ctx.garage.version_table.get(part.version, b"")
+        for _k, (h, _s) in pv.blocks.items():
+            await ctx.garage.block_ref_table.insert(BlockRef.new(h, ov.uuid))
+
+    etag = f"{etag_md5.hexdigest()}-{len(parts)}"
+    headers = (ov.state.headers if ov.state.kind == "uploading" else {})
+    meta = ObjectVersionMeta(headers, total_size, etag)
+    first_hash = next(iter([h for _k, (h, _s) in final.blocks.items()]),
+                      b"\x00" * 32)
+    done = Object(ctx.bucket_id, ctx.key, [ObjectVersion(
+        ov.uuid, ov.timestamp,
+        ObjectVersionState.complete(
+            ObjectVersionData.first_block(meta, first_hash)))])
+    await ctx.garage.object_table.insert(done)
+    return xml_response(xml("CompleteMultipartUploadResult",
+                            xml("Bucket", ctx.bucket_name),
+                            xml("Key", ctx.key),
+                            xml("ETag", f'"{etag}"')))
+
+
+async def handle_abort_multipart(ctx, req: Request) -> Response:
+    """ref: multipart.rs handle_abort_multipart_upload."""
+    upload_id = req.query.get("uploadId", "")
+    mpu, ov = await _get_upload(ctx, upload_id)
+    aborted = Object(ctx.bucket_id, ctx.key, [ObjectVersion(
+        ov.uuid, ov.timestamp, ObjectVersionState.aborted())])
+    await ctx.garage.object_table.insert(aborted)
+    return Response(204)

@@ -1,0 +1,360 @@
+"""AWS Signature V4 verification.
+
+Ref parity: src/api/common/signature/ (payload.rs:35-576 header +
+presigned auth, streaming.rs aws-chunked per-chunk signatures). Verifies
+Authorization-header and presigned-query signatures against the key
+table, and wraps `aws-chunked` streaming bodies (signed chunks or
+unsigned-with-trailer) so handlers see plain payload bytes.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+from typing import Optional
+from urllib.parse import quote, unquote
+
+from .http import BodyReader, HttpError, Request
+
+SERVICE = "s3"
+ALGORITHM = "AWS4-HMAC-SHA256"
+UNSIGNED_PAYLOAD = "UNSIGNED-PAYLOAD"
+STREAMING_SIGNED = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
+STREAMING_SIGNED_TRAILER = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD-TRAILER"
+STREAMING_UNSIGNED_TRAILER = "STREAMING-UNSIGNED-PAYLOAD-TRAILER"
+MAX_CLOCK_SKEW = 15 * 60
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def signing_key(secret: str, date: str, region: str,
+                service: str = SERVICE) -> bytes:
+    k = _hmac(b"AWS4" + secret.encode(), date)
+    k = _hmac(k, region)
+    k = _hmac(k, service)
+    return _hmac(k, "aws4_request")
+
+
+def uri_encode(s: str, encode_slash: bool = True) -> str:
+    safe = "-_.~" if encode_slash else "-_.~/"
+    return quote(s, safe=safe)
+
+
+def canonical_query(raw_pairs: list[tuple[str, str]],
+                    skip: tuple[str, ...] = ()) -> str:
+    enc = []
+    for k, v in raw_pairs:
+        dk, dv = unquote(k), unquote(v)
+        if dk in skip:
+            continue
+        enc.append((uri_encode(dk), uri_encode(dv)))
+    return "&".join(f"{k}={v}" for k, v in sorted(enc))
+
+
+def canonical_headers(headers: dict[str, str],
+                      signed: list[str]) -> tuple[str, str]:
+    lines = []
+    for name in signed:
+        v = headers.get(name)
+        if v is None:
+            raise HttpError(403, f"signed header {name} missing")
+        lines.append(f"{name}:{' '.join(v.split())}\n")
+    return "".join(lines), ";".join(signed)
+
+
+def canonical_request(method: str, raw_path: str,
+                      raw_query: list[tuple[str, str]],
+                      headers: dict[str, str], signed: list[str],
+                      payload_hash: str,
+                      skip_query: tuple[str, ...] = ()) -> str:
+    ch, sh = canonical_headers(headers, signed)
+    return "\n".join([
+        method,
+        raw_path or "/",
+        canonical_query(raw_query, skip_query),
+        ch,
+        sh,
+        payload_hash,
+    ])
+
+
+def string_to_sign(amz_date: str, scope: str, creq: str) -> str:
+    return "\n".join([ALGORITHM, amz_date, scope, _sha256(creq.encode())])
+
+
+def parse_amz_date(s: str) -> datetime.datetime:
+    try:
+        return datetime.datetime.strptime(s, "%Y%m%dT%H%M%SZ").replace(
+            tzinfo=datetime.timezone.utc)
+    except ValueError:
+        raise HttpError(403, "invalid x-amz-date")
+
+
+class VerifiedRequest:
+    __slots__ = ("key_id", "content_sha256", "signature", "scope_date",
+                 "signing_key", "presigned")
+
+    def __init__(self, key_id, content_sha256, signature, scope_date,
+                 sk, presigned):
+        self.key_id = key_id
+        self.content_sha256 = content_sha256  # literal header value
+        self.signature = signature
+        self.scope_date = scope_date
+        self.signing_key = sk
+        self.presigned = presigned
+
+
+async def verify_request(req: Request, region: str,
+                         lookup_secret) -> Optional[VerifiedRequest]:
+    """Check the request signature. `lookup_secret(key_id) -> secret|None`
+    (async). Returns None for anonymous (unsigned) requests; raises
+    HttpError(403) on bad signatures. ref: signature/payload.rs:35-200."""
+    auth = req.header("authorization")
+    if auth is not None:
+        return await _verify_header(req, region, lookup_secret, auth)
+    if req.query.get("X-Amz-Algorithm") == ALGORITHM:
+        return await _verify_presigned(req, region, lookup_secret)
+    return None
+
+
+def _parse_credential(cred: str, region: str) -> tuple[str, str]:
+    parts = cred.split("/")
+    if len(parts) != 5 or parts[4] != "aws4_request":
+        raise HttpError(403, "malformed credential")
+    key_id, date, creg, service = parts[0], parts[1], parts[2], parts[3]
+    if creg != region or service != SERVICE:
+        raise HttpError(403, f"wrong scope region/service ({creg}/{service})")
+    return key_id, date
+
+
+def _check_date(amz_date: str, scope_date: str, now=None) -> None:
+    t = parse_amz_date(amz_date)
+    if t.strftime("%Y%m%d") != scope_date:
+        raise HttpError(403, "date mismatch between x-amz-date and scope")
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    if abs((now - t).total_seconds()) > MAX_CLOCK_SKEW:
+        raise HttpError(403, "request time too skewed")
+
+
+async def _verify_header(req: Request, region: str, lookup_secret,
+                         auth: str) -> VerifiedRequest:
+    if not auth.startswith(ALGORITHM):
+        raise HttpError(403, "unsupported auth algorithm")
+    fields = {}
+    for item in auth[len(ALGORITHM):].split(","):
+        k, _, v = item.strip().partition("=")
+        fields[k] = v
+    try:
+        cred = fields["Credential"]
+        signed_headers = fields["SignedHeaders"].split(";")
+        signature = fields["Signature"]
+    except KeyError:
+        raise HttpError(403, "malformed authorization header")
+    key_id, scope_date = _parse_credential(cred, region)
+    amz_date = req.header("x-amz-date") or req.header("date") or ""
+    _check_date(amz_date, scope_date)
+    secret = await lookup_secret(key_id)
+    if secret is None:
+        raise HttpError(403, "no such key")
+    payload_hash = req.header("x-amz-content-sha256") or UNSIGNED_PAYLOAD
+    from .http import parse_query
+
+    _, raw_pairs = parse_query(req.raw_query)
+    creq = canonical_request(req.method, req.raw_path, raw_pairs,
+                             req.headers, signed_headers, payload_hash)
+    scope = f"{scope_date}/{region}/{SERVICE}/aws4_request"
+    sk = signing_key(secret, scope_date, region)
+    expect = hmac.new(sk, string_to_sign(amz_date, scope, creq).encode(),
+                      hashlib.sha256).hexdigest()
+    if not hmac.compare_digest(expect, signature):
+        raise HttpError(403, "signature mismatch")
+    return VerifiedRequest(key_id, payload_hash, signature, scope_date,
+                           sk, False)
+
+
+async def _verify_presigned(req: Request, region: str,
+                            lookup_secret) -> VerifiedRequest:
+    """ref: payload.rs check_presigned_signature."""
+    q = req.query
+    try:
+        cred = q["X-Amz-Credential"]
+        amz_date = q["X-Amz-Date"]
+        expires = int(q["X-Amz-Expires"])
+        signed_headers = q["X-Amz-SignedHeaders"].split(";")
+        signature = q["X-Amz-Signature"]
+    except (KeyError, ValueError):
+        raise HttpError(403, "malformed presigned query")
+    key_id, scope_date = _parse_credential(cred, region)
+    t = parse_amz_date(amz_date)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    if now > t + datetime.timedelta(seconds=min(expires, 7 * 86400)):
+        raise HttpError(403, "presigned URL expired")
+    secret = await lookup_secret(key_id)
+    if secret is None:
+        raise HttpError(403, "no such key")
+    from .http import parse_query
+
+    _, raw_pairs = parse_query(req.raw_query)
+    creq = canonical_request(req.method, req.raw_path, raw_pairs,
+                             req.headers, signed_headers, UNSIGNED_PAYLOAD,
+                             skip_query=("X-Amz-Signature",))
+    scope = f"{scope_date}/{region}/{SERVICE}/aws4_request"
+    sk = signing_key(secret, scope_date, region)
+    expect = hmac.new(sk, string_to_sign(amz_date, scope, creq).encode(),
+                      hashlib.sha256).hexdigest()
+    if not hmac.compare_digest(expect, signature):
+        raise HttpError(403, "signature mismatch")
+    return VerifiedRequest(key_id, UNSIGNED_PAYLOAD, signature, scope_date,
+                           sk, True)
+
+
+# ---- payload body wrappers (ref: signature/streaming.rs) ---------------
+
+
+class SignedPayloadReader:
+    """Whole-body sha256 check for x-amz-content-sha256=<hex> requests."""
+
+    def __init__(self, inner: BodyReader, expect_hex: str):
+        self.inner = inner
+        self.h = hashlib.sha256()
+        self.expect = expect_hex
+
+    async def read(self, n: int = 65536) -> bytes:
+        chunk = await self.inner.read(n)
+        if chunk:
+            self.h.update(chunk)
+        elif self.h.hexdigest() != self.expect:
+            raise HttpError(400, "payload checksum mismatch")
+        return chunk
+
+    async def read_all(self, limit: int = 1 << 30) -> bytes:
+        out = bytearray()
+        while True:
+            c = await self.read()
+            if not c:
+                return bytes(out)
+            out.extend(c)
+            if len(out) > limit:
+                raise HttpError(413)
+
+    async def drain(self):
+        await self.inner.drain()
+
+
+class AwsChunkedReader:
+    """Decodes aws-chunked framing, verifying per-chunk signatures when
+    the payload is STREAMING-AWS4-HMAC-SHA256-PAYLOAD.
+
+    chunk: <hex size>;chunk-signature=<sig>\r\n <data> \r\n
+    chunk signature = HMAC(sk, "AWS4-HMAC-SHA256-PAYLOAD" \n date \n
+                      scope \n previous-sig \n sha256("") \n sha256(data))
+    ref: streaming.rs.
+    """
+
+    def __init__(self, inner: BodyReader, verified: VerifiedRequest,
+                 region: str, amz_date: str, signed: bool):
+        self.inner = inner
+        self.v = verified
+        self.region = region
+        self.amz_date = amz_date
+        self.signed = signed
+        self.prev_sig = verified.signature
+        self._buf = bytearray()
+        self._done = False
+
+    async def _read_line(self) -> bytes:
+        while b"\r\n" not in self._buf:
+            c = await self.inner.read()
+            if not c:
+                raise HttpError(400, "truncated aws-chunked body")
+            self._buf.extend(c)
+        i = self._buf.index(b"\r\n")
+        line = bytes(self._buf[:i])
+        del self._buf[:i + 2]
+        return line
+
+    async def _read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            c = await self.inner.read()
+            if not c:
+                raise HttpError(400, "truncated aws-chunked body")
+            self._buf.extend(c)
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+    def _chunk_string_to_sign(self, data: bytes) -> str:
+        scope = f"{self.v.scope_date}/{self.region}/{SERVICE}/aws4_request"
+        return "\n".join([
+            "AWS4-HMAC-SHA256-PAYLOAD", self.amz_date, scope, self.prev_sig,
+            _sha256(b""), _sha256(data),
+        ])
+
+    async def read(self, n: int = 1 << 30) -> bytes:
+        """Returns one decoded chunk (ignores n except as a hint)."""
+        if self._done:
+            return b""
+        header = await self._read_line()
+        size_part, _, ext = header.partition(b";")
+        try:
+            size = int(size_part, 16)
+        except ValueError:
+            raise HttpError(400, "bad aws-chunk header")
+        sig = None
+        if ext.startswith(b"chunk-signature="):
+            sig = ext[len(b"chunk-signature="):].decode()
+        data = await self._read_exact(size)
+        if self.signed:
+            if sig is None:
+                raise HttpError(403, "missing chunk signature")
+            expect = hmac.new(self.v.signing_key,
+                              self._chunk_string_to_sign(data).encode(),
+                              hashlib.sha256).hexdigest()
+            if not hmac.compare_digest(expect, sig):
+                raise HttpError(403, "chunk signature mismatch")
+            self.prev_sig = expect
+        await self._read_exact(2)  # CRLF after data
+        if size == 0:
+            # trailers (x-amz-trailer checksums) until exhaustion
+            await self.inner.drain()
+            self._done = True
+            return b""
+        return data
+
+    async def read_all(self, limit: int = 1 << 30) -> bytes:
+        out = bytearray()
+        while True:
+            c = await self.read()
+            if not c:
+                return bytes(out)
+            out.extend(c)
+            if len(out) > limit:
+                raise HttpError(413)
+
+    async def drain(self):
+        await self.inner.drain()
+
+
+def wrap_body(req: Request, verified: Optional[VerifiedRequest],
+              region: str):
+    """Give the handler a body reader enforcing the payload integrity
+    mode the client declared."""
+    if verified is None:
+        return req.body
+    cs = verified.content_sha256
+    amz_date = req.header("x-amz-date") or ""
+    if cs == STREAMING_SIGNED:
+        return AwsChunkedReader(req.body, verified, region, amz_date, True)
+    if cs in (STREAMING_UNSIGNED_TRAILER, STREAMING_SIGNED_TRAILER):
+        return AwsChunkedReader(req.body, verified, region, amz_date,
+                                cs == STREAMING_SIGNED_TRAILER)
+    if cs and cs != UNSIGNED_PAYLOAD:
+        return SignedPayloadReader(req.body, cs)
+    return req.body

@@ -1,0 +1,1 @@
+"""Server entrypoint + operator CLI (ref: src/garage/)."""

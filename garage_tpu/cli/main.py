@@ -1,0 +1,278 @@
+"""Operator CLI: `python -m garage_tpu.cli.main <command>`.
+
+Ref parity: src/garage/cli/ (structs.rs:9-530, cmd.rs). Connects to a
+running node's RPC port (config from --config / GARAGE_CONFIG_FILE) with
+an ephemeral identity and drives the AdminRpc endpoint.
+
+Commands: status, node connect, layout {show,assign,remove,apply},
+bucket {list,create,delete,info,allow,deny}, key {new,list,info,delete,
+import}, worker list, stats.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+from ..model.garage import parse_addr, parse_peer
+from ..net import NetApp
+from ..net.message import PRIO_NORMAL
+from ..utils.config import read_config
+
+
+def fmt_table(rows: list[list[str]], header: list[str]) -> str:
+    """ref: src/format-table/lib.rs — tab-aligned columns."""
+    all_rows = [header] + rows
+    widths = [max(len(str(r[i])) for r in all_rows)
+              for i in range(len(header))]
+    lines = []
+    for i, r in enumerate(all_rows):
+        lines.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+class AdminClient:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        netid = (bytes.fromhex(cfg.rpc_secret) if cfg.rpc_secret
+                 else b"garage-tpu-insecure-dev")
+        self.netapp = NetApp(netid)
+        self.node = None
+
+    async def connect(self) -> None:
+        addr = parse_addr(self.cfg.rpc_public_addr or self.cfg.rpc_bind_addr)
+        self.node = await self.netapp.try_connect(addr)
+        self.ep = self.netapp.endpoint("garage_tpu/admin")
+
+    async def call(self, op: str, **kw):
+        resp, _ = await self.ep.call(self.node, {"op": op, **kw},
+                                     PRIO_NORMAL, timeout=30.0)
+        return resp
+
+    async def close(self):
+        await self.netapp.shutdown()
+
+
+async def cmd(args) -> int:
+    cfg = read_config(args.config)
+    cli = AdminClient(cfg)
+    try:
+        await cli.connect()
+        return await _dispatch(cli, args)
+    finally:
+        await cli.close()
+
+
+async def _dispatch(cli: AdminClient, args) -> int:
+    c = args.cmd
+    if c == "status":
+        r = await cli.call("status")
+        h = r["health"]
+        print(f"node id:  {r['node_id'].hex()}")
+        print(f"health:   {h['status']} "
+              f"({h['connected_nodes']}/{h['known_nodes']} nodes, "
+              f"{h['storage_nodes_up']}/{h['storage_nodes']} storage, "
+              f"{h['partitions_quorum']}/256 partitions with quorum)")
+        print(f"layout:   v{r['layout_version']}")
+        rows = []
+        for n in r["nodes"]:
+            role = n.get("role") or {}
+            rows.append([
+                n["id"].hex()[:16], n.get("hostname", ""),
+                "up" if n["is_up"] else "DOWN",
+                role.get("zone", "-"),
+                str(role.get("capacity", "-")),
+            ])
+        print(fmt_table(rows, ["id", "host", "status", "zone", "capacity"]))
+        return 0
+    if c == "connect":
+        addr, nid = parse_peer(args.peer)
+        await cli.call("connect", addr=list(addr), id=nid)
+        print("ok")
+        return 0
+    if c == "layout":
+        return await _layout(cli, args)
+    if c == "bucket":
+        return await _bucket(cli, args)
+    if c == "key":
+        return await _key(cli, args)
+    if c == "worker":
+        r = await cli.call("worker_list")
+        rows = [[w["id"], w["name"], str(w.get("queue") or ""),
+                 str(w.get("errors") or "")] for w in r["workers"]]
+        print(fmt_table(rows, ["id", "name", "queue", "errors"]))
+        return 0
+    if c == "stats":
+        r = await cli.call("stats")
+        print(json.dumps(r, indent=2, default=str))
+        return 0
+    print(f"unknown command {c}", file=sys.stderr)
+    return 1
+
+
+async def _layout(cli, args) -> int:
+    s = args.subcmd
+    if s == "show":
+        r = await cli.call("layout_show")
+        print(f"current layout version: {r['version']}")
+        rows = [[nid[:16], v["zone"], str(v["capacity"])]
+                for nid, v in sorted(r["roles"].items())]
+        print(fmt_table(rows, ["id", "zone", "capacity"]))
+        if r["staged"]:
+            print("\nstaged changes:")
+            for nid, v in sorted(r["staged"].items()):
+                print(f"  {nid[:16]} -> {v}")
+        return 0
+    if s == "assign":
+        from ..utils.config import parse_capacity
+
+        node = bytes.fromhex(args.node) if len(args.node) == 64 else None
+        if node is None:
+            # prefix match against known nodes
+            r = await cli.call("status")
+            cands = [n["id"] for n in r["nodes"]
+                     if n["id"].hex().startswith(args.node)]
+            if len(cands) != 1:
+                print(f"node prefix {args.node!r} matches {len(cands)} nodes",
+                      file=sys.stderr)
+                return 1
+            node = bytes(cands[0])
+        cap = parse_capacity(args.capacity) if args.capacity else None
+        await cli.call("layout_assign", node=node, zone=args.zone,
+                       capacity=cap, tags=args.tags or [])
+        print("staged; run `layout apply` to activate")
+        return 0
+    if s == "remove":
+        node = bytes.fromhex(args.node)
+        await cli.call("layout_remove", node=node)
+        print("staged removal")
+        return 0
+    if s == "apply":
+        r = await cli.call("layout_apply", version=args.version)
+        print(f"layout applied, now at version {r['version']}")
+        return 0
+    return 1
+
+
+async def _bucket(cli, args) -> int:
+    s = args.subcmd
+    if s == "list":
+        r = await cli.call("bucket_list")
+        print(fmt_table([[b["name"], b["id"][:16]] for b in r["buckets"]],
+                        ["name", "id"]))
+        return 0
+    if s == "create":
+        r = await cli.call("bucket_create", name=args.name)
+        print(f"bucket {args.name} created, id {r['id']}")
+        return 0
+    if s == "delete":
+        await cli.call("bucket_delete", name=args.name)
+        print("deleted")
+        return 0
+    if s == "info":
+        r = await cli.call("bucket_info", name=args.name)
+        print(json.dumps(r, indent=2))
+        return 0
+    if s in ("allow", "deny"):
+        await cli.call(f"bucket_{s}", bucket=args.name, key=args.key,
+                       read=args.read, write=args.write, owner=args.owner)
+        print("ok")
+        return 0
+    return 1
+
+
+async def _key(cli, args) -> int:
+    s = args.subcmd
+    if s == "new":
+        r = await cli.call("key_new", name=args.name or "")
+        print(f"Key ID:     {r['key_id']}")
+        print(f"Secret key: {r['secret_key']}")
+        return 0
+    if s == "list":
+        r = await cli.call("key_list")
+        print(fmt_table([[k["id"], k["name"]] for k in r["keys"]],
+                        ["id", "name"]))
+        return 0
+    if s == "info":
+        r = await cli.call("key_info", key=args.key, show_secret=args.show_secret)
+        print(json.dumps(r, indent=2))
+        return 0
+    if s == "delete":
+        await cli.call("key_delete", key=args.key)
+        print("deleted")
+        return 0
+    if s == "import":
+        r = await cli.call("key_import", key_id=args.key_id,
+                           secret_key=args.secret_key, name=args.name or "")
+        print(f"imported {r['key_id']}")
+        return 0
+    return 1
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="garage")
+    p.add_argument("--config", "-c",
+                   default=os.environ.get("GARAGE_CONFIG_FILE",
+                                          "/etc/garage.toml"))
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("status")
+    pc = sub.add_parser("connect")
+    pc.add_argument("peer")  # id@host:port
+    pl = sub.add_parser("layout")
+    pls = pl.add_subparsers(dest="subcmd", required=True)
+    pls.add_parser("show")
+    pa = pls.add_parser("assign")
+    pa.add_argument("node")
+    pa.add_argument("--zone", "-z", default="dc1")
+    pa.add_argument("--capacity", "-c", default=None)
+    pa.add_argument("--tags", "-t", nargs="*")
+    pr = pls.add_parser("remove")
+    pr.add_argument("node")
+    pap = pls.add_parser("apply")
+    pap.add_argument("--version", type=int, default=None)
+    pb = sub.add_parser("bucket")
+    pbs = pb.add_subparsers(dest="subcmd", required=True)
+    pbs.add_parser("list")
+    for name in ("create", "delete", "info"):
+        x = pbs.add_parser(name)
+        x.add_argument("name")
+    for name in ("allow", "deny"):
+        x = pbs.add_parser(name)
+        x.add_argument("name")
+        x.add_argument("--key", required=True)
+        x.add_argument("--read", action="store_true")
+        x.add_argument("--write", action="store_true")
+        x.add_argument("--owner", action="store_true")
+    pk = sub.add_parser("key")
+    pks = pk.add_subparsers(dest="subcmd", required=True)
+    kn = pks.add_parser("new")
+    kn.add_argument("--name", default="")
+    pks.add_parser("list")
+    ki = pks.add_parser("info")
+    ki.add_argument("key")
+    ki.add_argument("--show-secret", action="store_true")
+    kd = pks.add_parser("delete")
+    kd.add_argument("key")
+    kim = pks.add_parser("import")
+    kim.add_argument("key_id")
+    kim.add_argument("secret_key")
+    kim.add_argument("--name", default="")
+    sub.add_parser("worker").add_subparsers(dest="subcmd").add_parser("list")
+    sub.add_parser("stats")
+    return p
+
+
+def main() -> None:
+    args = build_parser().parse_args()
+    if args.cmd == "worker" and getattr(args, "subcmd", None) is None:
+        args.subcmd = "list"
+    sys.exit(asyncio.run(cmd(args)))
+
+
+if __name__ == "__main__":
+    main()

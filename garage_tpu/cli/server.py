@@ -1,0 +1,87 @@
+"""Node server entrypoint: `python -m garage_tpu.cli.server --config x.toml`.
+
+Ref parity: src/garage/server.rs:30-215 (startup sequence) +
+garage/main.rs. Builds the Garage root, starts RPC listen + gossip +
+workers, then the S3 / admin HTTP frontends; exits cleanly on
+SIGINT/SIGTERM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import signal
+
+from ..admin.rpc import AdminRpcHandler
+from ..api.s3.api_server import S3ApiServer
+from ..model.garage import Garage, parse_addr
+from ..utils.config import read_config
+
+log = logging.getLogger("garage_tpu.server")
+
+
+async def run_server(cfg_path: str) -> None:
+    cfg = read_config(cfg_path)
+    garage = Garage(cfg)
+    admin = AdminRpcHandler(garage)
+    stop = asyncio.Event()
+
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+
+    system_task = asyncio.create_task(garage.run())
+    servers = []
+    s3 = None
+    if cfg.s3_api_bind_addr:
+        s3 = S3ApiServer(garage)
+        host, port = parse_addr(cfg.s3_api_bind_addr)
+        await s3.start(host, port)
+        servers.append(s3)
+    if cfg.admin_api_bind_addr:
+        from ..admin.http import AdminHttpServer
+
+        ad = AdminHttpServer(garage)
+        host, port = parse_addr(cfg.admin_api_bind_addr)
+        await ad.start(host, port)
+        servers.append(ad)
+    if cfg.web_bind_addr:
+        from ..web.server import WebServer
+
+        web = WebServer(garage, s3)
+        host, port = parse_addr(cfg.web_bind_addr)
+        await web.start(host, port)
+        servers.append(web)
+
+    log.info("node %s up (rpc %s)", garage.system.id.hex()[:16],
+             cfg.rpc_bind_addr)
+    print(f"garage_tpu node {garage.system.id.hex()} ready", flush=True)
+    await stop.wait()
+    log.info("shutting down")
+    for s in servers:
+        await s.stop()
+    await garage.stop()
+    system_task.cancel()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(prog="garage_tpu.cli.server")
+    p.add_argument("--config", "-c",
+                   default=os.environ.get("GARAGE_CONFIG_FILE",
+                                          "/etc/garage.toml"))
+    p.add_argument("--log-level", default=os.environ.get("RUST_LOG", "info"))
+    args = p.parse_args()
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    asyncio.run(run_server(args.config))
+
+
+if __name__ == "__main__":
+    main()
