@@ -210,6 +210,16 @@ class AdminRpcHandler:
                         for bid, perm in k.params.authorized_buckets.items()},
         }
 
+    async def op_key_allow(self, p):
+        if p.get("create_bucket"):
+            await self.helper.set_key_create_bucket(p["key"], True)
+        return {"ok": True}
+
+    async def op_key_deny(self, p):
+        if p.get("create_bucket"):
+            await self.helper.set_key_create_bucket(p["key"], False)
+        return {"ok": True}
+
     async def op_key_delete(self, p):
         await self.helper.delete_key(p["key"])
         return {"ok": True}

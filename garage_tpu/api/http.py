@@ -211,9 +211,14 @@ async def write_response(writer: asyncio.StreamWriter, req: Optional[Request],
     names = {n.lower() for n, _ in resp.headers}
     body = resp.body
     fixed = isinstance(body, (bytes, bytearray))
+    # RFC 7230 §3.3.2: a message must not carry both Content-Length and
+    # Transfer-Encoding. Streams whose length the handler declared are
+    # written with content-length framing; only unknown-length streams
+    # get chunked.
+    chunked = not fixed and "content-length" not in names
     if fixed and "content-length" not in names:
         resp.headers.append(("content-length", str(len(body))))
-    if not fixed:
+    if chunked:
         resp.headers.append(("transfer-encoding", "chunked"))
     if "connection" not in names:
         resp.headers.append(("connection", "keep-alive" if keep_alive else "close"))
@@ -226,13 +231,31 @@ async def write_response(writer: asyncio.StreamWriter, req: Optional[Request],
     if fixed:
         writer.write(bytes(body))
         await writer.drain()
-    else:
+    elif chunked:
         async for chunk in body:
             if chunk:
                 writer.write(b"%x\r\n" % len(chunk) + bytes(chunk) + b"\r\n")
                 await writer.drain()
         writer.write(b"0\r\n\r\n")
         await writer.drain()
+    else:
+        declared = int(dict((n.lower(), v) for n, v in resp.headers)
+                       ["content-length"])
+        written = 0
+        async for chunk in body:
+            if chunk:
+                if written + len(chunk) > declared:
+                    # never write past the declared boundary: the client
+                    # would parse the excess as the next response
+                    raise ConnectionError(
+                        f"stream exceeds declared {declared} bytes")
+                writer.write(bytes(chunk))
+                written += len(chunk)
+                await writer.drain()
+        if written != declared:
+            # short stream would desync a keep-alive connection: abort
+            raise ConnectionError(
+                f"stream wrote {written} of {declared} declared bytes")
 
 
 class HttpServer:

@@ -24,6 +24,11 @@ async def handle_create_bucket(helper, bucket_name: str, api_key,
             return Response(200, [("location", f"/{bucket_name}")])
         raise S3Error("BucketAlreadyExists", 409,
                       "The requested bucket name is not available.")
+    # ref: bucket.rs:166 — only keys granted create-bucket may claim
+    # new global alias names.
+    if api_key.params is None or not api_key.params.allow_create_bucket.value:
+        raise S3Error("AccessDenied", 403,
+                      "Your key does not allow creating buckets.")
     try:
         bucket = await helper.create_bucket(bucket_name)
     except BadRequest as e:

@@ -64,11 +64,30 @@ async def handle_get(ctx, req: Request, head: bool = False) -> Response:
     meta = v.state.data.meta
 
     # conditionals (ref: get.rs try_answer_cached)
+    im = req.header("if-match")
+    if im is not None:
+        etags = [e.strip() for e in im.split(",")]
+        if "*" not in etags and f'"{meta.etag}"' not in etags:
+            raise S3Error("PreconditionFailed", 412, "If-Match failed")
+    ius = req.header("if-unmodified-since")
+    if ius is not None and im is None:
+        try:
+            t = datetime.datetime.strptime(
+                ius, "%a, %d %b %Y %H:%M:%S GMT"
+            ).replace(tzinfo=datetime.timezone.utc)
+            # floor to whole seconds: Last-Modified has 1 s resolution
+            if v.timestamp // 1000 > t.timestamp():
+                raise S3Error("PreconditionFailed", 412,
+                              "If-Unmodified-Since failed")
+        except ValueError:
+            pass
     inm = req.header("if-none-match")
-    if inm is not None and f'"{meta.etag}"' in [e.strip() for e in inm.split(",")]:
-        return Response(304, _object_headers(v, meta))
+    if inm is not None:
+        etags = [e.strip() for e in inm.split(",")]
+        if "*" in etags or f'"{meta.etag}"' in etags:
+            return Response(304, _object_headers(v, meta))
     ims = req.header("if-modified-since")
-    if ims is not None:
+    if ims is not None and inm is None:
         try:
             t = datetime.datetime.strptime(
                 ims, "%a, %d %b %Y %H:%M:%S GMT"
@@ -93,8 +112,14 @@ async def handle_get(ctx, req: Request, head: bool = False) -> Response:
             start, end = rng
             headers.append(("content-range",
                             f"bytes {start}-{end - 1}/{size}"))
-            return Response(206, headers, b"" if head else payload[start:end])
-        return Response(200, headers, b"" if head else payload)
+            if head:
+                headers.append(("content-length", str(end - start)))
+                return Response(206, headers)
+            return Response(206, headers, payload[start:end])
+        if head:
+            headers.append(("content-length", str(len(payload))))
+            return Response(200, headers)
+        return Response(200, headers, payload)
 
     version = await ctx.garage.version_table.get(v.uuid, b"")
     if version is None:

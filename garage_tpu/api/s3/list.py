@@ -230,13 +230,10 @@ async def handle_list_multipart_uploads(ctx, req: Request) -> Response:
 async def handle_list_parts(ctx, req: Request) -> Response:
     """ref: list.rs handle_list_parts."""
     upload_id = req.query.get("uploadId", "")
-    try:
-        uid = bytes.fromhex(upload_id)
-    except ValueError:
-        raise S3Error("NoSuchUpload", 404, upload_id)
-    mpu = await ctx.garage.mpu_table.get(uid, b"")
-    if mpu is None or mpu.is_tombstone():
-        raise S3Error("NoSuchUpload", 404, upload_id)
+    from .multipart import _get_upload
+
+    # 404s aborted/completed uploads too, not just unknown ids
+    mpu, _ov = await _get_upload(ctx, upload_id)
     marker = int(req.query.get("part-number-marker", "0") or 0)
     max_parts = min(int(req.query.get("max-parts", "1000") or 1000), 1000)
     # newest record per part number with a finished etag

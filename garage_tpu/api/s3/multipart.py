@@ -82,13 +82,36 @@ async def handle_put_part(ctx, req: Request) -> Response:
     version = Version.new(version_uuid, (BACKLINK_MPU, mpu.upload_id))
     await ctx.garage.version_table.insert(version)
 
+    from ..checksum import Checksummer, request_checksum_value
+
+    try:
+        expected_checksum = request_checksum_value(req.headers)
+    except ValueError as e:
+        raise S3Error("InvalidRequest", 400, str(e))
+    checksummer = (Checksummer(expected_checksum[0])
+                   if expected_checksum is not None else None)
     chunker = Chunker(req.body, ctx.garage.config.block_size)
     first = await chunker.next()
     if first is None:
         raise S3Error("EntityTooSmall", 400, "empty part")
     md5 = hashlib.md5()
-    total, etag, _first_hash = await read_and_put_blocks(
-        ctx.garage, version, part_number, first, chunker, md5)
+    try:
+        total, etag, _first_hash = await read_and_put_blocks(
+            ctx.garage, version, part_number, first, chunker, md5,
+            checksummer=checksummer)
+        if checksummer is not None \
+                and checksummer.b64() != expected_checksum[1]:
+            raise S3Error("BadDigest", 400, "checksum mismatch")
+    except BaseException:
+        # interrupted part: tombstone its version so block refs get
+        # dropped now instead of leaking until abort/complete
+        # (ref: multipart.rs:165-258 InterruptedCleanup)
+        try:
+            await ctx.garage.version_table.insert(Version.new(
+                version_uuid, (BACKLINK_MPU, mpu.upload_id), deleted=True))
+        except Exception:
+            pass
+        raise
 
     # record the finished part
     done = MultipartUpload.new(mpu.upload_id, mpu.timestamp,

@@ -78,9 +78,16 @@ def next_timestamp(existing: Optional[Object]) -> int:
 
 
 async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
-                      body, content_md5: Optional[str] = None):
+                      body, content_md5: Optional[str] = None,
+                      expected_checksum: Optional[tuple[str, str]] = None):
     """-> (version_uuid, version_timestamp, etag, total_size).
-    ref: put.rs:122-330 save_stream."""
+    ref: put.rs:122-330 save_stream. `expected_checksum` is a declared
+    (algo, base64-value) x-amz-checksum-* header to enforce."""
+    checksummer = None
+    if expected_checksum is not None:
+        from ..checksum import Checksummer
+
+        checksummer = Checksummer(expected_checksum[0])
     block_size = garage.config.block_size
     chunker = Chunker(body, block_size)
     first_block, existing = await asyncio.gather(
@@ -96,6 +103,10 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
         etag = md5.hexdigest()
         if content_md5 is not None and not _md5_matches(content_md5, etag):
             raise bad_request("Content-MD5 mismatch")
+        if checksummer is not None:
+            checksummer.update(first_block)
+            if checksummer.b64() != expected_checksum[1]:
+                raise bad_request("checksum mismatch")
         meta = ObjectVersionMeta(headers, len(first_block), etag)
         ov = ObjectVersion(uuid, ts, ObjectVersionState.complete(
             ObjectVersionData.inline(meta, first_block)))
@@ -111,9 +122,13 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
 
     try:
         total, etag, first_hash = await read_and_put_blocks(
-            garage, version, 1, first_block, chunker, md5)
+            garage, version, 1, first_block, chunker, md5,
+            checksummer=checksummer)
         if content_md5 is not None and not _md5_matches(content_md5, etag):
             raise bad_request("Content-MD5 mismatch")
+        if checksummer is not None \
+                and checksummer.b64() != expected_checksum[1]:
+            raise bad_request("checksum mismatch")
         meta = ObjectVersionMeta(headers, total, etag)
         done = Object(bucket_id, key, [ObjectVersion(
             uuid, ts, ObjectVersionState.complete(
@@ -141,7 +156,8 @@ def _md5_matches(content_md5_b64: str, etag_hex: str) -> bool:
 
 
 async def read_and_put_blocks(garage, version: Version, part_number: int,
-                              first_block: bytes, chunker: Chunker, md5):
+                              first_block: bytes, chunker: Chunker, md5,
+                              checksummer=None):
     """The staged put pipeline (ref: put.rs:378-530): ≤3 concurrent
     block writes; version + block_ref rows inserted alongside each
     block."""
@@ -166,30 +182,49 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
     try:
         while block is not None:
             md5.update(block)
+            if checksummer is not None:
+                # pure-python CRCs are slow; keep them off the event loop
+                await asyncio.to_thread(checksummer.update, block)
             h = await asyncio.to_thread(blake2sum, block)
             if first_hash is None:
                 first_hash = h
             tasks.append(asyncio.create_task(put_one(block, offset, h)))
             offset += len(block)
             # backpressure: don't build an unbounded task list
-            while sum(1 for t in tasks if not t.done()) > PUT_BLOCKS_MAX_PARALLEL:
-                await asyncio.sleep(0)
+            while len(tasks) > PUT_BLOCKS_MAX_PARALLEL:
+                done, _ = await asyncio.wait(
+                    tasks, return_when=asyncio.FIRST_COMPLETED)
+                for t in done:
+                    if t.exception() is not None:
+                        raise t.exception()
+                tasks = [t for t in tasks if not t.done()]
             block = await chunker.next()
         if tasks:
             await asyncio.gather(*tasks)
     except BaseException:
         for t in tasks:
             t.cancel()
+        # settle cancelled tasks before the caller writes its cleanup
+        # tombstone, or a late block_ref insert could race past it
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
         raise
     return offset, md5.hexdigest(), first_hash
 
 
 async def handle_put(ctx, req: Request) -> Response:
     """ref: put.rs:60-120 handle_put."""
+    from ..checksum import request_checksum_value
+
     headers = extract_metadata_headers(req)
+    try:
+        expected_checksum = request_checksum_value(req.headers)
+    except ValueError as e:
+        raise bad_request(str(e))
     uuid, ts, etag, _ = await save_stream(
         ctx.garage, ctx.bucket_id, ctx.key, headers, req.body,
         content_md5=req.header("content-md5"),
+        expected_checksum=expected_checksum,
     )
     return Response(200, [("etag", f'"{etag}"'),
                           ("x-amz-version-id", uuid.hex())])

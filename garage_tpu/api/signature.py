@@ -259,15 +259,23 @@ class AwsChunkedReader:
     """
 
     def __init__(self, inner: BodyReader, verified: VerifiedRequest,
-                 region: str, amz_date: str, signed: bool):
+                 region: str, amz_date: str, signed: bool,
+                 trailer: bool = False,
+                 trailer_algo: Optional[str] = None):
         self.inner = inner
         self.v = verified
         self.region = region
         self.amz_date = amz_date
         self.signed = signed
+        self.trailer = trailer
         self.prev_sig = verified.signature
         self._buf = bytearray()
         self._done = False
+        self._checksummer = None
+        if trailer_algo is not None:
+            from .checksum import Checksummer
+
+            self._checksummer = Checksummer(trailer_algo)
 
     async def _read_line(self) -> bytes:
         while b"\r\n" not in self._buf:
@@ -320,13 +328,65 @@ class AwsChunkedReader:
             if not hmac.compare_digest(expect, sig):
                 raise HttpError(403, "chunk signature mismatch")
             self.prev_sig = expect
-        await self._read_exact(2)  # CRLF after data
         if size == 0:
-            # trailers (x-amz-trailer checksums) until exhaustion
+            # trailer section follows the final chunk header directly
+            # (ref: streaming.rs parse_next — no data CRLF here)
+            if self.trailer:
+                await self._verify_trailer()
+            else:
+                await self._read_exact(2)  # final CRLF
             await self.inner.drain()
             self._done = True
             return b""
+        await self._read_exact(2)  # CRLF after data
+        if self._checksummer is not None:
+            self._checksummer.update(data)
         return data
+
+    async def _verify_trailer(self) -> None:
+        """Parse `name:value[\\n]\\r\\n` (+ x-amz-trailer-signature for
+        signed mode), check the declared checksum against the payload,
+        and verify the trailer signature (ref: streaming.rs
+        TrailerChunk::parse_*, compute_streaming_trailer_signature)."""
+        line = await self._read_line()
+        if not line and self._checksummer is None and not self.signed:
+            return  # legitimately empty trailer section: 0\r\n\r\n
+        name, sep, value = line.partition(b":")
+        if not sep:
+            raise HttpError(400, "malformed trailer")
+        name = name.strip().decode("latin-1").lower()
+        value = value.strip().decode("latin-1")
+        if self.signed:
+            sig_line = await self._read_line()
+            if not sig_line.startswith(b"x-amz-trailer-signature:"):
+                raise HttpError(403, "missing x-amz-trailer-signature")
+            sig = sig_line.partition(b":")[2].strip().decode()
+            trailer_blob = f"{name}:{value}\n".encode()
+            scope = (f"{self.v.scope_date}/{self.region}/{SERVICE}"
+                     "/aws4_request")
+            ok = False
+            # AWS documents AWS4-HMAC-SHA256-TRAILER; the reference
+            # signs with AWS4-HMAC-SHA256-PAYLOAD — accept either.
+            for label in ("AWS4-HMAC-SHA256-TRAILER",
+                          "AWS4-HMAC-SHA256-PAYLOAD"):
+                sts = "\n".join([label, self.amz_date, scope, self.prev_sig,
+                                 _sha256(trailer_blob)])
+                expect = hmac.new(self.v.signing_key, sts.encode(),
+                                  hashlib.sha256).hexdigest()
+                if hmac.compare_digest(expect, sig):
+                    ok = True
+                    break
+            if not ok:
+                raise HttpError(403, "trailer signature mismatch")
+        if self._checksummer is not None:
+            from .checksum import header_algorithm
+
+            if header_algorithm(name) == self._checksummer.algo:
+                if value != self._checksummer.b64():
+                    raise HttpError(400, "trailing checksum mismatch")
+            else:
+                raise HttpError(400, f"expected {self._checksummer.algo} "
+                                     "trailer checksum")
 
     async def read_all(self, limit: int = 1 << 30) -> bytes:
         out = bytearray()
@@ -353,8 +413,15 @@ def wrap_body(req: Request, verified: Optional[VerifiedRequest],
     if cs == STREAMING_SIGNED:
         return AwsChunkedReader(req.body, verified, region, amz_date, True)
     if cs in (STREAMING_UNSIGNED_TRAILER, STREAMING_SIGNED_TRAILER):
+        from .checksum import trailer_algorithm
+
+        try:
+            talgo = trailer_algorithm(req.headers)
+        except ValueError as e:
+            raise HttpError(400, str(e))
         return AwsChunkedReader(req.body, verified, region, amz_date,
-                                cs == STREAMING_SIGNED_TRAILER)
+                                cs == STREAMING_SIGNED_TRAILER,
+                                trailer=True, trailer_algo=talgo)
     if cs and cs != UNSIGNED_PAYLOAD:
         return SignedPayloadReader(req.body, cs)
     return req.body

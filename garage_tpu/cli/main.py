@@ -211,6 +211,11 @@ async def _key(cli, args) -> int:
                            secret_key=args.secret_key, name=args.name or "")
         print(f"imported {r['key_id']}")
         return 0
+    if s in ("allow", "deny"):
+        await cli.call(f"key_{s}", key=args.key,
+                       create_bucket=args.create_bucket)
+        print("ok")
+        return 0
     return 1
 
 
@@ -262,6 +267,10 @@ def build_parser() -> argparse.ArgumentParser:
     kim.add_argument("key_id")
     kim.add_argument("secret_key")
     kim.add_argument("--name", default="")
+    for name in ("allow", "deny"):
+        x = pks.add_parser(name)
+        x.add_argument("key")
+        x.add_argument("--create-bucket", action="store_true")
     sub.add_parser("worker").add_subparsers(dest="subcmd").add_parser("list")
     sub.add_parser("stats")
     return p

@@ -120,6 +120,16 @@ class GarageHelper:
                                                   BucketKeyPerm(now_msec()))
             await self.g.key_table.insert(Key.deleted(key_id))
 
+    async def set_key_create_bucket(self, key_id: str, allow: bool) -> None:
+        """Grant/revoke the global create-bucket permission
+        (ref: helper/key.rs set_allow_create_bucket)."""
+        async with self.g.bucket_lock:
+            key = await self.get_existing_key(key_id)
+            kp = key.params
+            kp.allow_create_bucket = kp.allow_create_bucket.update(allow)
+            await self.g.key_table.insert(
+                Key(key_id, Deletable.present(kp)))
+
     async def set_bucket_key_permissions(self, bucket_id: bytes,
                                          key_id: str,
                                          perm: BucketKeyPerm) -> None:
