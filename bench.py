@@ -1,58 +1,37 @@
-"""Headline benchmark: RS(10,4) erasure encode throughput, GB/s per chip.
+"""Headline benchmark for the TPU-native block data path.
 
-Prints exactly one JSON line. Baseline: 4.0 GB/s/chip (BASELINE.md,
-driver target for the north-star metric "RS(10,4) encode MB/s").
-Runs on whatever accelerator JAX finds; if the TPU backend is
-unavailable it falls back to CPU with a smaller problem so the bench
-always reports (the unit field says which backend measured).
+Prints exactly one JSON line. Headline metric: RS(10,4) erasure encode
+GB/s per chip (BASELINE.md driver target: 4.0 GB/s/chip). The same line
+carries the system-level numbers the north star asks for ("S3 PutObject
+GB/s/chip; RS encode MB/s; scrub blocks/s"):
+
+  put_gbps           block throughput measured THROUGH
+                     BlockManager.rpc_put_block on an in-process 6-node
+                     erasure(4,2) loopback cluster (device feeder
+                     batches encode onto the TPU; quorum-acked writes)
+  scrub_blocks_per_s ScrubWorker.scrub_batch over stored 1 MiB blocks,
+                     content-hash verified in batched device passes
+  blake3_gbps        batched BLAKE3 content hashing on device
+
+A broken accelerator tunnel can hang JAX init forever, so the default
+backend is probed in a subprocess with a timeout (block/feeder.py); on
+failure everything falls back to CPU with smaller problem sizes and the
+probe error is carried in the output so the fallback is never silent.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
+import os
+import shutil
+import tempfile
 import time
 
 import numpy as np
 
 
-PROBE_TIMEOUT = 180.0  # first TPU init can be slow; a dead tunnel hangs
-
-
-def _probe_accelerator() -> bool:
-    """Check in a subprocess whether the default backend comes up — a
-    broken TPU tunnel can hang init indefinitely, which a timeout on a
-    child process converts into a clean CPU fallback."""
-    import subprocess
-    import sys
-
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=PROBE_TIMEOUT,
-            capture_output=True,
-        )
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
-
-
-def _get_backend():
-    if not _probe_accelerator():
-        import os
-
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-        return jax, "cpu"
-    import jax
-
-    return jax, jax.devices()[0].platform
-
-
-def main() -> None:
-    jax, platform = _get_backend()
-
+def bench_rs_encode(jax, platform: str) -> float:
     from garage_tpu.ops import rs
 
     k, m = 10, 4
@@ -63,27 +42,183 @@ def main() -> None:
     rng = np.random.default_rng(0)
     data = rng.integers(0, 256, size=(batch, k, shard_len), dtype=np.uint8)
     data = jax.device_put(data)
-
     parity = rs.encode(k, m, data)  # compile + warm
     jax.block_until_ready(parity)
-
     t0 = time.perf_counter()
     for _ in range(iters):
         parity = rs.encode(k, m, data)
     jax.block_until_ready(parity)
     dt = time.perf_counter() - t0
+    return batch * k * shard_len * iters / dt / 1e9
 
-    gbps = batch * k * shard_len * iters / dt / 1e9
-    print(
-        json.dumps(
-            {
-                "metric": "rs_10_4_encode",
-                "value": round(gbps, 3),
-                "unit": f"GB/s/chip[{platform}]",
-                "vs_baseline": round(gbps / 4.0, 3),
-            }
-        )
-    )
+
+def bench_blake3(jax, platform: str) -> float:
+    from garage_tpu.ops import treehash
+
+    if platform == "cpu":
+        batch, iters = 4, 2
+    else:
+        batch, iters = 32, 5
+    rng = np.random.default_rng(1)
+    msgs = rng.integers(0, 256, size=(batch, 1 << 20), dtype=np.uint8)
+    lengths = np.full(batch, 1 << 20, dtype=np.int32)
+    treehash.hash_batch_jax(msgs, lengths)  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        treehash.hash_batch_jax(msgs, lengths)
+    dt = time.perf_counter() - t0
+    return batch * (1 << 20) * iters / dt / 1e9
+
+
+async def _put_cluster_bench(tmp: str, platform: str) -> dict:
+    """6-node in-process loopback cluster, erasure(4,2): pump 1 MiB
+    blocks through BlockManager.rpc_put_block — the real quorum write
+    path (feeder batches the RS math; shard files land on tmpfs)."""
+    from garage_tpu.block import BlockManager, DataLayout
+    from garage_tpu.block.block import DataBlock
+    from garage_tpu.block.repair import ScrubWorker
+    from garage_tpu.db import open_db
+    from garage_tpu.net import LocalNetwork, NetApp
+    from garage_tpu.rpc import ReplicationMode, System
+    from garage_tpu.rpc.layout import NodeRole
+    from garage_tpu.utils.data import blake3sum
+
+    n, k, m = 6, 4, 2
+    nblocks = 16 if platform == "cpu" else 128
+    block_len = 1 << 20
+    net = LocalNetwork()
+    systems, managers = [], []
+    rm = ReplicationMode.parse(3, erasure=f"{k},{m}")
+    for i in range(n):
+        app = NetApp(b"bench-net")
+        net.register(app)
+        meta = os.path.join(tmp, f"node{i}")
+        os.makedirs(meta, exist_ok=True)
+        s = System(app, rm, meta, status_interval=0.2, ping_interval=5.0)
+        systems.append(s)
+    tasks = [asyncio.create_task(s.run()) for s in systems]
+    for s in systems[1:]:
+        await s.netapp.try_connect(systems[0].netapp.public_addr,
+                                   systems[0].id)
+        s.peering.add_peer(systems[0].netapp.public_addr, systems[0].id)
+    deadline = asyncio.get_event_loop().time() + 15
+    while asyncio.get_event_loop().time() < deadline:
+        if all(len(s.netapp.conns) == n - 1 for s in systems):
+            break
+        await asyncio.sleep(0.05)
+    lm = systems[0].layout_manager
+    for s in systems:
+        lm.history.stage_role(s.id, NodeRole(zone="z1", capacity=1 << 30))
+    lm.apply_staged(None)
+    while asyncio.get_event_loop().time() < deadline:
+        if all(s.layout_manager.history.current().version == 1
+               for s in systems):
+            break
+        await asyncio.sleep(0.05)
+    for i, s in enumerate(systems):
+        db = open_db(os.path.join(tmp, f"node{i}", "db"), engine="memory")
+        lay = DataLayout.single(os.path.join(tmp, f"node{i}", "data"))
+        managers.append(BlockManager(s, db, lay, compression=False))
+
+    rng = np.random.default_rng(2)
+    blocks = [rng.integers(0, 256, block_len, dtype=np.uint8).tobytes()
+              for _ in range(nblocks)]
+    hashes = [blake3sum(b) for b in blocks]
+
+    for i in range(2):  # warm/compile the device encode path
+        await managers[0].rpc_put_block(hashes[i], blocks[i])
+
+    t0 = time.perf_counter()
+    conc = 16
+    idx, pending = 2, set()
+    while idx < nblocks or pending:
+        while idx < nblocks and len(pending) < conc:
+            pending.add(asyncio.create_task(
+                managers[0].rpc_put_block(hashes[idx], blocks[idx])))
+            idx += 1
+        done, pending = await asyncio.wait(
+            pending, return_when=asyncio.FIRST_COMPLETED)
+        for t in done:
+            t.result()
+    dt = time.perf_counter() - t0
+    put_gbps = (nblocks - 2) * block_len / dt / 1e9
+
+    # ---- scrub: replicate-mode batched device verify -------------------
+    app = NetApp(b"bench-net")
+    net.register(app)
+    sm = os.path.join(tmp, "scrubnode")
+    os.makedirs(sm, exist_ok=True)
+    s1 = System(app, ReplicationMode.parse(1), sm,
+                status_interval=3600.0, ping_interval=3600.0)
+    db1 = open_db(os.path.join(sm, "db"), engine="memory")
+    mgr1 = BlockManager(s1, db1, DataLayout.single(os.path.join(sm, "data")),
+                        compression=False)
+    for h, b in zip(hashes, blocks):
+        mgr1.write_local(h, DataBlock.plain(b).pack())
+    scrubber = ScrubWorker(mgr1)
+    await scrubber.scrub_batch(hashes[:4])  # warm/compile
+    t0 = time.perf_counter()
+    bad = 0
+    for i in range(0, nblocks, 32):
+        bad += await scrubber.scrub_batch(hashes[i:i + 32])
+    scrub_bps = nblocks / (time.perf_counter() - t0)
+
+    feeder_stats = dict(managers[0].feeder.stats)
+    feeder_perf = {**managers[0].feeder.perf_summary(),
+                   **{f"scrub_{k2}": v for k2, v in
+                      mgr1.feeder.perf_summary().items()}}
+    for s in systems + [s1]:
+        await s.stop()
+    for t in tasks:
+        t.cancel()
+    await asyncio.gather(*tasks, return_exceptions=True)
+    return {
+        "put_gbps": round(put_gbps, 3),
+        "scrub_blocks_per_s": round(scrub_bps, 1),
+        "scrub_corrupt": bad,
+        "feeder_device_items": feeder_stats["device_items"],
+        "feeder_max_batch": feeder_stats["max_batch"],
+        "feeder_mbps": feeder_perf,
+    }
+
+
+def main() -> None:
+    from garage_tpu.block.feeder import probe_device
+
+    probe = probe_device(timeout=180.0)
+    if not probe["ok"]:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if not probe["ok"]:
+        jax.config.update("jax_platforms", "cpu")
+    platform = jax.devices()[0].platform
+
+    extra: dict = {"platform": platform}
+    if probe.get("error"):
+        extra["probe_error"] = probe["error"]
+
+    gbps = bench_rs_encode(jax, platform)
+    extra["blake3_gbps"] = round(bench_blake3(jax, platform), 3)
+
+    tmp = tempfile.mkdtemp(
+        prefix="gt_bench_",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
+    try:
+        extra.update(asyncio.run(
+            asyncio.wait_for(_put_cluster_bench(tmp, platform), 600)))
+    except Exception as e:  # system bench must never kill the headline
+        extra["put_error"] = f"{type(e).__name__}: {e}"[:300]
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    print(json.dumps({
+        "metric": "rs_10_4_encode",
+        "value": round(gbps, 3),
+        "unit": f"GB/s/chip[{platform}]",
+        "vs_baseline": round(gbps / 4.0, 3),
+        **extra,
+    }))
 
 
 if __name__ == "__main__":

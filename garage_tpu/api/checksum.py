@@ -34,18 +34,37 @@ _CRC32C_TABLE = _make_table(_CRC32C_POLY, 32)
 _CRC64NVME_TABLE = _make_table(_CRC64NVME_POLY, 64)
 
 
-def crc32c(data: bytes, crc: int = 0) -> int:
+def _crc32c_py(data: bytes, crc: int = 0) -> int:
     crc ^= 0xFFFFFFFF
     for b in data:
         crc = (crc >> 8) ^ _CRC32C_TABLE[(crc ^ b) & 0xFF]
     return crc ^ 0xFFFFFFFF
 
 
-def crc64nvme(data: bytes, crc: int = 0) -> int:
+def _crc64nvme_py(data: bytes, crc: int = 0) -> int:
     crc ^= 0xFFFFFFFFFFFFFFFF
     for b in data:
         crc = (crc >> 8) ^ _CRC64NVME_TABLE[(crc ^ b) & 0xFF]
     return crc ^ 0xFFFFFFFFFFFFFFFF
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    """Native slice-by-8 when the library is already loaded (the server
+    warms it off-loop at startup; checking `loaded` here never triggers
+    a blocking compile on the event loop), else the Python table loop."""
+    from .. import native
+
+    if native.loaded():
+        return native.crc32c(data, crc)
+    return _crc32c_py(data, crc)
+
+
+def crc64nvme(data: bytes, crc: int = 0) -> int:
+    from .. import native
+
+    if native.loaded():
+        return native.crc64nvme(data, crc)
+    return _crc64nvme_py(data, crc)
 
 
 class Checksummer:

@@ -72,6 +72,17 @@ async def handle_put_part(ctx, req: Request) -> Response:
         raise S3Error("InvalidArgument", 400, "bad partNumber")
     mpu, _ov = await _get_upload(ctx, q.get("uploadId", ""))
 
+    # validate headers BEFORE inserting any rows — a 400 here must not
+    # leak an uploading version/part placeholder
+    from ..checksum import Checksummer, request_checksum_value
+
+    try:
+        expected_checksum = request_checksum_value(req.headers)
+    except ValueError as e:
+        raise S3Error("InvalidRequest", 400, str(e))
+    checksummer = (Checksummer(expected_checksum[0])
+                   if expected_checksum is not None else None)
+
     ts = mpu.next_timestamp(part_number)
     version_uuid = gen_uuid()
     # register the part (etag/size unset until data is stored)
@@ -81,15 +92,6 @@ async def handle_put_part(ctx, req: Request) -> Response:
     await ctx.garage.mpu_table.insert(mpu2)
     version = Version.new(version_uuid, (BACKLINK_MPU, mpu.upload_id))
     await ctx.garage.version_table.insert(version)
-
-    from ..checksum import Checksummer, request_checksum_value
-
-    try:
-        expected_checksum = request_checksum_value(req.headers)
-    except ValueError as e:
-        raise S3Error("InvalidRequest", 400, str(e))
-    checksummer = (Checksummer(expected_checksum[0])
-                   if expected_checksum is not None else None)
     chunker = Chunker(req.body, ctx.garage.config.block_size)
     first = await chunker.next()
     if first is None:

@@ -22,7 +22,7 @@ from ...model.s3.object_table import (Object, ObjectVersion,
                                       object_upload_version)
 from ...model.s3.version_table import BACKLINK_OBJECT, Version
 from ...utils.crdt import now_msec
-from ...utils.data import blake2sum, gen_uuid
+from ...utils.data import gen_uuid
 from ..http import Request, Response
 from .xml import S3Error, bad_request
 
@@ -185,7 +185,7 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
             if checksummer is not None:
                 # pure-python CRCs are slow; keep them off the event loop
                 await asyncio.to_thread(checksummer.update, block)
-            h = await asyncio.to_thread(blake2sum, block)
+            h = await garage.block_manager.hash_block(block)
             if first_hash is None:
                 first_hash = h
             tasks.append(asyncio.create_task(put_one(block, offset, h)))

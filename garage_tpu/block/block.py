@@ -12,7 +12,7 @@ from __future__ import annotations
 import zlib
 from dataclasses import dataclass
 
-from ..utils.data import blake2sum
+from ..utils.data import content_hash_matches
 from ..utils.error import CorruptData
 
 COMPRESSION_NONE = 0
@@ -45,13 +45,15 @@ class DataBlock:
         return zlib.decompress(self.bytes)
 
     def verify(self, hash32: bytes) -> None:
-        """ref: block.rs:69-83 (plain: blake2 check; compressed: integrity
-        of the decompression stream + blake2 of the result)."""
+        """ref: block.rs:69-83 (plain: content-hash check; compressed:
+        integrity of the decompression stream + content hash of the
+        result). Content hash is BLAKE3 by default (utils/data.py),
+        blake2 accepted for stores migrated from the legacy algo."""
         try:
             plain = self.plain_bytes()
         except zlib.error as e:
             raise CorruptData(hash32) from e
-        if blake2sum(plain) != hash32:
+        if not content_hash_matches(plain, hash32):
             raise CorruptData(hash32)
 
     # wire format: 1 header byte + payload

@@ -23,7 +23,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..ops import rs
-from ..utils.data import blake2sum
+from ..utils.data import content_hash_matches
 from ..utils.error import MissingBlock
 
 
@@ -62,7 +62,7 @@ class ReplicateCodec(BlockCodec):
         raise MissingBlock(b"")
 
     def parity_ok(self, parts, hash32):
-        return any(blake2sum(b) == hash32 for b in parts.values())
+        return any(content_hash_matches(b, hash32) for b in parts.values())
 
 
 class ErasureCodec(BlockCodec):

@@ -1,0 +1,419 @@
+"""DeviceFeeder: batches block math from concurrent requests onto the TPU.
+
+The reference does its per-block CPU work (hashing, compression) inline
+in each request task (src/api/s3/put.rs:413-477 spawn_blocking, one
+block at a time). A TPU earns its keep only on *batches* — so the data
+path here funnels every block-math request (content hash, RS encode,
+scrub verify) through one bounded queue. A single dispatcher drains
+whatever has accumulated, groups it by operation and shape, and issues
+one batched JAX call per group (ops/treehash.hash_batch_jax,
+ops/rs.encode). Under load, concurrent PUTs coalesce into MXU-sized
+batches for free; when idle, single requests take the native C path
+(garage_tpu/native) which beats a device round-trip for one block.
+
+Backend selection: the `axon` remote-TPU backend can hang indefinitely
+on init when the tunnel is down (observed: jax.devices() blocked >500 s)
+— so device use is gated behind a subprocess probe with a timeout,
+cached in /tmp. Until the probe succeeds, everything runs host-side;
+the data path never blocks on a dead tunnel.
+
+Once the device is up, the feeder CALIBRATES rather than assumes: it
+tracks observed bytes/s per (op, backend) and routes each batch to the
+faster one, re-probing the loser periodically. On a real TPU host
+(PCIe/DMA) the batched device path wins by an order of magnitude; on a
+tunneled dev chip where host<->device moves at tens of MB/s the native C
+kernels win — measured, not guessed (a fixed threshold was wrong on both
+ends: this box's tunnel does ~300 MB/s h2d but ~15 MB/s d2h).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger("garage_tpu.block.feeder")
+
+# a (possibly remote) device round trip only pays above these sizes
+_DEVICE_MIN_BYTES = 4 << 20
+_DEVICE_MIN_ITEMS = 4
+# re-try the losing backend every N routed batches so a recovered
+# tunnel (or a warmed-up XLA program) gets re-discovered
+_EXPLORE_EVERY = 32
+# a batch stuck longer than this means the device backend hung (the
+# axon tunnel can block inside XLA calls); the batch re-runs host-side
+# and the device path is disabled
+_BATCH_TIMEOUT = 300.0
+
+PROBE_TIMEOUT = 60.0
+# per-uid cache path: a shared /tmp name would let another local user
+# pin the probe verdict for every process on the box
+_PROBE_CACHE = os.path.join(
+    tempfile.gettempdir(),
+    f"garage_tpu_device_probe.{os.getuid() if hasattr(os, 'getuid') else 0}.json",
+)
+_PROBE_TTL = 600.0
+
+_probe_lock = threading.Lock()
+_probe_result: Optional[dict] = None
+
+
+def probe_device(timeout: float = PROBE_TIMEOUT, force: bool = False) -> dict:
+    """Subprocess-probe the default JAX backend. Returns
+    {"ok": bool, "platform": str, "error": str}. Cached in-process and in
+    /tmp (TTL 10 min) so a dead tunnel costs one timeout, not one per
+    worker."""
+    global _probe_result
+    with _probe_lock:
+        if _probe_result is not None and not force:
+            return _probe_result
+        if not force:
+            try:
+                with open(_PROBE_CACHE) as f:
+                    cached = json.load(f)
+                age = time.time() - cached.get("at", 0)
+                if 0 <= age < _PROBE_TTL:  # reject future timestamps
+                    _probe_result = cached
+                    return cached
+            except Exception:
+                pass
+        res = {"ok": False, "platform": "cpu", "error": "", "at": time.time()}
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                timeout=timeout, capture_output=True, text=True,
+            )
+            if r.returncode == 0:
+                plat = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "cpu"
+                res["platform"] = plat
+                res["ok"] = plat not in ("cpu",)
+            else:
+                res["error"] = (r.stderr or "")[-500:]
+        except subprocess.TimeoutExpired:
+            res["error"] = f"jax.devices() did not return within {timeout}s"
+        except OSError as e:
+            res["error"] = str(e)
+        _probe_result = res
+        try:
+            with open(_PROBE_CACHE + ".tmp", "w") as f:
+                json.dump(res, f)
+            os.replace(_PROBE_CACHE + ".tmp", _PROBE_CACHE)
+        except OSError:
+            pass
+        return res
+
+
+class _Item:
+    __slots__ = ("op", "data", "future", "extra")
+
+    def __init__(self, op: str, data, future, extra=None):
+        self.op = op
+        self.data = data
+        self.future = future
+        self.extra = extra
+
+
+class DeviceFeeder:
+    """One per BlockManager. mode: "auto" (probe, then use device when
+    batches are big enough), "off" (host only), "require" (device always;
+    raise if probe fails — bench/test use)."""
+
+    def __init__(self, codec=None, mode: str = "auto"):
+        self.codec = codec
+        self.mode = mode
+        self._q: Optional[asyncio.Queue] = None
+        self._task: Optional[asyncio.Task] = None
+        self._device_ok: Optional[bool] = None
+        self._probing = False
+        self.stats = {"batches": 0, "items": 0, "device_batches": 0,
+                      "device_items": 0, "max_batch": 0}
+        # calibration: (op, backend) -> [bytes, seconds]; routing picks
+        # the backend with the best observed bytes/s, exploring the
+        # other every _EXPLORE_EVERY batches
+        self._perf: dict[tuple[str, str], list[float]] = {}
+        self._routed: dict[str, int] = {}
+
+    def perf_summary(self) -> dict[str, float]:
+        """Observed MB/s per (op, backend) — /metrics + bench surface."""
+        return {f"{op}/{be}": round(b / t / 1e6, 1)
+                for (op, be), (b, t) in self._perf.items() if t > 0}
+
+    # ---- lifecycle ----------------------------------------------------
+
+    def _ensure_started(self) -> None:
+        if self._task is None or self._task.done():
+            self._q = asyncio.Queue()
+            self._task = asyncio.create_task(self._run(), name="device-feeder")
+        if self.mode == "off":
+            self._device_ok = False
+        elif self.mode == "require" and self._device_ok is None:
+            res = probe_device()
+            if not res["ok"]:
+                raise RuntimeError(f"device required but probe failed: "
+                                   f"{res['error'] or res['platform']}")
+            self._device_ok = True
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+        # fail anything still queued so no caller awaits forever
+        if self._q is not None:
+            while not self._q.empty():
+                item = self._q.get_nowait()
+                if not item.future.done():
+                    item.future.set_exception(RuntimeError("feeder stopped"))
+
+    def _maybe_start_probe(self) -> None:
+        """Kick the backend probe in a thread; host path until it lands."""
+        if self._device_ok is not None or self._probing or self.mode != "auto":
+            return
+        self._probing = True
+
+        def run():
+            try:
+                res = probe_device()
+                self._device_ok = bool(res["ok"])
+                if self._device_ok:
+                    log.info("device data plane active: %s", res["platform"])
+                elif res["error"]:
+                    log.info("device probe failed, host data plane: %s",
+                             res["error"])
+            finally:
+                self._probing = False
+
+        threading.Thread(target=run, daemon=True,
+                         name="feeder-probe").start()
+
+    # ---- public async ops ---------------------------------------------
+
+    async def _submit(self, op: str, data, extra=None):
+        self._ensure_started()
+        fut = asyncio.get_running_loop().create_future()
+        await self._q.put(_Item(op, data, fut, extra))
+        return await fut
+
+    async def hash(self, data: bytes) -> bytes:
+        """Content hash of one block (batched with concurrent callers)."""
+        return await self._submit("hash", data)
+
+    async def encode(self, packed: bytes) -> list[bytes]:
+        """Erasure parts for one packed block (batched)."""
+        if self.codec is None:
+            raise RuntimeError("feeder has no codec")
+        return await self._submit("encode", packed)
+
+    async def verify_blocks(self, items: list[tuple[bytes, bytes]]
+                            ) -> list[bool]:
+        """[(hash32, plain)] -> per-item content-hash match (scrub)."""
+        if not items:
+            return []
+        futs = [self._submit("verify", (h, d)) for h, d in items]
+        return list(await asyncio.gather(*futs))
+
+    # ---- dispatcher ----------------------------------------------------
+
+    async def _run(self) -> None:
+        while True:
+            first = await self._q.get()
+            batch = [first]
+            # greedy non-waiting drain: whatever queued while the last
+            # batch was on the device becomes the next batch
+            while not self._q.empty() and len(batch) < 256:
+                batch.append(self._q.get_nowait())
+            self._maybe_start_probe()
+            try:
+                try:
+                    results = await asyncio.wait_for(
+                        asyncio.to_thread(self._run_batch, batch),
+                        _BATCH_TIMEOUT)
+                except asyncio.TimeoutError:
+                    # hung device call: the stuck thread is abandoned,
+                    # the device path disabled, the batch re-run on the
+                    # host (native kernels) in a fresh thread
+                    log.error("feeder batch stuck >%ss; disabling device "
+                              "path and re-running host-side",
+                              _BATCH_TIMEOUT)
+                    self._device_ok = False
+                    # bounded too: if even the JAX-free host path stalls,
+                    # fail this batch instead of wedging the dispatcher
+                    results = await asyncio.wait_for(
+                        asyncio.to_thread(self._run_batch, batch, True),
+                        _BATCH_TIMEOUT)
+                for item, res in zip(batch, results):
+                    if not item.future.done():
+                        if isinstance(res, BaseException):
+                            item.future.set_exception(res)
+                        else:
+                            item.future.set_result(res)
+            except BaseException as e:
+                for item in batch:
+                    if not item.future.done():
+                        item.future.set_exception(
+                            e if not isinstance(e, asyncio.CancelledError)
+                            else RuntimeError("feeder stopped"))
+                if isinstance(e, asyncio.CancelledError):
+                    raise
+
+    # ---- batch execution (worker thread) -------------------------------
+
+    def _pick_backend(self, op: str, total_bytes: int, n_items: int) -> str:
+        if self._device_ok is not True:
+            return "host"
+        if total_bytes < _DEVICE_MIN_BYTES and n_items < _DEVICE_MIN_ITEMS:
+            return "host"  # tiny batches never amortize a round trip
+        self._routed[op] = self._routed.get(op, 0) + 1
+        dev = self._perf.get((op, "device"))
+        host = self._perf.get((op, "host"))
+        if dev is None:
+            return "device"  # first sizeable batch: measure the device
+        if host is None:
+            return "host"
+        if self._routed[op] % _EXPLORE_EVERY == 0:
+            # periodic re-probe of whichever backend is currently losing
+            return ("device" if dev[0] / dev[1] < host[0] / host[1]
+                    else "host")
+        return ("device" if dev[0] / dev[1] >= host[0] / host[1]
+                else "host")
+
+    def _record(self, op: str, backend: str, nbytes: int, dt: float) -> None:
+        ent = self._perf.setdefault((op, backend), [0.0, 0.0])
+        # exponential forgetting so old (e.g. cold-compile) samples fade
+        if ent[1] > 30.0:
+            ent[0] *= 0.5
+            ent[1] *= 0.5
+        ent[0] += nbytes
+        ent[1] += max(dt, 1e-6)
+
+    def _run_batch(self, batch: list[_Item], force_host: bool = False
+                   ) -> list:
+        self.stats["batches"] += 1
+        self.stats["items"] += len(batch)
+        self.stats["max_batch"] = max(self.stats["max_batch"], len(batch))
+        results: list = [None] * len(batch)
+        by_op: dict[str, list[int]] = {}
+        for i, item in enumerate(batch):
+            by_op.setdefault(item.op, []).append(i)
+        for op, idxs in by_op.items():
+            blobs = [batch[i].data for i in idxs]
+            if op == "verify":
+                total = sum(len(b) for _, b in blobs)
+            else:
+                total = sum(len(b) for b in blobs
+                            if isinstance(b, (bytes, bytearray)))
+            perf_op = "hash" if op == "verify" else op
+            host_only = force_host
+            if perf_op == "hash":
+                from ..utils import data as _data
+
+                if _data._content_algo != "blake3":
+                    host_only = True  # blake2 never runs on device
+            backend = ("host" if host_only else
+                       self._pick_backend(perf_op, total, len(blobs)))
+            t0 = time.perf_counter()
+            try:
+                try:
+                    out = self._do_op(op, blobs, backend)
+                except Exception as e:
+                    if backend != "device":
+                        raise
+                    # a failing device (dead tunnel, OOM, XLA error) must
+                    # not fail requests while the host path works: retry
+                    # host-side and penalize the device in calibration
+                    log.warning("device %s batch failed (%s: %s); "
+                                "falling back to host", op,
+                                type(e).__name__, e)
+                    self._record(perf_op, "device", 0, 60.0)
+                    backend = "host"
+                    t0 = time.perf_counter()
+                    out = self._do_op(op, blobs, backend)
+                for i, o in zip(idxs, out):
+                    results[i] = o
+                self._record(perf_op, backend, total,
+                             time.perf_counter() - t0)
+                if backend == "device":
+                    self.stats["device_batches"] += 1
+                    self.stats["device_items"] += len(idxs)
+            except Exception as e:
+                for i in idxs:
+                    results[i] = e
+        return results
+
+    def _do_op(self, op: str, blobs: list, backend: str) -> list:
+        if op == "hash":
+            return self._do_hash(blobs, backend)
+        if op == "verify":
+            from ..utils.data import content_hash_matches
+
+            digs = self._do_hash([b for _, b in blobs], backend)
+            return [d == h or content_hash_matches(b, h)
+                    for d, (h, b) in zip(digs, blobs)]
+        if op == "encode":
+            return self._do_encode(blobs, backend)
+        raise RuntimeError(f"unknown feeder op {op!r}")
+
+    def _do_hash(self, blobs: list[bytes], backend: str) -> list[bytes]:
+        from ..utils import data as _data
+
+        if _data._content_algo != "blake3":
+            return [_data.content_hash(b) for b in blobs]
+        if backend == "device":
+            from ..ops import treehash
+
+            return treehash.blake3_many(blobs)
+        try:
+            from .. import native
+
+            if native.available():
+                return native.blake3_many(blobs)
+        except Exception:
+            pass
+        from ..utils.data import blake3sum
+
+        return [blake3sum(b) for b in blobs]
+
+    def _do_encode(self, blocks: list[bytes], backend: str
+                   ) -> list[list[bytes]]:
+        from ..ops import rs
+
+        codec = self.codec
+        if backend == "device":
+            return codec.encode_batch(blocks)
+        try:
+            from .. import native
+
+            if native.available():
+                out = []
+                for b in blocks:
+                    shards = rs.split_stripe(b, codec.k)
+                    parity = native.gf_matmul(
+                        rs.parity_matrix(codec.k, codec.m), shards)
+                    out.append([bytes(s) for s in shards]
+                               + [bytes(p) for p in parity])
+                return out
+        except Exception:
+            pass
+        # last resort: pure numpy — NEVER codec.encode here, whose JAX
+        # path would re-enter the possibly-dead backend this host branch
+        # exists to avoid
+        out = []
+        for b in blocks:
+            shards = rs.split_stripe(b, codec.k)
+            parity = rs.encode_np(codec.k, codec.m, shards)
+            out.append([bytes(s) for s in shards]
+                       + [bytes(p) for p in parity])
+        return out

@@ -60,10 +60,54 @@ def unpack_shard(raw: bytes) -> tuple[bytes, int]:
     return data, packed_len
 
 
+class _ByteSemaphore:
+    """Async counting semaphore over bytes with FIFO wakeup; a single
+    oversize request (> capacity) is allowed when it is alone, so giant
+    blocks don't deadlock."""
+
+    def __init__(self, capacity: int):
+        self.capacity = max(1, capacity)
+        self.in_use = 0
+        self._waiters: list[tuple[int, asyncio.Future]] = []
+
+    async def acquire(self, n: int) -> None:
+        # the fast path must not barge past queued waiters, or a large
+        # request starves under a steady stream of small ones
+        if not self._waiters and (
+                self.in_use == 0 or self.in_use + n <= self.capacity):
+            self.in_use += n
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.append((n, fut))
+        try:
+            await fut
+        except BaseException:
+            try:
+                self._waiters.remove((n, fut))
+            except ValueError:
+                # already popped by release(): granted unless cancelled
+                if fut.done() and not fut.cancelled():
+                    self.release(n)
+            raise
+
+    def release(self, n: int) -> None:
+        self.in_use -= n
+        while self._waiters:
+            need, fut = self._waiters[0]
+            if self.in_use != 0 and self.in_use + need > self.capacity:
+                break
+            self._waiters.pop(0)
+            if not fut.cancelled():
+                self.in_use += need
+                fut.set_result(None)
+
+
 class BlockManager:
     def __init__(self, system, db, data_layout: DataLayout,
                  codec: Optional[BlockCodec] = None,
-                 compression: bool = True, fsync: bool = False):
+                 compression: bool = True, fsync: bool = False,
+                 device_mode: str = "auto",
+                 ram_buffer_max: int = 256 * 1024 * 1024):
         self.system = system
         self.db = db
         self.data_layout = data_layout
@@ -80,6 +124,17 @@ class BlockManager:
                 codec = ReplicateCodec(rm.factor,
                                        write_quorum=rm.write_quorum)
         self.codec = codec
+        from .feeder import DeviceFeeder
+
+        self.feeder = DeviceFeeder(
+            codec=codec if isinstance(codec, ErasureCodec) else None,
+            mode=device_mode,
+        )
+        # RAM held by in-flight outbound block writes, bounded like the
+        # reference's buffer_stream semaphore (ref: manager.rs:156,
+        # util/config.rs:272-274 block_ram_buffer_max). Slot unit = one
+        # byte; putters acquire len(packed) before fan-out.
+        self._ram_sem = _ByteSemaphore(ram_buffer_max)
         self.endpoint = system.netapp.endpoint("garage_tpu/block").set_handler(
             self._handle
         )
@@ -100,15 +155,28 @@ class BlockManager:
         if scrub:
             runner.spawn_worker(ScrubWorker(self))
 
+    async def stop(self) -> None:
+        await self.feeder.stop()
+
     # ==== cluster write path (ref: manager.rs:366-450) ==================
 
+    async def hash_block(self, data: bytes) -> bytes:
+        """Content hash of a plain block — batched with all concurrent
+        callers through the device feeder (API PUT path entry point)."""
+        return await self.feeder.hash(data)
+
     async def rpc_put_block(self, hash32: bytes, data: bytes) -> None:
-        blk = DataBlock.compress(data) if self.compression else DataBlock.plain(data)
-        packed = blk.pack()
-        if self.erasure:
-            await self._put_erasure(hash32, packed)
-        else:
-            await self._put_replicate(hash32, packed)
+        await self._ram_sem.acquire(len(data))
+        try:
+            blk = (await asyncio.to_thread(DataBlock.compress, data)
+                   if self.compression else DataBlock.plain(data))
+            packed = blk.pack()
+            if self.erasure:
+                await self._put_erasure(hash32, packed)
+            else:
+                await self._put_replicate(hash32, packed)
+        finally:
+            self._ram_sem.release(len(data))
 
     async def _put_replicate(self, hash32: bytes, packed: bytes) -> None:
         helper = self.system.layout_helper
@@ -123,7 +191,7 @@ class BlockManager:
             )
 
     async def _put_erasure(self, hash32: bytes, packed: bytes) -> None:
-        parts = self.codec.encode(packed)
+        parts = await self.feeder.encode(packed)
         helper = self.system.layout_helper
         with helper.write_lock():
             # One shard placement per live layout version, mirroring

@@ -66,6 +66,12 @@ class Garage:
                  ping_interval: Optional[float] = None):
         self.config = config
         self.bg_vars = BgVars()
+        from ..utils.data import set_content_hash_algo
+
+        set_content_hash_algo(config.block_hash_algo)
+        from .. import native
+
+        native.warm_async()  # build the C kernels off the event loop
         os.makedirs(config.metadata_dir, exist_ok=True)
         for d in config.data_dirs:
             os.makedirs(d.path, exist_ok=True)
@@ -121,6 +127,8 @@ class Garage:
             self.system, self.db, self.data_layout,
             compression=config.compression_level is not None,
             fsync=config.data_fsync,
+            device_mode="auto" if config.tpu.enable else "off",
+            ram_buffer_max=config.block_ram_buffer_max,
         )
 
         # ---- tables (ref: garage.rs:178-248) ---------------------------
@@ -212,5 +220,6 @@ class Garage:
 
     async def stop(self) -> None:
         await self.runner.shutdown()
+        await self.block_manager.stop()
         await self.system.stop()
         self.db.close()

@@ -22,7 +22,6 @@ from ...table.replication import (SyncPartition, TableShardedReplication,
                                   partition_first_hash)
 from ...table.schema import Entry, TableSchema, tree_key
 from ...utils.crdt import Bool
-from ...utils.data import blake2sum
 
 
 class BlockRef(Entry):

@@ -1,0 +1,172 @@
+"""Native host kernels (C, built on first import, loaded via ctypes).
+
+The framework's compute path is JAX/XLA on the accelerator; the runtime
+around it keeps native-code hot spots on the host: BLAKE3 content
+hashing and GF(2^8) RS math for when blocks are handled one at a time
+(server PUT fallback, shard checksum verify, offline tools). Mirrors the
+reference's use of native code for its data path (the reference is Rust
+end to end; here C serves the same role behind a Python runtime).
+
+Build: one `gcc -O3 -shared` invocation, cached by source hash under
+_build/. If no toolchain is available the callers fall back to the pure
+Python / numpy implementations (ops/treehash.py, ops/gf256.py) — slower
+but identical results. Set GARAGE_TPU_NO_NATIVE=1 to force the fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "b3gf.c")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+_lock = threading.Lock()
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    if os.environ.get("GARAGE_TPU_NO_NATIVE"):
+        return None
+    try:
+        with open(_SRC, "rb") as f:
+            src = f.read()
+    except OSError:
+        return None
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    build_dir = os.path.join(_HERE, "_build")
+    so_path = os.path.join(build_dir, f"b3gf-{tag}.so")
+    if not os.path.exists(so_path):
+        os.makedirs(build_dir, exist_ok=True)
+        tmp = so_path + f".tmp{os.getpid()}"
+        for cc in ("cc", "gcc", "g++"):
+            try:
+                r = subprocess.run(
+                    [cc, "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+                    capture_output=True,
+                    timeout=120,
+                )
+            except (OSError, subprocess.TimeoutExpired):
+                continue
+            if r.returncode == 0:
+                os.replace(tmp, so_path)
+                break
+        else:
+            return None
+    try:
+        lib = ctypes.CDLL(so_path)
+    except OSError:
+        return None
+    lib.b3_hash.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p]
+    lib.b3_hash_many.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.gf256_matmul.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+    ]
+    lib.crc32c_update.argtypes = [ctypes.c_uint32, ctypes.c_char_p,
+                                  ctypes.c_uint64]
+    lib.crc32c_update.restype = ctypes.c_uint32
+    lib.crc64nvme_update.argtypes = [ctypes.c_uint64, ctypes.c_char_p,
+                                     ctypes.c_uint64]
+    lib.crc64nvme_update.restype = ctypes.c_uint64
+    return lib
+
+
+def _get() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if not _tried:
+        with _lock:
+            if not _tried:
+                _lib = _build_and_load()
+                _tried = True
+    return _lib
+
+
+def available() -> bool:
+    return _get() is not None
+
+
+def loaded() -> bool:
+    """True if the library is ALREADY built and loaded — never triggers
+    a build (callers on latency-sensitive paths gate on this)."""
+    return _lib is not None
+
+
+def warm_async() -> None:
+    """Kick the build/load in a daemon thread (server startup)."""
+    threading.Thread(target=available, daemon=True,
+                     name="native-build").start()
+
+
+def blake3(data: bytes) -> bytes:
+    """32-byte BLAKE3 digest (native; raises if the library is absent —
+    use utils.data.blake3sum for the auto-fallback entry point)."""
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    out = ctypes.create_string_buffer(32)
+    lib.b3_hash(data, len(data), out)
+    return out.raw
+
+
+def blake3_many(blobs: list[bytes]) -> list[bytes]:
+    """Hash many messages in one native call (GIL released throughout)."""
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = len(blobs)
+    if n == 0:
+        return []
+    offs = np.zeros(n, dtype=np.int64)
+    lens = np.array([len(b) for b in blobs], dtype=np.int64)
+    if n > 1:
+        np.cumsum(lens[:-1], out=offs[1:])
+    joined = b"".join(blobs)
+    buf = (np.frombuffer(joined, dtype=np.uint8) if joined
+           else np.zeros(1, dtype=np.uint8))
+    out = np.empty((n, 32), dtype=np.uint8)
+    lib.b3_hash_many(
+        buf.ctypes.data, n, offs.ctypes.data, lens.ctypes.data,
+        out.ctypes.data,
+    )
+    return [out[i].tobytes() for i in range(n)]
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    return lib.crc32c_update(crc, data, len(data))
+
+
+def crc64nvme(data: bytes, crc: int = 0) -> int:
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    return lib.crc64nvme_update(crc, data, len(data))
+
+
+def gf_matmul(mat: np.ndarray, x: np.ndarray) -> np.ndarray:
+    """(r, s) @ (s, n) over GF(2^8) -> (r, n); native table kernel."""
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    x = np.ascontiguousarray(x, dtype=np.uint8)
+    r, s = mat.shape
+    s2, n = x.shape
+    if s != s2:
+        raise ValueError(f"shape mismatch {mat.shape} @ {x.shape}")
+    out = np.empty((r, n), dtype=np.uint8)
+    lib.gf256_matmul(mat.ctypes.data, r, s, x.ctypes.data, n, out.ctypes.data)
+    return out

@@ -1,0 +1,294 @@
+/* Native host kernels for the block data path: BLAKE3 hashing and
+ * GF(2^8) matrix application (Reed-Solomon encode/decode).
+ *
+ * Role: the CPU-side twin of the TPU data plane (ops/treehash.py,
+ * ops/gf256.py). The TPU path batches whole stripes through XLA; this
+ * library serves the host-resident cases — single-block hashing on the
+ * PUT path when no accelerator is attached, shard checksum verification,
+ * and RS fallback math — at native speed instead of pure Python.
+ *
+ * BLAKE3 is implemented from the public spec (portable, no SIMD
+ * intrinsics; gcc auto-vectorizes the compression rounds well enough
+ * for a host fallback). Only the default 32-byte hash mode is needed.
+ *
+ * The reference stores hash blocks with sequential blake2
+ * (src/util/data.rs:124-132); this framework's content hash is BLAKE3
+ * so device and host agree on one tree-structured function.
+ */
+
+#include <stdint.h>
+#include <stddef.h>
+#include <string.h>
+
+/* ================= BLAKE3 ================= */
+
+static const uint32_t IV[8] = {
+    0x6A09E667u, 0xBB67AE85u, 0x3C6EF372u, 0xA54FF53Au,
+    0x510E527Fu, 0x9B05688Cu, 0x1F83D9ABu, 0x5BE0CD19u,
+};
+
+static const uint8_t MSG_PERM[16] = {2, 6, 3, 10, 7, 0, 4, 13,
+                                     1, 11, 12, 5, 9, 14, 15, 8};
+
+enum {
+    CHUNK_START = 1 << 0,
+    CHUNK_END = 1 << 1,
+    PARENT = 1 << 2,
+    ROOT = 1 << 3,
+};
+
+#define CHUNK_LEN 1024
+#define BLOCK_LEN 64
+
+static inline uint32_t rotr32(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+}
+
+static inline void gmix(uint32_t *v, int a, int b, int c, int d,
+                        uint32_t mx, uint32_t my) {
+    v[a] = v[a] + v[b] + mx;
+    v[d] = rotr32(v[d] ^ v[a], 16);
+    v[c] = v[c] + v[d];
+    v[b] = rotr32(v[b] ^ v[c], 12);
+    v[a] = v[a] + v[b] + my;
+    v[d] = rotr32(v[d] ^ v[a], 8);
+    v[c] = v[c] + v[d];
+    v[b] = rotr32(v[b] ^ v[c], 7);
+}
+
+static void compress(const uint32_t cv[8], const uint32_t block[16],
+                     uint64_t counter, uint32_t block_len, uint32_t flags,
+                     uint32_t out[8]) {
+    uint32_t v[16];
+    uint32_t m[16], t[16];
+    memcpy(v, cv, 32);
+    v[8] = IV[0];
+    v[9] = IV[1];
+    v[10] = IV[2];
+    v[11] = IV[3];
+    v[12] = (uint32_t)counter;
+    v[13] = (uint32_t)(counter >> 32);
+    v[14] = block_len;
+    v[15] = flags;
+    memcpy(m, block, 64);
+    for (int r = 0;; r++) {
+        gmix(v, 0, 4, 8, 12, m[0], m[1]);
+        gmix(v, 1, 5, 9, 13, m[2], m[3]);
+        gmix(v, 2, 6, 10, 14, m[4], m[5]);
+        gmix(v, 3, 7, 11, 15, m[6], m[7]);
+        gmix(v, 0, 5, 10, 15, m[8], m[9]);
+        gmix(v, 1, 6, 11, 12, m[10], m[11]);
+        gmix(v, 2, 7, 8, 13, m[12], m[13]);
+        gmix(v, 3, 4, 9, 14, m[14], m[15]);
+        if (r == 6)
+            break;
+        for (int i = 0; i < 16; i++)
+            t[i] = m[MSG_PERM[i]];
+        memcpy(m, t, 64);
+    }
+    for (int i = 0; i < 8; i++)
+        out[i] = v[i] ^ v[i + 8];
+}
+
+static void load_words(const uint8_t *p, size_t len, uint32_t out[16]) {
+    uint8_t buf[BLOCK_LEN];
+    if (len < BLOCK_LEN) {
+        memset(buf, 0, BLOCK_LEN);
+        memcpy(buf, p, len);
+        p = buf;
+    }
+    for (int i = 0; i < 16; i++)
+        out[i] = (uint32_t)p[4 * i] | ((uint32_t)p[4 * i + 1] << 8) |
+                 ((uint32_t)p[4 * i + 2] << 16) | ((uint32_t)p[4 * i + 3] << 24);
+}
+
+static void chunk_cv(const uint8_t *chunk, size_t len, uint64_t counter,
+                     int root, uint32_t cv[8]) {
+    size_t nblocks = len == 0 ? 1 : (len + BLOCK_LEN - 1) / BLOCK_LEN;
+    memcpy(cv, IV, 32);
+    for (size_t b = 0; b < nblocks; b++) {
+        size_t blen = (b == nblocks - 1) ? len - BLOCK_LEN * b : BLOCK_LEN;
+        uint32_t m[16];
+        load_words(chunk + BLOCK_LEN * b, blen, m);
+        uint32_t flags = 0;
+        if (b == 0)
+            flags |= CHUNK_START;
+        if (b == nblocks - 1) {
+            flags |= CHUNK_END;
+            if (root)
+                flags |= ROOT;
+        }
+        compress(cv, m, counter, (uint32_t)blen, flags, cv);
+    }
+}
+
+static void parent_cv(const uint32_t l[8], const uint32_t r[8], int root,
+                      uint32_t out[8]) {
+    uint32_t m[16];
+    memcpy(m, l, 32);
+    memcpy(m + 8, r, 32);
+    compress(IV, m, 0, BLOCK_LEN, PARENT | (root ? ROOT : 0), out);
+}
+
+/* Spec tree: left subtree = largest power of two of chunks strictly
+ * less than the total. Recursion depth <= 54 for 64-bit lengths. */
+static void subtree_cv(const uint8_t *data, uint64_t len, uint64_t counter0,
+                       int root, uint32_t cv[8]) {
+    uint64_t nchunks = len == 0 ? 1 : (len + CHUNK_LEN - 1) / CHUNK_LEN;
+    if (nchunks == 1) {
+        chunk_cv(data, (size_t)len, counter0, root, cv);
+        return;
+    }
+    uint64_t left = 1;
+    while (left * 2 < nchunks)
+        left *= 2;
+    uint32_t l[8], r[8];
+    subtree_cv(data, left * CHUNK_LEN, counter0, 0, l);
+    subtree_cv(data + left * CHUNK_LEN, len - left * CHUNK_LEN,
+               counter0 + left, 0, r);
+    parent_cv(l, r, root, cv);
+}
+
+void b3_hash(const uint8_t *data, uint64_t len, uint8_t out[32]) {
+    uint32_t cv[8];
+    subtree_cv(data, len, 0, 1, cv);
+    for (int i = 0; i < 8; i++) {
+        out[4 * i] = (uint8_t)cv[i];
+        out[4 * i + 1] = (uint8_t)(cv[i] >> 8);
+        out[4 * i + 2] = (uint8_t)(cv[i] >> 16);
+        out[4 * i + 3] = (uint8_t)(cv[i] >> 24);
+    }
+}
+
+/* n messages at data + offs[i], length lens[i]; digests to out + 32*i. */
+void b3_hash_many(const uint8_t *data, int64_t n, const int64_t *offs,
+                  const int64_t *lens, uint8_t *out) {
+    for (int64_t i = 0; i < n; i++)
+        b3_hash(data + offs[i], (uint64_t)lens[i], out + 32 * i);
+}
+
+/* ================= GF(2^8), poly 0x11D ================= */
+
+static uint8_t GFMUL[256][256];
+static int gf_ready = 0;
+
+static void gf_init(void) {
+    uint8_t exp[512];
+    int log[256];
+    int x = 1;
+    for (int i = 0; i < 255; i++) {
+        exp[i] = (uint8_t)x;
+        log[x] = i;
+        x <<= 1;
+        if (x & 0x100)
+            x ^= 0x11D;
+    }
+    for (int i = 255; i < 512; i++)
+        exp[i] = exp[i - 255];
+    for (int a = 0; a < 256; a++) {
+        GFMUL[0][a] = 0;
+        GFMUL[a][0] = 0;
+    }
+    for (int a = 1; a < 256; a++)
+        for (int b = 1; b < 256; b++)
+            GFMUL[a][b] = exp[log[a] + log[b]];
+    gf_ready = 1;
+}
+
+/* ================= reflected CRCs (slice-by-8) =================
+ * crc32c (Castagnoli, poly 0x82F63B78 reflected) and CRC-64/NVME
+ * (poly 0x9A6C9329AC4BC9B5 reflected) for the S3 x-amz-checksum-*
+ * framework (ref: src/api/common/signature/checksum.rs). */
+
+static uint32_t C32C_T[8][256];
+static uint64_t C64_T[8][256];
+static int crc_ready = 0;
+
+static void crc_init(void) {
+    for (int i = 0; i < 256; i++) {
+        uint32_t c = (uint32_t)i;
+        uint64_t d = (uint64_t)i;
+        for (int k = 0; k < 8; k++) {
+            c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
+            d = (d & 1) ? (d >> 1) ^ 0x9A6C9329AC4BC9B5ull : d >> 1;
+        }
+        C32C_T[0][i] = c;
+        C64_T[0][i] = d;
+    }
+    for (int i = 0; i < 256; i++) {
+        uint32_t c = C32C_T[0][i];
+        uint64_t d = C64_T[0][i];
+        for (int s = 1; s < 8; s++) {
+            c = C32C_T[0][c & 0xFF] ^ (c >> 8);
+            d = C64_T[0][d & 0xFF] ^ (d >> 8);
+            C32C_T[s][i] = c;
+            C64_T[s][i] = d;
+        }
+    }
+    crc_ready = 1;
+}
+
+uint32_t crc32c_update(uint32_t crc, const uint8_t *p, uint64_t len) {
+    if (!crc_ready)
+        crc_init();
+    crc = ~crc;
+    while (len >= 8) {
+        uint64_t w;
+        memcpy(&w, p, 8);
+        w ^= crc; /* little-endian host assumed (x86/arm) */
+        crc = C32C_T[7][w & 0xFF] ^ C32C_T[6][(w >> 8) & 0xFF] ^
+              C32C_T[5][(w >> 16) & 0xFF] ^ C32C_T[4][(w >> 24) & 0xFF] ^
+              C32C_T[3][(w >> 32) & 0xFF] ^ C32C_T[2][(w >> 40) & 0xFF] ^
+              C32C_T[1][(w >> 48) & 0xFF] ^ C32C_T[0][(w >> 56) & 0xFF];
+        p += 8;
+        len -= 8;
+    }
+    while (len--)
+        crc = C32C_T[0][(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+    return ~crc;
+}
+
+uint64_t crc64nvme_update(uint64_t crc, const uint8_t *p, uint64_t len) {
+    if (!crc_ready)
+        crc_init();
+    crc = ~crc;
+    while (len >= 8) {
+        uint64_t w;
+        memcpy(&w, p, 8);
+        w ^= crc;
+        crc = C64_T[7][w & 0xFF] ^ C64_T[6][(w >> 8) & 0xFF] ^
+              C64_T[5][(w >> 16) & 0xFF] ^ C64_T[4][(w >> 24) & 0xFF] ^
+              C64_T[3][(w >> 32) & 0xFF] ^ C64_T[2][(w >> 40) & 0xFF] ^
+              C64_T[1][(w >> 48) & 0xFF] ^ C64_T[0][(w >> 56) & 0xFF];
+        p += 8;
+        len -= 8;
+    }
+    while (len--)
+        crc = C64_T[0][(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+    return ~crc;
+}
+
+/* out (r, n) = mat (r, s) @ x (s, n) over GF(2^8); rows contiguous. */
+void gf256_matmul(const uint8_t *mat, int64_t r, int64_t s,
+                  const uint8_t *x, int64_t n, uint8_t *out) {
+    if (!gf_ready)
+        gf_init();
+    for (int64_t i = 0; i < r; i++) {
+        uint8_t *o = out + i * n;
+        memset(o, 0, (size_t)n);
+        for (int64_t j = 0; j < s; j++) {
+            uint8_t c = mat[i * s + j];
+            if (c == 0)
+                continue;
+            const uint8_t *tab = GFMUL[c];
+            const uint8_t *xj = x + j * n;
+            if (c == 1) {
+                for (int64_t t = 0; t < n; t++)
+                    o[t] ^= xj[t];
+            } else {
+                for (int64_t t = 0; t < n; t++)
+                    o[t] ^= tab[xj[t]];
+            }
+        }
+    }
+}

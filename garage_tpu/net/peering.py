@@ -106,6 +106,13 @@ class PeeringManager:
     def get_peer_list(self) -> list[PeerInfo]:
         out = []
         for p in self.peers.values():
+            if p.addr is None and p.id != self.netapp.id:
+                # inbound connection that never announced a public addr:
+                # a transient RPC client (operator CLI), not a cluster
+                # member — keep it out of membership, gossip and metrics
+                # (ref: only Hello-announcing nodes enter the peer list,
+                # src/net/netapp.rs:440-470)
+                continue
             avg = sum(p.pings) / len(p.pings) if p.pings else None
             mx = max(p.pings) if p.pings else None
             out.append(PeerInfo(p.id, p.addr, p.state, p.last_seen, avg, mx))
@@ -226,7 +233,13 @@ class PeeringManager:
 
     def _on_disconnected(self, peer_id: bytes) -> None:
         p = self.peers.get(peer_id)
-        if p is not None and p.state == PeerConnState.CONNECTED:
+        if p is None:
+            return
+        if p.addr is None:
+            # transient client gone: forget it, nothing to reconnect to
+            del self.peers[peer_id]
+            return
+        if p.state == PeerConnState.CONNECTED:
             p.state = PeerConnState.WAITING
             p.next_retry = time.monotonic() + self.retry_interval * random.uniform(0.5, 1.0)
 

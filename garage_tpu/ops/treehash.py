@@ -308,7 +308,10 @@ def hash_batch_jax(msgs: np.ndarray, lengths: np.ndarray) -> np.ndarray:
     if any(n_chunks_for(int(n)) != c for n in lengths):
         raise ValueError(f"all lengths must span exactly {c} chunks")
     cvs = _hash_fn(c)(msgs, lengths)
-    return np.asarray(cvs).astype("<u4").view(np.uint8).reshape(b, 32)
+    # ascontiguousarray: device transfers can return a transposed layout
+    # whose last axis is not contiguous, which .view(uint8) rejects
+    out = np.ascontiguousarray(np.asarray(cvs).astype("<u4"))
+    return out.view(np.uint8).reshape(b, 32)
 
 
 def blake3_many(blobs: list[bytes]) -> list[bytes]:

@@ -45,6 +45,9 @@ class Config:
     consistency_mode: str = "consistent"  # consistent|degraded|dangerous
     # erasure coding mode (north star; not in reference): e.g. "4,2" => k=4,m=2
     erasure_coding: Optional[str] = None
+    # block content hash: "blake3" (TPU-batchable tree hash, default) or
+    # "blake2" (the reference's sequential hash, for migrated stores)
+    block_hash_algo: str = "blake3"
 
     rpc_secret: Optional[str] = None
     rpc_bind_addr: str = "127.0.0.1:3901"

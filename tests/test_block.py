@@ -258,9 +258,15 @@ def test_erasure_put_get_and_degraded_read(tmp_path):
             data = os.urandom(300_000)
             h = blake2sum(data)
             await managers[0].rpc_put_block(h, data)
-            # every node holds exactly one shard
-            parts = [m.local_parts(h) for m in managers]
-            held = sorted(i for ps in parts for i in ps)
+            # every node holds exactly one shard; the put acks at write
+            # quorum (5/6) and the last shard lands in background
+            held: list[int] = []
+            for _ in range(100):
+                parts = [m.local_parts(h) for m in managers]
+                held = sorted(i for ps in parts for i in ps)
+                if held == [0, 1, 2, 3, 4, 5]:
+                    break
+                await asyncio.sleep(0.02)
             assert held == [0, 1, 2, 3, 4, 5]
             got = await managers[3].rpc_get_block(h)
             assert got == data
