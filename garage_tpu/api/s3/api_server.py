@@ -18,8 +18,10 @@ from . import bucket as bucket_handlers
 from . import delete as delete_handlers
 from . import get as get_handlers
 from . import list as list_handlers
+from . import lifecycle as lifecycle_handlers
 from . import multipart as multipart_handlers
 from . import put as put_handlers
+from . import website as website_handlers
 from .xml import S3Error, access_denied, no_such_bucket
 
 log = logging.getLogger("garage_tpu.api.s3")
@@ -115,21 +117,41 @@ class S3ApiServer:
             raise no_such_bucket(bucket_name)
         bucket = await self.helper.get_existing_bucket(bucket_id)
 
-        # authorization (ref: api_server.rs:96-171)
-        if api_key is not None:
-            allowed = (api_key.allow_read(bucket_id)
-                       if req.method in ("GET", "HEAD")
-                       else api_key.allow_write(bucket_id))
-            if req.method == "DELETE" and key is None:
-                allowed = api_key.allow_owner(bucket_id)
-        else:
-            allowed = False  # no anonymous access (website server differs)
-        if not allowed:
-            raise access_denied()
+        # CORS preflight is unauthenticated by definition
+        # (ref: api_server.rs handle_options_api)
+        if req.method == "OPTIONS":
+            return website_handlers.handle_options_for_bucket(
+                req, bucket.params)
 
-        ctx = ReqCtx(self.garage, bucket_id, bucket_name, bucket, key,
-                     api_key, verified)
-        return await self._route(req, ctx)
+        try:
+            # authorization (ref: api_server.rs:96-171)
+            if api_key is not None:
+                allowed = (api_key.allow_read(bucket_id)
+                           if req.method in ("GET", "HEAD")
+                           else api_key.allow_write(bucket_id))
+                if req.method == "DELETE" and key is None:
+                    allowed = api_key.allow_owner(bucket_id)
+                # bucket config CRUD is owner-only (ref: api_server.rs
+                # Endpoint::authorization_type Owner for website/cors/
+                # lifecycle endpoints)
+                if key is None and any(x in req.query for x in
+                                       ("website", "cors", "lifecycle")):
+                    allowed = api_key.allow_owner(bucket_id)
+            else:
+                allowed = False  # no anonymous access (web server differs)
+            if not allowed:
+                raise access_denied()
+
+            ctx = ReqCtx(self.garage, bucket_id, bucket_name, bucket, key,
+                         api_key, verified)
+            resp = await self._route(req, ctx)
+        except S3Error as e:
+            # errors carry CORS headers too, or browsers turn a plain
+            # 404 into an opaque network error (ref: cors.rs
+            # add_cors_headers on the error path)
+            resp = e.response()
+        return website_handlers.apply_cors_to_response(req, bucket.params,
+                                                       resp)
 
     # ---- router (ref: router.rs:20-1109) -------------------------------
 
@@ -146,12 +168,39 @@ class S3ApiServer:
                         self.region)
                 if "versioning" in q:
                     return bucket_handlers.handle_get_bucket_versioning()
+                if "website" in q:
+                    return await website_handlers.handle_get_bucket_website(
+                        ctx)
+                if "cors" in q:
+                    return await website_handlers.handle_get_bucket_cors(ctx)
+                if "lifecycle" in q:
+                    return await lifecycle_handlers.handle_get_bucket_lifecycle(
+                        ctx)
                 if m == "HEAD":
                     return Response(200)
                 if q.get("list-type") == "2":
                     return await list_handlers.handle_list_objects_v2(ctx, req)
                 return await list_handlers.handle_list_objects_v1(ctx, req)
+            if m == "PUT":
+                if "website" in q:
+                    return await website_handlers.handle_put_bucket_website(
+                        ctx, req)
+                if "cors" in q:
+                    return await website_handlers.handle_put_bucket_cors(
+                        ctx, req)
+                if "lifecycle" in q:
+                    return await lifecycle_handlers.handle_put_bucket_lifecycle(
+                        ctx, req)
             if m == "DELETE":
+                if "website" in q:
+                    return await website_handlers.handle_delete_bucket_website(
+                        ctx)
+                if "cors" in q:
+                    return await website_handlers.handle_delete_bucket_cors(
+                        ctx)
+                if "lifecycle" in q:
+                    return await \
+                        lifecycle_handlers.handle_delete_bucket_lifecycle(ctx)
                 return await bucket_handlers.handle_delete_bucket(
                     self.helper, ctx)
             if m == "POST" and "delete" in q:

@@ -136,6 +136,18 @@ class GarageHelper:
         async with self.g.bucket_lock:
             await self._set_perm_unlocked(bucket_id, key_id, perm)
 
+    async def update_bucket_config(self, bucket_id: bytes, field: str,
+                                   value) -> None:
+        """Read-modify-write one Lww config register (website_config /
+        cors_config / lifecycle_config / quotas) under the bucket lock
+        (ref: api/s3/website.rs + cors.rs update paths through
+        helper/locked.rs)."""
+        async with self.g.bucket_lock:
+            bucket = await self.get_existing_bucket(bucket_id)
+            params = bucket.params
+            setattr(params, field, getattr(params, field).update(value))
+            await self.g.bucket_table.insert(bucket.with_params(params))
+
     async def _set_perm_unlocked(self, bucket_id: bytes, key_id: str,
                                  perm: BucketKeyPerm) -> None:
         bucket = await self.get_existing_bucket(bucket_id)

@@ -130,7 +130,9 @@ def config_from_dict(raw: dict) -> Config:
             prefix = {"s3_api": "s3_", "k2v_api": "k2v_", "admin": "admin_", "web": "web_"}[key]
             for k2, v2 in val.items():
                 attr = k2 if k2.startswith(prefix) else None
-                for cand in (k2, prefix + k2, {
+                # prefixed name first: [web] root_domain must map to
+                # web_root_domain, not the top-level (S3) root_domain
+                for cand in (prefix + k2, k2, {
                     "api_bind_addr": prefix + "api_bind_addr",
                 }.get(k2, "")):
                     if cand in simple_fields:

@@ -1,0 +1,8 @@
+"""Static website server: serves buckets over vhost-style domains.
+
+Ref parity: src/web/web_server.rs. See server.WebServer.
+"""
+
+from .server import WebServer, path_to_keys
+
+__all__ = ["WebServer", "path_to_keys"]

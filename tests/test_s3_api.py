@@ -57,6 +57,10 @@ root_domain = ".s3.garage.test"
 [admin]
 api_bind_addr = "127.0.0.1:{self.admin_port}"
 admin_token = "test-admin-token"
+
+[web]
+bind_addr = "127.0.0.1:{self.web_port}"
+root_domain = ".web.garage.test"
 """)
         self.proc: subprocess.Popen | None = None
         self.key_id = ""
@@ -629,3 +633,145 @@ def test_presigned_bad_signature(client):
 def test_anonymous_rejected(client):
     status, _, _ = client.raw("GET", "/conformance/inline")
     assert status == 403
+
+
+# ---- website / CORS -----------------------------------------------------
+
+WEBSITE_XML = b"""<?xml version="1.0" encoding="UTF-8"?>
+<WebsiteConfiguration xmlns="http://s3.amazonaws.com/doc/2006-03-01/">
+  <IndexDocument><Suffix>index.html</Suffix></IndexDocument>
+  <ErrorDocument><Key>error.html</Key></ErrorDocument>
+</WebsiteConfiguration>"""
+
+CORS_XML = b"""<?xml version="1.0" encoding="UTF-8"?>
+<CORSConfiguration xmlns="http://s3.amazonaws.com/doc/2006-03-01/">
+  <CORSRule>
+    <AllowedOrigin>https://example.com</AllowedOrigin>
+    <AllowedMethod>GET</AllowedMethod>
+    <AllowedHeader>x-custom</AllowedHeader>
+    <ExposeHeader>etag</ExposeHeader>
+    <MaxAgeSeconds>3600</MaxAgeSeconds>
+  </CORSRule>
+</CORSConfiguration>"""
+
+
+def _web_get(server, host, path, method="GET", headers=None):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", server.web_port,
+                                      timeout=30)
+    try:
+        h = {"host": host}
+        h.update(headers or {})
+        conn.request(method, path, headers=h)
+        r = conn.getresponse()
+        return r.status, {k.lower(): v for k, v in r.getheaders()}, r.read()
+    finally:
+        conn.close()
+
+
+@pytest.fixture(scope="module")
+def website_bucket(server, client):
+    status, _, body = client.request("PUT", "/wsite")
+    assert status == 200, body
+    status, _, body = client.request(
+        "PUT", "/wsite", query=[("website", "")], body=WEBSITE_XML)
+    assert status == 200, body
+    for key, content in [("index.html", b"<h1>home</h1>"),
+                         ("error.html", b"<h1>custom error</h1>"),
+                         ("docs/index.html", b"<h1>docs</h1>"),
+                         ("page.html", b"<h1>page</h1>")]:
+        status, _, body = client.request("PUT", f"/wsite/{key}",
+                                         body=content)
+        assert status == 200, body
+    return "wsite.web.garage.test"
+
+
+def test_get_bucket_website_roundtrip(client, website_bucket):
+    status, _, body = client.request("GET", "/wsite",
+                                     query=[("website", "")])
+    assert status == 200
+    assert xml_find(body, "Suffix") == ["index.html"]
+    assert xml_find(body, "Key") == ["error.html"]
+
+
+def test_website_serves_index_and_keys(server, website_bucket):
+    status, _, body = _web_get(server, website_bucket, "/")
+    assert status == 200 and body == b"<h1>home</h1>"
+    status, _, body = _web_get(server, website_bucket, "/page.html")
+    assert status == 200 and body == b"<h1>page</h1>"
+    status, _, body = _web_get(server, website_bucket, "/docs/")
+    assert status == 200 and body == b"<h1>docs</h1>"
+
+
+def test_website_implicit_redirect(server, website_bucket):
+    status, headers, _ = _web_get(server, website_bucket, "/docs")
+    assert status == 302
+    assert headers["location"] == "/docs/"
+
+
+def test_website_error_document(server, website_bucket):
+    status, _, body = _web_get(server, website_bucket, "/missing.html")
+    assert status == 404
+    assert body == b"<h1>custom error</h1>"
+
+
+def test_website_head(server, website_bucket):
+    status, headers, body = _web_get(server, website_bucket, "/page.html",
+                                     method="HEAD")
+    assert status == 200 and body == b""
+    assert headers["content-length"] == str(len(b"<h1>page</h1>"))
+
+
+def test_website_not_configured(server, client):
+    status, _, body = client.request("PUT", "/nosite")
+    assert status == 200, body
+    status, _, _ = _web_get(server, "nosite.web.garage.test", "/")
+    assert status == 404
+
+
+def test_website_delete_config(server, client, website_bucket):
+    status, _, _ = client.request("PUT", "/wsite2")
+    assert status == 200
+    status, _, _ = client.request("PUT", "/wsite2",
+                                  query=[("website", "")],
+                                  body=WEBSITE_XML)
+    assert status == 200
+    status, _, _ = client.request("DELETE", "/wsite2",
+                                  query=[("website", "")])
+    assert status == 204
+    status, _, body = client.request("GET", "/wsite2",
+                                     query=[("website", "")])
+    assert status == 404
+    assert xml_error_code(body) == "NoSuchWebsiteConfiguration"
+
+
+def test_cors_crud_and_preflight(server, client, website_bucket):
+    status, _, body = client.request("PUT", "/wsite",
+                                     query=[("cors", "")], body=CORS_XML)
+    assert status == 200, body
+    status, _, body = client.request("GET", "/wsite", query=[("cors", "")])
+    assert status == 200
+    assert xml_find(body, "AllowedOrigin") == ["https://example.com"]
+    # preflight on the website endpoint
+    status, headers, _ = _web_get(
+        server, website_bucket, "/page.html", method="OPTIONS",
+        headers={"origin": "https://example.com",
+                 "access-control-request-method": "GET"})
+    assert status == 200
+    assert headers["access-control-allow-origin"] == "https://example.com"
+    # denied origin
+    status, _, _ = _web_get(
+        server, website_bucket, "/page.html", method="OPTIONS",
+        headers={"origin": "https://evil.example",
+                 "access-control-request-method": "GET"})
+    assert status == 403
+    # actual response carries CORS headers
+    status, headers, _ = _web_get(server, website_bucket, "/page.html",
+                                  headers={"origin": "https://example.com"})
+    assert status == 200
+    assert headers.get("access-control-allow-origin") == "https://example.com"
+    status, _, _ = client.request("DELETE", "/wsite", query=[("cors", "")])
+    assert status == 204
+    status, _, body = client.request("GET", "/wsite", query=[("cors", "")])
+    assert status == 404
