@@ -231,6 +231,163 @@ class AdminRpcHandler:
         await self.garage.key_table.insert(k)
         return {"key_id": k.key_id}
 
+    # ---- repair / block ops / snapshot (ref: garage/admin/mod.rs
+    # launch repairs + block ops, garage/repair/online.rs) ---------------
+
+    async def op_repair(self, p):
+        from ..model.repair import launch_repair
+
+        what = p.get("what", "")
+        if what == "scrub":
+            return await self._scrub_cmd(p.get("cmd", "start"))
+        msg = launch_repair(self.garage, what)
+        return {"ok": True, "msg": msg}
+
+    async def _scrub_cmd(self, cmd: str):
+        sw = getattr(self.garage.block_manager, "scrub_worker", None)
+        if sw is None:
+            raise BadRequest("no scrub worker on this node")
+        try:
+            sw.command(cmd)
+        except ValueError as e:
+            raise BadRequest(str(e))
+        return {"ok": True, "msg": f"scrub {cmd}"}
+
+    async def op_block_list_errors(self, p):
+        res = self.garage.block_manager.resync
+        return {"errors": [
+            {"hash": h.hex(), "failures": count, "next_try_ms": next_ms}
+            for h, count, next_ms in res.iter_errors()
+        ]}
+
+    async def op_block_info(self, p):
+        try:
+            h = bytes.fromhex(p["hash"])
+        except ValueError:
+            raise BadRequest(f"not a hex block hash: {p['hash']!r}")
+        m = self.garage.block_manager
+        state, at = m.rc.get(h)
+        refs = []
+        store = self.garage.block_ref_table.data
+        for raw in store.read_range(h, None, None, 100):
+            e = store.decode_stored(raw)
+            refs.append({"version": e.version.hex(),
+                         "deleted": e.deleted.value})
+        return {
+            "hash": h.hex(),
+            "rc": state,
+            "deletable_at": at,
+            "stored_locally": (m.local_parts(h) if m.erasure
+                               else m.has_local(h)),
+            "refs": refs,
+        }
+
+    async def op_block_retry_now(self, p):
+        res = self.garage.block_manager.resync
+        try:
+            hashes = [bytes.fromhex(x) for x in p.get("hashes", [])]
+        except ValueError as e:
+            raise BadRequest(f"bad block hash: {e}")
+        n = res.retry_now(hashes, all_errors=bool(p.get("all")))
+        return {"ok": True, "count": n}
+
+    async def op_block_purge(self, p):
+        """Tombstone every version referencing the block (cascades to
+        refs + object entries; ref: admin/block.rs handle_block_purge)."""
+        from ..model.s3.mpu_table import MultipartUpload
+        from ..model.s3.object_table import (Object, ObjectVersion,
+                                             ObjectVersionState)
+        from ..model.s3.version_table import BACKLINK_OBJECT, Version
+
+        try:
+            hashes = [bytes.fromhex(x) for x in p.get("hashes", [])]
+        except ValueError as e:
+            raise BadRequest(f"bad block hash: {e}")
+        purged_versions = 0
+        purged_objects = 0
+        purged_mpus = 0
+
+        async def abort_object_version(bucket_id, key, uuid):
+            kb = key.encode() if isinstance(key, str) else key
+            obj = await self.garage.object_table.get(bucket_id, kb)
+            if obj is None:
+                return 0
+            aborted = [ObjectVersion(ov.uuid, ov.timestamp,
+                                     ObjectVersionState.aborted())
+                       for ov in obj.versions if ov.uuid == uuid]
+            if not aborted:
+                return 0
+            await self.garage.object_table.insert(Object(
+                bucket_id, key if isinstance(key, str) else key.decode(),
+                aborted))
+            return 1
+
+        for h in hashes:
+            data = self.garage.block_ref_table.data
+            refs = [data.decode_stored(raw)
+                    for raw in data.read_range(h, None, None, 10000)]
+            for ref in refs:
+                if ref.deleted.value:
+                    continue
+                v = await self.garage.version_table.get(ref.version, b"")
+                if v is None:
+                    continue
+                if v.backlink[0] == BACKLINK_OBJECT:
+                    _, bucket_id, key = v.backlink
+                    purged_objects += await abort_object_version(
+                        bucket_id, key, v.uuid)
+                else:
+                    # MPU-backed part: abort the whole upload — its
+                    # object uploading-version AND the mpu row — or the
+                    # client could still "complete" an upload whose data
+                    # is gone (ref: admin/block.rs handle_block_purge)
+                    upload_id = v.backlink[1]
+                    mpu = await self.garage.mpu_table.get(upload_id, b"")
+                    if mpu is not None and not mpu.deleted.value:
+                        purged_objects += await abort_object_version(
+                            mpu.bucket_id, mpu.key, upload_id)
+                        await self.garage.mpu_table.insert(
+                            MultipartUpload.new(upload_id, mpu.timestamp,
+                                                mpu.bucket_id, mpu.key,
+                                                deleted=True))
+                        purged_mpus += 1
+                await self.garage.version_table.insert(
+                    Version.new(v.uuid, v.backlink, deleted=True))
+                purged_versions += 1
+        return {"ok": True, "versions": purged_versions,
+                "objects": purged_objects, "mpus": purged_mpus}
+
+    async def op_meta_snapshot(self, p):
+        import asyncio
+
+        from ..model.snapshot import snapshot_metadata
+
+        path = await asyncio.to_thread(snapshot_metadata, self.garage)
+        return {"ok": True, "path": path}
+
+    async def op_worker_get(self, p):
+        bv = self.garage.bg_vars
+        if p.get("name"):
+            try:
+                return {"vars": {p["name"]: bv.get(p["name"])}}
+            except KeyError:
+                raise BadRequest(
+                    f"unknown variable {p['name']!r}; known: "
+                    f"{', '.join(sorted(bv.all()))}")
+        return {"vars": bv.all()}
+
+    async def op_worker_set(self, p):
+        bv = self.garage.bg_vars
+        try:
+            bv.set(p["name"], p["value"])
+            return {"ok": True, "value": bv.get(p["name"])}
+        except KeyError:
+            raise BadRequest(
+                f"unknown variable {p['name']!r}; known: "
+                f"{', '.join(sorted(bv.all()))}")
+        except ValueError as e:
+            raise BadRequest(str(e))
+
     # ---- workers / stats ----------------------------------------------
 
     async def op_worker_list(self, p):

@@ -152,8 +152,41 @@ class BlockManager:
         from .repair import ScrubWorker
 
         self.resync.spawn_workers(runner)
+        self.scrub_worker = None
         if scrub:
-            runner.spawn_worker(ScrubWorker(self))
+            self.scrub_worker = ScrubWorker(self)
+            runner.spawn_worker(self.scrub_worker)
+
+    def register_bg_vars(self, vars) -> None:
+        """Runtime-tunables for `worker get/set` (ref: BgVars
+        registrations in block/manager.rs:213-233)."""
+        res = self.resync
+
+        def set_rt(v):
+            res.tranquility = float(v)
+
+        vars.register_rw("resync-tranquility",
+                         lambda: res.tranquility, set_rt)
+        sw = getattr(self, "scrub_worker", None)
+        if sw is not None:
+            def set_st(v):
+                sw.state.tranquility = float(v)
+                sw.persister.save(sw.state)
+
+            def set_paused(v):
+                sw.state.paused = v.lower() in ("1", "true", "yes")
+                sw.persister.save(sw.state)
+
+            vars.register_rw("scrub-tranquility",
+                             lambda: sw.state.tranquility, set_st)
+            vars.register_rw("scrub-paused",
+                             lambda: sw.state.paused, set_paused)
+            vars.register_rw(
+                "scrub-last-completed",
+                lambda: sw.state.last_completed,
+                lambda v: (_ for _ in ()).throw(
+                    ValueError("read-only variable")),
+            )
 
     async def stop(self) -> None:
         await self.feeder.stop()

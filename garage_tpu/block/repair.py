@@ -60,12 +60,42 @@ class ScrubWorker(Worker):
         self.state = self.persister.load() or ScrubState()
         self._jitter = random.random() * 0.4 + 0.8  # ±20%
         self._iter = None  # live sorted walk; rebuilt from cursor on restart
+        self._pending_cmd: str | None = None
 
     def _due(self) -> bool:
         return (time.time() - self.state.last_completed
                 >= self.interval * self._jitter)
 
+    def command(self, cmd: str) -> None:
+        """Operator control (CLI `repair scrub <cmd>`). Commands are
+        applied at the top of the next work() tick so they can never be
+        clobbered by an in-flight batch's cursor save
+        (ref: repair.rs ScrubWorkerCommand channel)."""
+        if cmd not in ("start", "pause", "resume", "cancel"):
+            raise ValueError(f"unknown scrub command {cmd!r}")
+        self._pending_cmd = cmd
+
+    def _apply_pending(self) -> None:
+        cmd, self._pending_cmd = self._pending_cmd, None
+        if cmd is None:
+            return
+        if cmd == "start":
+            self.state.last_completed = 0.0
+            self.state.cursor = b""
+            self.state.paused = False
+            self._iter = None
+        elif cmd == "pause":
+            self.state.paused = True
+        elif cmd == "resume":
+            self.state.paused = False
+        elif cmd == "cancel":
+            self.state.cursor = b""
+            self._iter = None
+            self.state.last_completed = time.time()
+        self.persister.save(self.state)
+
     async def work(self):
+        self._apply_pending()
         if self.state.paused or not self._due():
             return WState.IDLE
         if self._iter is None:

@@ -89,6 +89,24 @@ class BlockResyncManager:
     def _clear_error(self, hash32: bytes) -> None:
         self.errors.remove(hash32)
 
+    def iter_errors(self, limit: int = 1000):
+        """[(hash32, failures, next_try_ms)] — `block list-errors`."""
+        out = []
+        for h, raw in self.errors.iter(limit=limit):
+            count, next_ms = self._parse_err(raw)
+            out.append((h, count, next_ms))
+        return out
+
+    def retry_now(self, hashes=None, all_errors: bool = False) -> int:
+        """Clear backoff + requeue (`block retry-now`)."""
+        if all_errors:
+            hashes = [h for h, _ in self.errors.iter(limit=1 << 20)]
+        hashes = hashes or []
+        for h in hashes:
+            self._clear_error(h)
+            self.push_now(h)
+        return len(hashes)
+
     def spawn_workers(self, runner) -> None:
         for i in range(self.n_workers):
             runner.spawn_worker(ResyncWorker(self, i))

@@ -102,11 +102,37 @@ async def _dispatch(cli: AdminClient, args) -> int:
     if c == "key":
         return await _key(cli, args)
     if c == "worker":
-        r = await cli.call("worker_list")
-        rows = [[w["id"], w["name"], str(w.get("queue") or ""),
-                 str(w.get("errors") or "")] for w in r["workers"]]
-        print(fmt_table(rows, ["id", "name", "queue", "errors"]))
+        s = getattr(args, "subcmd", None) or "list"
+        if s == "list":
+            r = await cli.call("worker_list")
+            rows = [[w["id"], w["name"], str(w.get("queue") or ""),
+                     str(w.get("errors") or "")] for w in r["workers"]]
+            print(fmt_table(rows, ["id", "name", "queue", "errors"]))
+            return 0
+        if s == "get":
+            r = await cli.call("worker_get", name=args.name)
+            for k, v in sorted(r["vars"].items()):
+                print(f"{k} = {v}")
+            return 0
+        if s == "set":
+            r = await cli.call("worker_set", name=args.name,
+                               value=args.value)
+            print(f"{args.name} = {r['value']}")
+            return 0
+        return 1
+    if c == "repair":
+        r = await cli.call("repair", what=args.what,
+                           cmd=getattr(args, "scrub_cmd", None))
+        print(r.get("msg", "ok"))
         return 0
+    if c == "block":
+        return await _block(cli, args)
+    if c == "meta":
+        if args.subcmd == "snapshot":
+            r = await cli.call("meta_snapshot")
+            print(f"snapshot written to {r['path']}")
+            return 0
+        return 1
     if c == "stats":
         r = await cli.call("stats")
         print(json.dumps(r, indent=2, default=str))
@@ -182,6 +208,34 @@ async def _bucket(cli, args) -> int:
         await cli.call(f"bucket_{s}", bucket=args.name, key=args.key,
                        read=args.read, write=args.write, owner=args.owner)
         print("ok")
+        return 0
+    return 1
+
+
+async def _block(cli, args) -> int:
+    s = args.subcmd
+    if s == "list-errors":
+        r = await cli.call("block_list_errors")
+        rows = [[e["hash"][:16], str(e["failures"]),
+                 str(e["next_try_ms"])] for e in r["errors"]]
+        print(fmt_table(rows, ["hash", "failures", "next_try_ms"]))
+        return 0
+    if s == "info":
+        r = await cli.call("block_info", hash=args.hash)
+        print(json.dumps(r, indent=2, default=str))
+        return 0
+    if s == "retry-now":
+        r = await cli.call("block_retry_now", all=args.all,
+                           hashes=args.hashes or [])
+        print(f"{r['count']} block(s) queued for retry")
+        return 0
+    if s == "purge":
+        if not args.yes:
+            print("refusing to purge without --yes", file=sys.stderr)
+            return 1
+        r = await cli.call("block_purge", hashes=args.hashes or [])
+        print(f"purged {r['versions']} version(s), "
+              f"{r['objects']} object(s)")
         return 0
     return 1
 
@@ -271,7 +325,34 @@ def build_parser() -> argparse.ArgumentParser:
         x = pks.add_parser(name)
         x.add_argument("key")
         x.add_argument("--create-bucket", action="store_true")
-    sub.add_parser("worker").add_subparsers(dest="subcmd").add_parser("list")
+    pw = sub.add_parser("worker")
+    pws = pw.add_subparsers(dest="subcmd")
+    pws.add_parser("list")
+    wg = pws.add_parser("get")
+    wg.add_argument("name", nargs="?", default=None)
+    ws = pws.add_parser("set")
+    ws.add_argument("name")
+    ws.add_argument("value")
+    prp = sub.add_parser("repair")
+    prp.add_argument("what", choices=["tables", "versions", "mpu",
+                                      "block-refs", "block-rc", "blocks",
+                                      "scrub"])
+    prp.add_argument("scrub_cmd", nargs="?", default="start",
+                     choices=["start", "pause", "resume", "cancel"])
+    pbl = sub.add_parser("block")
+    pbls = pbl.add_subparsers(dest="subcmd", required=True)
+    pbls.add_parser("list-errors")
+    bi = pbls.add_parser("info")
+    bi.add_argument("hash")
+    br = pbls.add_parser("retry-now")
+    br.add_argument("--all", action="store_true")
+    br.add_argument("hashes", nargs="*")
+    bp = pbls.add_parser("purge")
+    bp.add_argument("--yes", action="store_true")
+    bp.add_argument("hashes", nargs="*")
+    pm = sub.add_parser("meta")
+    pms = pm.add_subparsers(dest="subcmd", required=True)
+    pms.add_parser("snapshot")
     sub.add_parser("stats")
     return p
 

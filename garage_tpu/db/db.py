@@ -130,16 +130,18 @@ class Tree:
         return None
 
     def get_gt(self, key: bytes) -> Optional[Tuple[bytes, bytes]]:
-        for kv in self.iter(start=key + b"\x00"):
+        for kv in self.iter(start=key + b"\x00", limit=1):
             return kv
         return None
 
     def iter(self, start: Optional[bytes] = None, end: Optional[bytes] = None,
-             reverse: bool = False) -> Iterator[Tuple[bytes, bytes]]:
+             reverse: bool = False,
+             limit: Optional[int] = None) -> Iterator[Tuple[bytes, bytes]]:
         """Ordered scan over [start, end). Materialized per-call to stay
-        consistent under concurrent writes (scans here are short/batched)."""
+        consistent under concurrent writes; pass `limit` for cursor-style
+        batch walks so a batch never materializes the whole tail."""
         with self._db._lock:
-            items = self._e.range(self.name, start, end, reverse)
+            items = self._e.range(self.name, start, end, reverse, limit)
         return iter(items)
 
 
@@ -169,8 +171,9 @@ class Transaction:
         return self._e.length(tree.name)
 
     def range(self, tree: Tree, start: Optional[bytes] = None,
-              end: Optional[bytes] = None, reverse: bool = False):
-        return self._e.range(tree.name, start, end, reverse)
+              end: Optional[bytes] = None, reverse: bool = False,
+              limit: Optional[int] = None):
+        return self._e.range(tree.name, start, end, reverse, limit)
 
     def on_commit(self, hook: Callable[[], None]) -> None:
         self._hooks.append(hook)
@@ -189,7 +192,7 @@ class _Engine:
     def delete(self, tree: str, key: bytes) -> None: ...
     def clear(self, tree: str) -> None: ...
     def length(self, tree: str) -> int: ...
-    def range(self, tree, start, end, reverse) -> list: ...
+    def range(self, tree, start, end, reverse, limit=None) -> list: ...
     def begin(self) -> None: ...
     def commit(self) -> None: ...
     def rollback(self) -> None: ...
@@ -249,13 +252,15 @@ class MemEngine(_Engine):
     def length(self, tree):
         return len(self._data[tree])
 
-    def range(self, tree, start, end, reverse):
+    def range(self, tree, start, end, reverse, limit=None):
         ks = self._keys[tree]
         lo = bisect.bisect_left(ks, start) if start is not None else 0
         hi = bisect.bisect_left(ks, end) if end is not None else len(ks)
         sel = ks[lo:hi]
         if reverse:
             sel = list(reversed(sel))
+        if limit is not None:
+            sel = sel[:limit]
         d = self._data[tree]
         return [(k, d[k]) for k in sel]
 
@@ -295,7 +300,17 @@ class MemEngine(_Engine):
                 ks.pop(i)
 
     def snapshot(self, to_dir):
-        raise NotImplementedError("memory engine has no snapshot")
+        # dev/test engine: dump all trees as one msgpack file so the
+        # snapshot workers + CLI behave uniformly across engines
+        import msgpack
+        import os
+
+        os.makedirs(to_dir, exist_ok=True)
+        payload = {t: list(self._data[t].items()) for t in self._data}
+        tmp = os.path.join(to_dir, "memdb.msgpack.tmp")
+        with open(tmp, "wb") as f:
+            f.write(msgpack.packb(payload, use_bin_type=True))
+        os.replace(tmp, os.path.join(to_dir, "memdb.msgpack"))
 
     def close(self):
         pass
@@ -352,7 +367,7 @@ class SqliteEngine(_Engine):
         return self._conn.execute(
             f"SELECT COUNT(*) FROM {self._tbl(tree)}").fetchone()[0]
 
-    def range(self, tree, start, end, reverse):
+    def range(self, tree, start, end, reverse, limit=None):
         q = f"SELECT k, v FROM {self._tbl(tree)}"
         conds, params = [], []
         if start is not None:
@@ -364,6 +379,9 @@ class SqliteEngine(_Engine):
         if conds:
             q += " WHERE " + " AND ".join(conds)
         q += " ORDER BY k" + (" DESC" if reverse else "")
+        if limit is not None:
+            q += " LIMIT ?"
+            params.append(limit)
         return self._conn.execute(q, params).fetchall()
 
     def begin(self):

@@ -210,6 +210,15 @@ class Garage:
         for t in self.all_tables():
             t.spawn_workers(self.runner)
         self.block_manager.spawn_workers(self.runner, scrub=scrub)
+        self.block_manager.register_bg_vars(self.bg_vars)
+        from .s3.lifecycle_worker import LifecycleWorker
+
+        self.runner.spawn_worker(LifecycleWorker(self))
+        if self.config.metadata_auto_snapshot_interval:
+            from .snapshot import AutoSnapshotWorker
+
+            self.runner.spawn_worker(AutoSnapshotWorker(
+                self, self.config.metadata_auto_snapshot_interval))
 
     async def run(self, spawn_workers: bool = True) -> None:
         """Start listening + gossip + workers; returns when stop() is

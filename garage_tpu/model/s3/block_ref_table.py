@@ -86,12 +86,15 @@ def block_ref_recount_fn(block_ref_table):
     of a block in the local store (ref: block_ref_table.rs:88-125)."""
 
     def count(hash32: bytes) -> int:
+        from ...table.data import _prefix_upper_bound
+
         data = block_ref_table.data
         prefix = tree_key(hash32, b"")
         n = 0
-        for k, raw in data.store.iter(start=prefix):
-            if not k.startswith(prefix):
-                break
+        # end-bounded: an unbounded iter materializes the whole tail of
+        # the block_ref tree per call, turning a full rc repair O(N^2)
+        for _k, raw in data.store.iter(start=prefix,
+                                       end=_prefix_upper_bound(prefix)):
             if not data.decode_stored(raw).is_tombstone():
                 n += 1
         return n

@@ -63,6 +63,11 @@ class TableSyncer(Worker):
     async def wait_for_work(self):
         await asyncio.sleep(1.0)
 
+    def add_full_sync(self) -> None:
+        """Force a full anti-entropy round on the next worker tick
+        (ref: table/sync.rs add_full_sync, CLI `repair tables`)."""
+        self._last_sync = 0.0
+
     async def sync_all_partitions(self) -> None:
         me = self.table.system.id
         # pin the version we're syncing against BEFORE the round; a layout

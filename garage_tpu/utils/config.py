@@ -67,6 +67,7 @@ class Config:
     web_root_domain: str = ".web.garage"
 
     metadata_auto_snapshot_interval: Optional[float] = None  # seconds
+    metadata_snapshots_dir: Optional[str] = None  # default {meta}/snapshots
 
     tpu: TpuConfig = field(default_factory=TpuConfig)
 
