@@ -1,0 +1,129 @@
+"""K2V API server: routing, auth, dispatch.
+
+Ref parity: src/api/k2v/api_server.rs + router.rs. URL shape:
+
+  GET    /{bucket}                       ?start&end&limit&prefix  ReadIndex
+  POST   /{bucket}                       body = [items]           InsertBatch
+  POST   /{bucket}?search                body = [queries]         ReadBatch
+  POST   /{bucket}?delete                body = [queries]         DeleteBatch
+  GET    /{bucket}/{partition_key}?sort_key=...                   ReadItem
+  GET    /{bucket}/{partition_key}?sort_key=...&causality_token=
+         ...&timeout=...                                          PollItem
+  PUT    /{bucket}/{partition_key}?sort_key=...                   InsertItem
+  DELETE /{bucket}/{partition_key}?sort_key=...                   DeleteItem
+
+Auth is SigV4 with scope service "k2v". Permissions reuse the bucket
+key grants (read for GET/POLL, write for PUT/DELETE/batches).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Optional
+
+from ...model.helper import GarageHelper
+from ...utils.error import BadRequest, NoSuchBucket, NoSuchKey
+from ..http import HttpError, HttpServer, Request, Response
+from ..s3.api_server import ReqCtx
+from ..s3.xml import S3Error, access_denied, no_such_bucket
+from ..signature import verify_request
+from . import batch as batch_handlers
+from . import index as index_handlers
+from . import item as item_handlers
+
+log = logging.getLogger("garage_tpu.api.k2v")
+
+
+def json_error(code: str, status: int, message: str) -> Response:
+    body = json.dumps({"code": code, "message": message}).encode()
+    return Response(status, [("content-type", "application/json")], body)
+
+
+class K2VApiServer:
+    def __init__(self, garage, region: Optional[str] = None):
+        self.garage = garage
+        self.helper = GarageHelper(garage)
+        self.region = region or garage.config.s3_region
+        self.http = HttpServer(self.handle, name="k2v")
+
+    async def start(self, host: str, port: int) -> None:
+        await self.http.start(host, port)
+
+    async def stop(self) -> None:
+        await self.http.stop()
+
+    async def handle(self, req: Request) -> Response:
+        try:
+            return await self._handle(req)
+        except S3Error as e:
+            return json_error(e.code, e.status, e.message)
+        except HttpError as e:
+            return json_error("InvalidRequest", e.status, e.reason)
+        except NoSuchBucket as e:
+            return json_error("NoSuchBucket", 404, str(e))
+        except NoSuchKey as e:
+            return json_error("NoSuchKey", 404, str(e))
+        except BadRequest as e:
+            return json_error("InvalidRequest", 400, str(e))
+
+    async def _handle(self, req: Request) -> Response:
+        verified = await verify_request(req, self.region,
+                                        self.helper.key_secret,
+                                        service="k2v")
+        if verified is None:
+            raise access_denied("authentication required")
+        api_key = await self.helper.get_existing_key(verified.key_id)
+
+        path = req.path.lstrip("/")
+        bucket_name, _, partition_key = path.partition("/")
+        if not bucket_name:
+            raise S3Error("InvalidRequest", 400, "no bucket in path")
+        bucket_id = await self.helper.resolve_global_bucket_name(bucket_name)
+        if bucket_id is None:
+            raise no_such_bucket(bucket_name)
+        bucket = await self.helper.get_existing_bucket(bucket_id)
+
+        if req.method in ("GET", "HEAD"):
+            allowed = api_key.allow_read(bucket_id)
+        else:
+            allowed = api_key.allow_write(bucket_id)
+        if not allowed:
+            raise access_denied()
+
+        ctx = ReqCtx(self.garage, bucket_id, bucket_name, bucket,
+                     partition_key or None, api_key, verified)
+        return await self._route(req, ctx, partition_key)
+
+    async def _route(self, req: Request, ctx: ReqCtx,
+                     partition_key: str) -> Response:
+        m, q = req.method, req.query
+        if not partition_key:
+            if m == "GET":
+                return await index_handlers.handle_read_index(ctx, req)
+            if m == "POST":
+                if "search" in q:
+                    return await batch_handlers.handle_read_batch(ctx, req)
+                if "delete" in q:
+                    return await batch_handlers.handle_delete_batch(ctx,
+                                                                    req)
+                return await batch_handlers.handle_insert_batch(ctx, req)
+            raise S3Error("NotImplemented", 501,
+                          f"unsupported K2V bucket operation {m}")
+        if "sort_key" not in q:
+            raise S3Error("InvalidRequest", 400, "sort_key is required")
+        sort_key = q["sort_key"]
+        if m in ("GET", "HEAD"):
+            if "causality_token" in q:
+                return await item_handlers.handle_poll_item(
+                    ctx, req, partition_key, sort_key)
+            return await item_handlers.handle_read_item(
+                ctx, req, partition_key, sort_key)
+        if m == "PUT":
+            return await item_handlers.handle_insert_item(
+                ctx, req, partition_key, sort_key)
+        if m == "DELETE":
+            return await item_handlers.handle_delete_item(
+                ctx, req, partition_key, sort_key)
+        raise S3Error("NotImplemented", 501,
+                      f"unsupported K2V item operation {m}")

@@ -111,26 +111,31 @@ class VerifiedRequest:
         self.presigned = presigned
 
 
-async def verify_request(req: Request, region: str,
-                         lookup_secret) -> Optional[VerifiedRequest]:
+async def verify_request(req: Request, region: str, lookup_secret,
+                         service: str = SERVICE
+                         ) -> Optional[VerifiedRequest]:
     """Check the request signature. `lookup_secret(key_id) -> secret|None`
     (async). Returns None for anonymous (unsigned) requests; raises
-    HttpError(403) on bad signatures. ref: signature/payload.rs:35-200."""
+    HttpError(403) on bad signatures. `service` is the SigV4 scope
+    service — "s3", or "k2v" for the K2V API (ref:
+    api/k2v/api_server.rs verify_request). ref: payload.rs:35-200."""
     auth = req.header("authorization")
     if auth is not None:
-        return await _verify_header(req, region, lookup_secret, auth)
+        return await _verify_header(req, region, lookup_secret, auth,
+                                    service)
     if req.query.get("X-Amz-Algorithm") == ALGORITHM:
-        return await _verify_presigned(req, region, lookup_secret)
+        return await _verify_presigned(req, region, lookup_secret, service)
     return None
 
 
-def _parse_credential(cred: str, region: str) -> tuple[str, str]:
+def _parse_credential(cred: str, region: str,
+                      service: str = SERVICE) -> tuple[str, str]:
     parts = cred.split("/")
     if len(parts) != 5 or parts[4] != "aws4_request":
         raise HttpError(403, "malformed credential")
-    key_id, date, creg, service = parts[0], parts[1], parts[2], parts[3]
-    if creg != region or service != SERVICE:
-        raise HttpError(403, f"wrong scope region/service ({creg}/{service})")
+    key_id, date, creg, svc = parts[0], parts[1], parts[2], parts[3]
+    if creg != region or svc != service:
+        raise HttpError(403, f"wrong scope region/service ({creg}/{svc})")
     return key_id, date
 
 
@@ -144,7 +149,8 @@ def _check_date(amz_date: str, scope_date: str, now=None) -> None:
 
 
 async def _verify_header(req: Request, region: str, lookup_secret,
-                         auth: str) -> VerifiedRequest:
+                         auth: str,
+                         service: str = SERVICE) -> VerifiedRequest:
     if not auth.startswith(ALGORITHM):
         raise HttpError(403, "unsupported auth algorithm")
     fields = {}
@@ -157,7 +163,7 @@ async def _verify_header(req: Request, region: str, lookup_secret,
         signature = fields["Signature"]
     except KeyError:
         raise HttpError(403, "malformed authorization header")
-    key_id, scope_date = _parse_credential(cred, region)
+    key_id, scope_date = _parse_credential(cred, region, service)
     amz_date = req.header("x-amz-date") or req.header("date") or ""
     _check_date(amz_date, scope_date)
     secret = await lookup_secret(key_id)
@@ -169,8 +175,8 @@ async def _verify_header(req: Request, region: str, lookup_secret,
     _, raw_pairs = parse_query(req.raw_query)
     creq = canonical_request(req.method, req.raw_path, raw_pairs,
                              req.headers, signed_headers, payload_hash)
-    scope = f"{scope_date}/{region}/{SERVICE}/aws4_request"
-    sk = signing_key(secret, scope_date, region)
+    scope = f"{scope_date}/{region}/{service}/aws4_request"
+    sk = signing_key(secret, scope_date, region, service)
     expect = hmac.new(sk, string_to_sign(amz_date, scope, creq).encode(),
                       hashlib.sha256).hexdigest()
     if not hmac.compare_digest(expect, signature):
@@ -179,8 +185,8 @@ async def _verify_header(req: Request, region: str, lookup_secret,
                            sk, False)
 
 
-async def _verify_presigned(req: Request, region: str,
-                            lookup_secret) -> VerifiedRequest:
+async def _verify_presigned(req: Request, region: str, lookup_secret,
+                            service: str = SERVICE) -> VerifiedRequest:
     """ref: payload.rs check_presigned_signature."""
     q = req.query
     try:
@@ -191,7 +197,7 @@ async def _verify_presigned(req: Request, region: str,
         signature = q["X-Amz-Signature"]
     except (KeyError, ValueError):
         raise HttpError(403, "malformed presigned query")
-    key_id, scope_date = _parse_credential(cred, region)
+    key_id, scope_date = _parse_credential(cred, region, service)
     t = parse_amz_date(amz_date)
     now = datetime.datetime.now(datetime.timezone.utc)
     if now > t + datetime.timedelta(seconds=min(expires, 7 * 86400)):
@@ -205,8 +211,8 @@ async def _verify_presigned(req: Request, region: str,
     creq = canonical_request(req.method, req.raw_path, raw_pairs,
                              req.headers, signed_headers, UNSIGNED_PAYLOAD,
                              skip_query=("X-Amz-Signature",))
-    scope = f"{scope_date}/{region}/{SERVICE}/aws4_request"
-    sk = signing_key(secret, scope_date, region)
+    scope = f"{scope_date}/{region}/{service}/aws4_request"
+    sk = signing_key(secret, scope_date, region, service)
     expect = hmac.new(sk, string_to_sign(amz_date, scope, creq).encode(),
                       hashlib.sha256).hexdigest()
     if not hmac.compare_digest(expect, signature):

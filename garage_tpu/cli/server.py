@@ -50,6 +50,13 @@ async def run_server(cfg_path: str) -> None:
         host, port = parse_addr(cfg.admin_api_bind_addr)
         await ad.start(host, port)
         servers.append(ad)
+    if cfg.k2v_api_bind_addr:
+        from ..api.k2v.api_server import K2VApiServer
+
+        k2v = K2VApiServer(garage)
+        host, port = parse_addr(cfg.k2v_api_bind_addr)
+        await k2v.start(host, port)
+        servers.append(k2v)
     if cfg.web_bind_addr:
         from ..web.server import WebServer
 

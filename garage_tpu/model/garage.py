@@ -157,6 +157,21 @@ class Garage:
             meta_rep, rpc, self.db,
         )
 
+        # ---- K2V (ref: garage.rs:206-248 + model/k2v/) -----------------
+        from .k2v.item_table import K2VItemTable
+        from .k2v.rpc import K2VRpcHandler, SubscriptionManager
+
+        self.k2v_subscriptions = SubscriptionManager()
+        self.k2v_counter = IndexCounter(self.system, meta_rep, rpc, self.db,
+                                        "k2v_index_counter")
+        self.k2v_item_table = Table(
+            K2VItemTable(self.k2v_counter, self.k2v_subscriptions),
+            meta_rep, rpc, self.db,
+        )
+        self.k2v_rpc = K2VRpcHandler(self.system, self.db,
+                                     self.k2v_item_table,
+                                     self.k2v_subscriptions)
+
         # rc recalculation from the block_ref store (ref: garage.rs:252-256)
         self.block_manager.rc.register_calculator(
             block_ref_recount_fn(self.block_ref_table)
@@ -203,6 +218,7 @@ class Garage:
             self.bucket_table, self.bucket_alias_table, self.key_table,
             self.object_table, self.version_table, self.block_ref_table,
             self.mpu_table, self.object_counter.table, self.mpu_counter.table,
+            self.k2v_item_table, self.k2v_counter.table,
         ]
 
     def spawn_workers(self, scrub: bool = True) -> None:

@@ -1,0 +1,250 @@
+"""K2V RPC: insert routing + poll subscriptions.
+
+Ref parity: src/model/k2v/rpc.rs. Inserts are NOT applied by the API
+gateway node: they are routed to one of the partition's storage nodes
+(quorum 1) which applies the DVVS update under its *own* node id — this
+keeps vector clocks bounded by the replication factor instead of growing
+with every gateway that ever handled a write. The storage node then
+propagates the merged item through the normal table quorum write.
+
+PollItem long-polling (ref rpc.rs:206-260, sub.rs): the API node asks
+every storage node to wake it when the item's causal context becomes
+newer than the client's token; first non-empty response wins.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+from ...net.message import PRIO_NORMAL
+from ...table.schema import partition_hash
+from ...utils.crdt import now_msec
+from .causality import CausalContext
+from .item_table import K2VItem, partition_pk
+
+log = logging.getLogger("garage_tpu.model.k2v")
+
+_TIMESTAMP_KEY = b"timestamp"
+
+
+class SubscriptionManager:
+    """Wakes local pollers when an item changes (ref: k2v/sub.rs).
+
+    notify() can fire from worker threads (table updates apply via
+    asyncio.to_thread), so wakeups go through call_soon_threadsafe on
+    the loop captured at subscribe time, and the registry is
+    lock-protected."""
+
+    def __init__(self):
+        import threading
+
+        self._events: dict[tuple, list] = {}  # key -> [(loop, Event)]
+        self._lock = threading.Lock()
+
+    def _key(self, item: K2VItem) -> tuple:
+        return (item.bucket_id, item.partition_key_str, item.sort_key_str)
+
+    def notify(self, item: K2VItem) -> None:
+        with self._lock:
+            waiters = self._events.pop(self._key(item), [])
+        for loop, ev in waiters:
+            loop.call_soon_threadsafe(ev.set)
+
+    def subscribe(self, bucket_id: bytes, pk: str, sk: str) -> asyncio.Event:
+        ev = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        with self._lock:
+            self._events.setdefault((bucket_id, pk, sk), []).append(
+                (loop, ev))
+        return ev
+
+    def unsubscribe(self, bucket_id: bytes, pk: str, sk: str,
+                    ev: asyncio.Event) -> None:
+        with self._lock:
+            lst = self._events.get((bucket_id, pk, sk))
+            if not lst:
+                return
+            self._events[(bucket_id, pk, sk)] = [
+                (lp, e) for lp, e in lst if e is not ev]
+            if not self._events[(bucket_id, pk, sk)]:
+                del self._events[(bucket_id, pk, sk)]
+
+
+class K2VRpcHandler:
+    def __init__(self, system, db, item_table, subscriptions):
+        self.system = system
+        self.item_table = item_table
+        self.subscriptions = subscriptions
+        self.local_timestamp = db.open_tree("k2v_local_timestamp")
+        self.endpoint = system.netapp.endpoint("garage_tpu/k2v").set_handler(
+            self._handle)
+
+    # ---- public interface (API server calls these) ---------------------
+
+    def _storage_nodes(self, bucket_id: bytes, partition_key: str
+                       ) -> list[bytes]:
+        ph = partition_hash(partition_pk(bucket_id, partition_key))
+        return sorted(self.item_table.replication.storage_nodes(ph))
+
+    async def insert(self, bucket_id: bytes, partition_key: str,
+                     sort_key: str, causal_context: Optional[CausalContext],
+                     value: Optional[bytes]) -> None:
+        who = self._storage_nodes(bucket_id, partition_key)
+        payload = {
+            "op": "insert",
+            "bucket": bucket_id,
+            "pk": partition_key,
+            "sk": sort_key,
+            "ct": (causal_context.serialize()
+                   if causal_context is not None else None),
+            "value": value,
+        }
+        await self._call_any(who, payload)
+
+    async def insert_batch(self, bucket_id: bytes,
+                           items: list[tuple[str, str,
+                                             Optional[CausalContext],
+                                             Optional[bytes]]]) -> None:
+        by_nodes: dict[tuple, list] = {}
+        for pk, sk, ct, value in items:
+            who = tuple(self._storage_nodes(bucket_id, pk))
+            by_nodes.setdefault(who, []).append(
+                [pk, sk, ct.serialize() if ct is not None else None, value])
+        await asyncio.gather(*[
+            self._call_any(list(who), {"op": "insert_many",
+                                       "bucket": bucket_id,
+                                       "items": batch})
+            for who, batch in by_nodes.items()
+        ])
+
+    async def poll_item(self, bucket_id: bytes, partition_key: str,
+                        sort_key: str, causal_context: CausalContext,
+                        timeout: float) -> Optional[K2VItem]:
+        """Wait until the item is newer than `causal_context`; None on
+        timeout. First storage node to see a newer version answers."""
+        who = self._storage_nodes(bucket_id, partition_key)
+        payload = {"op": "poll_item", "bucket": bucket_id,
+                   "pk": partition_key, "sk": sort_key,
+                   "ct": causal_context.serialize(),
+                   "timeout_ms": int(timeout * 1000)}
+
+        async def one(node):
+            resp, _ = await self.endpoint.call(node, payload, PRIO_NORMAL,
+                                               timeout=timeout + 10.0)
+            if resp.get("item") is None:
+                raise TimeoutError("poll timed out on peer")
+            return resp["item"]
+
+        tasks = [asyncio.create_task(one(n)) for n in who]
+        try:
+            while tasks:
+                done, tasks_set = await asyncio.wait(
+                    tasks, return_when=asyncio.FIRST_COMPLETED)
+                tasks = list(tasks_set)
+                for t in done:
+                    if t.exception() is None:
+                        from ...utils import migrate
+
+                        return migrate.decode(K2VItem, t.result())
+            return None
+        finally:
+            for t in tasks:
+                t.cancel()
+
+    # ---- local application --------------------------------------------
+
+    async def _call_any(self, who: list[bytes], payload) -> None:
+        """try_call_many with quorum 1 (ref: rpc.rs insert)."""
+        from ...rpc.rpc_helper import RequestStrategy
+
+        await self.item_table.rpc.try_call_many(
+            self.endpoint, who, payload,
+            RequestStrategy(quorum=1, prio=PRIO_NORMAL, timeout=30.0),
+        )
+
+    def _local_insert(self, bucket_id: bytes, pk: str, sk: str,
+                      ct_str: Optional[str],
+                      value: Optional[bytes]) -> Optional[K2VItem]:
+        """Apply the DVVS update locally under OUR node id, atomically
+        with the monotonic local-timestamp bump, through the full
+        trigger/merkle path (ref: rpc.rs local_insert)."""
+        ct = CausalContext.parse(ct_str) if ct_str else None
+        data = self.item_table.data
+
+        def apply(tx, old):
+            old_ts_raw = tx.get(self.local_timestamp, _TIMESTAMP_KEY)
+            old_ts = (int.from_bytes(old_ts_raw, "big")
+                      if old_ts_raw else 0)
+            ent = old if old is not None else K2VItem(bucket_id, pk, sk)
+            new_ts = ent.update(self.system.id, ct, value,
+                                max(old_ts, now_msec()))
+            tx.insert(self.local_timestamp, _TIMESTAMP_KEY,
+                      new_ts.to_bytes(8, "big"))
+            return ent
+
+        return data.update_entry_with(partition_pk(bucket_id, pk),
+                                      sk.encode(), apply)
+
+    # ---- server side ---------------------------------------------------
+
+    async def _handle(self, from_node, payload, stream):
+        op = payload["op"]
+        if op == "insert":
+            item = self._local_insert(payload["bucket"], payload["pk"],
+                                      payload["sk"], payload.get("ct"),
+                                      payload.get("value"))
+            if item is not None:
+                await self.item_table.insert(item)
+            return {"ok": True}
+        if op == "insert_many":
+            updated = []
+            for pk, sk, ct, value in payload["items"]:
+                item = self._local_insert(payload["bucket"], pk, sk, ct,
+                                          value)
+                if item is not None:
+                    updated.append(item)
+            for item in updated:
+                await self.item_table.insert(item)
+            return {"ok": True}
+        if op == "poll_item":
+            item = await self._handle_poll(
+                payload["bucket"], payload["pk"], payload["sk"],
+                payload["ct"], payload["timeout_ms"] / 1000.0)
+            from ...utils import migrate
+
+            return {"item": migrate.encode(item) if item else None}
+        raise ValueError(f"unknown k2v op {op!r}")
+
+    async def _handle_poll(self, bucket_id: bytes, pk: str, sk: str,
+                           ct_str: str, timeout: float
+                           ) -> Optional[K2VItem]:
+        ct = CausalContext.parse(ct_str)
+        if ct is None:
+            raise ValueError("bad causality token")
+        deadline = time.monotonic() + timeout
+        while True:
+            ev = self.subscriptions.subscribe(bucket_id, pk, sk)
+            try:
+                item = self._read_local(bucket_id, pk, sk)
+                if item is not None and item.causal_context(
+                        ).is_newer_than(ct):
+                    return item
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                try:
+                    await asyncio.wait_for(ev.wait(), remaining)
+                except asyncio.TimeoutError:
+                    return None
+            finally:
+                self.subscriptions.unsubscribe(bucket_id, pk, sk, ev)
+
+    def _read_local(self, bucket_id: bytes, pk: str,
+                    sk: str) -> Optional[K2VItem]:
+        raw = self.item_table.data.read_entry(
+            partition_pk(bucket_id, pk), sk.encode())
+        return (self.item_table.data.decode_stored(raw)
+                if raw is not None else None)

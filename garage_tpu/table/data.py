@@ -64,18 +64,35 @@ class TableData:
         return self.schema.decode_entry(raw)
 
     def read_range(self, pk: bytes, start_sk: Optional[bytes], flt,
-                   limit: int, reverse: bool = False) -> list[bytes]:
+                   limit: int, reverse: bool = False,
+                   prefix_sk: Optional[bytes] = None,
+                   end_sk: Optional[bytes] = None) -> list[bytes]:
         """Rows of one partition key, from start_sk, filtered, ≤ limit.
-        ref: table/data.rs read_range."""
+        `prefix_sk` bounds both ends to sort keys with that prefix (so a
+        reverse scan without an explicit start begins at the prefix's
+        upper bound, not at it); `end_sk` is an exclusive stop bound.
+        ref: table/data.rs read_range + k2v range semantics."""
         prefix = tree_key(pk, b"")
-        start = tree_key(pk, start_sk) if start_sk is not None else prefix
-        end_excl = _prefix_upper_bound(prefix)
-        out = []
-        if reverse:
-            rev_end = start + b"\x00" if start_sk is not None else end_excl
-            it = self.store.iter(start=prefix, end=rev_end, reverse=True)
+        part_end = _prefix_upper_bound(prefix)
+        lo, hi = prefix, part_end
+        if prefix_sk is not None:
+            lo = tree_key(pk, prefix_sk)
+            hi = _prefix_upper_bound(lo) or part_end
+        if not reverse:
+            if start_sk is not None:
+                lo = max(lo, tree_key(pk, start_sk))
+            if end_sk is not None:
+                hi = min(hi, tree_key(pk, end_sk))
+            it = self.store.iter(start=lo, end=hi)
         else:
-            it = self.store.iter(start=start, end=end_excl)
+            # reverse: start_sk = inclusive upper start; end_sk =
+            # exclusive lower stop (keys must stay > end_sk)
+            if start_sk is not None:
+                hi = min(hi, tree_key(pk, start_sk) + b"\x00")
+            if end_sk is not None:
+                lo = max(lo, tree_key(pk, end_sk) + b"\x00")
+            it = self.store.iter(start=lo, end=hi, reverse=True)
+        out = []
         for k, v in it:
             if not k.startswith(prefix):
                 break
@@ -98,23 +115,41 @@ class TableData:
         return self.update_entry_decoded(entry)
 
     def update_entry_decoded(self, entry: Entry) -> Optional[Entry]:
-        k = tree_key(entry.partition_key(), entry.sort_key())
+        return self._apply_row(
+            entry.partition_key(), entry.sort_key(),
+            lambda tx, old: old.merge(entry) if old is not None else entry,
+        )
+
+    def update_entry_with(self, pk: bytes, sk: bytes, fn) -> Optional[Entry]:
+        """Read-modify-write one row inside a single transaction with the
+        full trigger/merkle path: `fn(tx, old_entry_or_None) -> Entry`.
+        ref: table/data.rs update_entry_with (K2V's local insert uses it
+        so the DVVS update + local-timestamp bump commit atomically)."""
+        return self._apply_row(pk, sk, fn)
+
+    def _apply_row(self, pk: bytes, sk: bytes, produce) -> Optional[Entry]:
+        """The one commit path for local row changes:
+        `produce(tx, old_or_None) -> new` runs inside the transaction,
+        then store write + merkle todo + updated() trigger + gc todo +
+        changed hooks. `produce` MAY mutate the decoded old entry and
+        return it — the trigger's `old` is re-decoded from the stored
+        bytes so counter deltas never alias old and new."""
+        k = tree_key(pk, sk)
 
         def body(tx):
             old_raw = tx.get(self.store, k)
-            if old_raw is not None:
-                old = self.schema.decode_entry(old_raw)
-                new = old.merge(entry)
-            else:
-                old = None
-                new = entry
+            old_for_fn = (self.schema.decode_entry(old_raw)
+                          if old_raw is not None else None)
+            new = produce(tx, old_for_fn)
             new_raw = self.schema.encode_entry(new)
             if old_raw == new_raw:
                 return None
+            old = (self.schema.decode_entry(old_raw)
+                   if old_raw is not None else None)
             tx.insert(self.store, k, new_raw)
             tx.insert(self.merkle_todo, k, blake2sum(new_raw))
             self.schema.updated(tx, old, new)
-            self._maybe_gc_todo(tx, entry, new, k, new_raw)
+            self._maybe_gc_todo(tx, new, k, new_raw)
             return new
 
         new = self.db.transaction(body)
@@ -134,7 +169,7 @@ class TableData:
                 n += 1
         return n
 
-    def _maybe_gc_todo(self, tx, incoming: Entry, new: Entry, k: bytes,
+    def _maybe_gc_todo(self, tx, new: Entry, k: bytes,
                        new_raw: bytes) -> None:
         """Tombstones get a GC-todo entry on the partition leader
         (ref: data.rs:242-257)."""

@@ -132,7 +132,9 @@ class Table:
 
     async def get_range(self, pk: bytes, start_sk: Optional[bytes] = None,
                         flt=None, limit: int = 100,
-                        reverse: bool = False) -> list[Entry]:
+                        reverse: bool = False,
+                        prefix_sk: Optional[bytes] = None,
+                        end_sk: Optional[bytes] = None) -> list[Entry]:
         """ref: table.rs:363-483."""
         ph = partition_hash(pk)
         nodes = self.replication.read_nodes(ph)
@@ -140,7 +142,8 @@ class Table:
             self.endpoint,
             nodes,
             {"op": "read_range", "pk": pk, "start_sk": start_sk,
-             "limit": limit, "reverse": reverse, "filter": flt},
+             "limit": limit, "reverse": reverse, "filter": flt,
+             "prefix_sk": prefix_sk, "end_sk": end_sk},
             RequestStrategy(quorum=self.replication.read_quorum()),
         )
         by_key: dict[tuple, Entry] = {}
@@ -202,6 +205,7 @@ class Table:
                 self.data.read_range,
                 payload["pk"], payload.get("start_sk"), payload.get("filter"),
                 payload.get("limit", 100), payload.get("reverse", False),
+                payload.get("prefix_sk"), payload.get("end_sk"),
             )
             return {"entries": entries}
         raise ValueError(f"unknown table op {op!r}")

@@ -1,0 +1,301 @@
+"""K2V: DVVS semantics, causality tokens, insert routing, poll.
+
+Ref parity targets: src/model/k2v/causality.rs (token round-trip test
+vector), item_table.rs (DVVS update/discard/merge), rpc.rs (routed
+inserts keep vector clocks bounded; read-your-write via tokens).
+"""
+
+import asyncio
+
+from garage_tpu.model.k2v import (CausalContext, DvvsEntry, K2VItem,
+                                  make_node_id, partition_pk)
+from garage_tpu.utils.data import gen_uuid
+
+from test_model import make_garage_cluster, stop_all, wait_until  # noqa
+
+
+def run(coro, timeout=120.0):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+# ---- causality tokens ----------------------------------------------------
+
+
+def test_causality_token_roundtrip():
+    # the reference's own test vector (causality.rs tests)
+    ct = CausalContext({4: 42, 1928131023: 76, 0xEFC0C1C47F9DE433: 2})
+    assert CausalContext.parse(ct.serialize()) == ct
+    assert CausalContext.parse("") is None
+    assert CausalContext.parse("garbage!!") is None
+    # checksum catches corruption
+    tok = ct.serialize()
+    bad = ("A" if tok[0] != "A" else "B") + tok[1:]
+    assert CausalContext.parse(bad) != ct
+
+
+def test_causality_newer_than():
+    a = CausalContext({1: 5})
+    b = CausalContext({1: 3, 2: 1})
+    assert a.is_newer_than(b)
+    assert b.is_newer_than(a)  # concurrent: each has something new
+    c = CausalContext({1: 5, 2: 1})
+    assert not a.is_newer_than(c)
+    assert not b.is_newer_than(c)
+
+
+# ---- DVVS semantics ------------------------------------------------------
+
+
+def test_dvvs_update_and_discard():
+    node_a, node_b = gen_uuid(), gen_uuid()
+    item = K2VItem(gen_uuid(), "pk", "sk")
+    item.update(node_a, None, b"v1", 0)
+    assert item.live_values() == [b"v1"]
+    # concurrent write on another node without context -> conflict
+    item.update(node_b, None, b"v2", 0)
+    assert sorted(item.live_values()) == [b"v1", b"v2"]
+    # write WITH the merged context discards both
+    ct = item.causal_context()
+    item.update(node_a, ct, b"v3", 0)
+    assert item.live_values() == [b"v3"]
+    # delete with context -> tombstone
+    item.update(node_b, item.causal_context(), None, 0)
+    assert item.is_tombstone()
+
+
+def test_dvvs_merge_commutative_idempotent():
+    node_a, node_b = gen_uuid(), gen_uuid()
+    base = K2VItem(gen_uuid(), "p", "s")
+    base.update(node_a, None, b"x", 0)
+    i1 = base.merge(K2VItem(base.bucket_id, "p", "s"))
+    i2 = K2VItem(base.bucket_id, "p", "s")
+    i2.update(node_b, None, b"y", 0)
+    m12 = i1.merge(i2)
+    m21 = i2.merge(i1)
+    assert sorted(m12.live_values()) == sorted(m21.live_values()) \
+        == [b"x", b"y"]
+    assert m12.merge(i2).pack() == m12.pack()  # idempotent
+
+
+def test_dvvs_entry_encoding_roundtrip():
+    e = DvvsEntry(5, [(7, b"abc"), (9, None)])
+    assert DvvsEntry.unpack(e.pack()).pack() == e.pack()
+    item = K2VItem(gen_uuid(), "pk", "sk",
+                   {make_node_id(gen_uuid()): e})
+    from garage_tpu.utils import migrate
+
+    assert migrate.decode(K2VItem, migrate.encode(item)).pack() \
+        == item.pack()
+
+
+# ---- cluster: routed inserts + read-your-write + poll --------------------
+
+
+def test_k2v_cluster_insert_read_delete(tmp_path):
+    async def main():
+        net, garages, tasks = await make_garage_cluster(tmp_path, n=3, rf=3)
+        g0 = garages[0]
+        try:
+            bucket_id = gen_uuid()
+            await g0.k2v_rpc.insert(bucket_id, "part", "key1", None,
+                                    b"hello")
+            item = await g0.k2v_item_table.get(
+                partition_pk(bucket_id, "part"), b"key1")
+            assert item is not None and item.live_values() == [b"hello"]
+            # vector clock carries exactly ONE node id (the storage
+            # node that applied it) — the point of insert routing
+            assert len(item.causal_context().vector_clock) == 1
+
+            # read-your-write from another node using the token
+            item2 = await garages[1].k2v_item_table.get(
+                partition_pk(bucket_id, "part"), b"key1")
+            ct = item2.causal_context()
+            await garages[1].k2v_rpc.insert(bucket_id, "part", "key1",
+                                            ct, b"world")
+            item3 = await garages[2].k2v_item_table.get(
+                partition_pk(bucket_id, "part"), b"key1")
+            assert item3.live_values() == [b"world"]
+
+            # delete
+            await g0.k2v_rpc.insert(bucket_id, "part", "key1",
+                                    item3.causal_context(), None)
+            item4 = await g0.k2v_item_table.get(
+                partition_pk(bucket_id, "part"), b"key1")
+            assert item4.is_tombstone()
+        finally:
+            await stop_all(garages, tasks)
+
+    run(main())
+
+
+def test_k2v_conflicting_writes_coexist(tmp_path):
+    async def main():
+        net, garages, tasks = await make_garage_cluster(tmp_path, n=3, rf=3)
+        g0 = garages[0]
+        try:
+            bucket_id = gen_uuid()
+            # two writes with NO causality token = concurrent
+            await g0.k2v_rpc.insert(bucket_id, "p", "k", None, b"a")
+            await garages[1].k2v_rpc.insert(bucket_id, "p", "k", None,
+                                            b"b")
+            item = await g0.k2v_item_table.get(
+                partition_pk(bucket_id, "p"), b"k")
+            assert sorted(item.live_values()) == [b"a", b"b"]
+            # resolving write discards both
+            await g0.k2v_rpc.insert(bucket_id, "p", "k",
+                                    item.causal_context(), b"resolved")
+            item2 = await g0.k2v_item_table.get(
+                partition_pk(bucket_id, "p"), b"k")
+            assert item2.live_values() == [b"resolved"]
+        finally:
+            await stop_all(garages, tasks)
+
+    run(main())
+
+
+def test_k2v_insert_batch_and_counters(tmp_path):
+    async def main():
+        net, garages, tasks = await make_garage_cluster(tmp_path, n=3, rf=3)
+        g0 = garages[0]
+        try:
+            bucket_id = gen_uuid()
+            await g0.k2v_rpc.insert_batch(bucket_id, [
+                ("pa", "k1", None, b"1"),
+                ("pa", "k2", None, b"22"),
+                ("pb", "k1", None, b"333"),
+            ])
+            for pk, sk, want in (("pa", "k1", b"1"), ("pa", "k2", b"22"),
+                                 ("pb", "k1", b"333")):
+                item = await g0.k2v_item_table.get(
+                    partition_pk(bucket_id, pk), sk.encode())
+                assert item.live_values() == [want], (pk, sk)
+            # index counters converge
+            nodes = list(g0.system.layout_manager.history
+                         .all_nongateway_nodes())
+            vals = {}
+            for _ in range(100):
+                vals = await g0.k2v_counter.read(bucket_id, b"pa", nodes)
+                if vals.get("entries") == 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert vals.get("entries") == 2
+            assert vals.get("bytes") == 3  # len("1") + len("22")
+        finally:
+            await stop_all(garages, tasks)
+
+    run(main())
+
+
+def test_k2v_poll_item_wakes_on_write(tmp_path):
+    async def main():
+        net, garages, tasks = await make_garage_cluster(tmp_path, n=3, rf=3)
+        g0 = garages[0]
+        try:
+            bucket_id = gen_uuid()
+            await g0.k2v_rpc.insert(bucket_id, "p", "k", None, b"v1")
+            item = await g0.k2v_item_table.get(
+                partition_pk(bucket_id, "p"), b"k")
+            ct = item.causal_context()
+
+            async def poller():
+                return await garages[1].k2v_rpc.poll_item(
+                    bucket_id, "p", "k", ct, timeout=20.0)
+
+            task = asyncio.create_task(poller())
+            await asyncio.sleep(0.2)
+            assert not task.done()
+            await g0.k2v_rpc.insert(bucket_id, "p", "k", ct, b"v2")
+            got = await asyncio.wait_for(task, 20.0)
+            assert got is not None and b"v2" in got.live_values()
+
+            # poll with up-to-date token times out -> None
+            item2 = await g0.k2v_item_table.get(
+                partition_pk(bucket_id, "p"), b"k")
+            got2 = await garages[1].k2v_rpc.poll_item(
+                bucket_id, "p", "k", item2.causal_context(), timeout=0.5)
+            assert got2 is None
+        finally:
+            await stop_all(garages, tasks)
+
+    run(main())
+
+
+def test_k2v_counters_track_overwrite_and_delete(tmp_path):
+    """Regression: counter deltas must not alias old/new on the routed
+    local-insert path (overwrite/delete previously left stale stats)."""
+    async def main():
+        net, garages, tasks = await make_garage_cluster(tmp_path, n=1, rf=1)
+        g0 = garages[0]
+        try:
+            bucket_id = gen_uuid()
+            nodes = list(g0.system.layout_manager.history
+                         .all_nongateway_nodes())
+
+            async def counters():
+                for _ in range(100):
+                    v = await g0.k2v_counter.read(bucket_id, b"p", nodes)
+                    if v:
+                        return v
+                    await asyncio.sleep(0.02)
+                return {}
+
+            await g0.k2v_rpc.insert(bucket_id, "p", "k", None, b"xxxx")
+            v = await counters()
+            assert v.get("entries") == 1 and v.get("bytes") == 4
+            item = await g0.k2v_item_table.get(
+                partition_pk(bucket_id, "p"), b"k")
+            # overwrite with a longer value: bytes must follow
+            await g0.k2v_rpc.insert(bucket_id, "p", "k",
+                                    item.causal_context(), b"y" * 10)
+            for _ in range(100):
+                v = await g0.k2v_counter.read(bucket_id, b"p", nodes)
+                if v.get("bytes") == 10:
+                    break
+                await asyncio.sleep(0.02)
+            assert v.get("bytes") == 10 and v.get("entries") == 1
+            # delete: entries drops to 0
+            item2 = await g0.k2v_item_table.get(
+                partition_pk(bucket_id, "p"), b"k")
+            await g0.k2v_rpc.insert(bucket_id, "p", "k",
+                                    item2.causal_context(), None)
+            for _ in range(100):
+                v = await g0.k2v_counter.read(bucket_id, b"p", nodes)
+                if v.get("entries") == 0:
+                    break
+                await asyncio.sleep(0.02)
+            assert v.get("entries") == 0 and v.get("bytes") == 0
+        finally:
+            await stop_all(garages, tasks)
+
+    run(main())
+
+
+def test_k2v_reverse_prefix_and_pagination(tmp_path):
+    async def main():
+        net, garages, tasks = await make_garage_cluster(tmp_path, n=1, rf=1)
+        g0 = garages[0]
+        try:
+            bucket_id = gen_uuid()
+            await g0.k2v_rpc.insert_batch(bucket_id, [
+                ("p", sk, None, b"v") for sk in
+                ("a1", "a2", "a3", "b1", "b2")
+            ])
+            pk = partition_pk(bucket_id, "p")
+            # reverse with prefix, no start: must return a3, a2, a1
+            items = await g0.k2v_item_table.get_range(
+                pk, None, flt={"type": "item"}, limit=10, reverse=True,
+                prefix_sk=b"a")
+            assert [i.sort_key_str for i in items] == ["a3", "a2", "a1"]
+            # forward with exclusive end
+            items = await g0.k2v_item_table.get_range(
+                pk, None, flt={"type": "item"}, limit=10, end_sk=b"a3")
+            assert [i.sort_key_str for i in items] == ["a1", "a2"]
+            # reverse with exclusive end
+            items = await g0.k2v_item_table.get_range(
+                pk, None, flt={"type": "item"}, limit=10, reverse=True,
+                end_sk=b"a2")
+            assert [i.sort_key_str for i in items] == ["b2", "b1", "a3"]
+        finally:
+            await stop_all(garages, tasks)
+
+    run(main())

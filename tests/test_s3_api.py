@@ -38,6 +38,7 @@ class Server:
         self.s3_port = free_port()
         self.admin_port = free_port()
         self.web_port = free_port()
+        self.k2v_port = free_port()
         self.config_path = os.path.join(tmpdir, "garage.toml")
         with open(self.config_path, "w") as f:
             f.write(f"""
@@ -53,6 +54,9 @@ rpc_public_addr = "127.0.0.1:{self.rpc_port}"
 api_bind_addr = "127.0.0.1:{self.s3_port}"
 s3_region = "garage"
 root_domain = ".s3.garage.test"
+
+[k2v_api]
+api_bind_addr = "127.0.0.1:{self.k2v_port}"
 
 [admin]
 api_bind_addr = "127.0.0.1:{self.admin_port}"
@@ -812,3 +816,113 @@ def test_cli_meta_snapshot(server):
     assert "snapshot written to" in out
     path = out.strip().split()[-1]
     assert os.path.basename(os.path.dirname(path)) == "snapshots"
+
+
+# ---- K2V API (driven with the standalone k2v_client SDK) ----------------
+
+
+@pytest.fixture(scope="module")
+def k2v(server, client):
+    from garage_tpu.k2v_client import K2vClient
+
+    status, _, body = client.request("PUT", "/k2vbkt")
+    assert status == 200, body
+    return K2vClient("127.0.0.1", server.k2v_port, "k2vbkt",
+                     server.key_id, server.secret)
+
+
+def test_k2v_item_roundtrip(k2v):
+    from garage_tpu.k2v_client import K2vError
+
+    k2v.insert_item("users", "alice", b'{"age": 30}')
+    val = k2v.read_item("users", "alice")
+    assert val.value == b'{"age": 30}'
+    # read-your-write via causality token
+    k2v.insert_item("users", "alice", b'{"age": 31}',
+                    causality=val.causality)
+    val2 = k2v.read_item("users", "alice")
+    assert val2.values == [b'{"age": 31}']
+    # delete with token -> the tombstone stays readable as [null] so
+    # its causality token can seed a re-insert (ref: item.rs
+    # make_response serves DvvsValue::Deleted as JSON null / 204)
+    k2v.delete_item("users", "alice", causality=val2.causality)
+    val3 = k2v.read_item("users", "alice")
+    assert val3.values == [None]
+    assert val3.value is None
+    # a never-written key is a true 404
+    try:
+        k2v.read_item("users", "ghost")
+        raise AssertionError("expected NoSuchKey")
+    except K2vError as e:
+        assert e.status == 404
+
+
+def test_k2v_conflict_surfaces_both_values(k2v):
+    k2v.insert_item("conf", "k", b"one")      # no token
+    k2v.insert_item("conf", "k", b"two")      # no token: concurrent
+    val = k2v.read_item("conf", "k")
+    assert sorted(v for v in val.values if v) == [b"one", b"two"]
+    k2v.insert_item("conf", "k", b"merged", causality=val.causality)
+    assert k2v.read_item("conf", "k").values == [b"merged"]
+
+
+def test_k2v_batch_and_index(k2v):
+    k2v.insert_batch([
+        ("idx", "a", b"1", None),
+        ("idx", "b", b"2", None),
+        ("idx2", "a", b"3", None),
+    ])
+    res = k2v.read_batch([{"partitionKey": "idx"}])
+    assert [i["sk"] for i in res[0]["items"]] == ["a", "b"]
+    # counters propagate through the async insert queue
+    parts = {}
+    for _ in range(100):
+        parts = {p.pk: p for p in k2v.read_index(prefix="idx")}
+        if "idx" in parts and "idx2" in parts \
+                and parts["idx"].entries == 2:
+            break
+        time.sleep(0.1)
+    assert parts["idx"].entries == 2
+    assert parts["idx2"].entries == 1
+    assert parts["idx"].bytes == 2
+    deleted = k2v.delete_batch([{"partitionKey": "idx"}])
+    assert deleted[0]["deletedItems"] == 2
+    res2 = k2v.read_batch([{"partitionKey": "idx"}])
+    assert res2[0]["items"] == []
+
+
+def test_k2v_poll_item(server, k2v):
+    import threading
+
+    k2v.insert_item("poll", "k", b"v1")
+    val = k2v.read_item("poll", "k")
+    got = {}
+
+    def poller():
+        got["val"] = k2v.poll_item("poll", "k", val.causality,
+                                   timeout=20.0)
+
+    t = threading.Thread(target=poller)
+    t.start()
+    time.sleep(0.5)
+    k2v.insert_item("poll", "k", b"v2", causality=val.causality)
+    t.join(timeout=25.0)
+    assert not t.is_alive()
+    assert got["val"] is not None and got["val"].values == [b"v2"]
+
+
+def test_k2v_read_batch_pagination_no_duplicates(k2v):
+    k2v.insert_batch([("pages", f"k{i:02d}", b"x", None)
+                      for i in range(7)])
+    res = k2v.read_batch([{"partitionKey": "pages", "limit": 3}])
+    page1 = [i["sk"] for i in res[0]["items"]]
+    assert page1 == ["k00", "k01", "k02"]
+    assert res[0]["more"] is True
+    res2 = k2v.read_batch([{"partitionKey": "pages", "limit": 3,
+                            "start": res[0]["nextStart"]}])
+    page2 = [i["sk"] for i in res2[0]["items"]]
+    assert page2 == ["k03", "k04", "k05"]
+    res3 = k2v.read_batch([{"partitionKey": "pages", "limit": 3,
+                            "start": res2[0]["nextStart"]}])
+    assert [i["sk"] for i in res3[0]["items"]] == ["k06"]
+    assert res3[0]["more"] is False
