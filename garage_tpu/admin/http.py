@@ -1,8 +1,12 @@
-"""Admin HTTP API: /health, /metrics (Prometheus text), /status.
+"""Admin HTTP API: health/metrics + the v1 cluster-management REST API.
 
-Ref parity: src/api/admin/api_server.rs:232-330 + rpc/system_metrics.rs.
-Bearer-token auth via admin_token/metrics_token config; /health is
-always public (used by load balancers).
+Ref parity: src/api/admin/api_server.rs:232-330 + router_v1.rs (cluster
+status/health/connect, layout staging, key + bucket CRUD, aliasing,
+allow/deny) and rpc/system_metrics.rs for /metrics. Bearer-token auth
+via admin_token (management) / metrics_token (/metrics); /health is
+always public (used by load balancers). Management endpoints delegate
+to the same AdminRpcHandler ops the CLI drives, so both operator
+surfaces stay behavior-identical.
 """
 
 from __future__ import annotations
@@ -10,12 +14,23 @@ from __future__ import annotations
 import json
 
 from ..api.http import HttpServer, Request, Response
+from ..utils.error import BadRequest, GarageError, NoSuchBucket, NoSuchKey
+
+
+def _json(body, status: int = 200) -> Response:
+    return Response(status, [("content-type", "application/json")],
+                    json.dumps(body, default=str).encode())
 
 
 class AdminHttpServer:
-    def __init__(self, garage):
+    def __init__(self, garage, admin_rpc=None):
         self.garage = garage
         self.http = HttpServer(self.handle, name="admin")
+        if admin_rpc is None:
+            from .rpc import AdminRpcHandler
+
+            admin_rpc = AdminRpcHandler(garage)
+        self.rpc = admin_rpc
 
     async def start(self, host: str, port: int) -> None:
         await self.http.start(host, port)
@@ -42,24 +57,259 @@ class AdminHttpServer:
                             [("content-type",
                               "text/plain; version=0.0.4")],
                             self.render_metrics().encode())
-        if path in ("/status", "/v1/status"):
-            if not self._authorized(req, self.garage.config.admin_token):
-                return Response(403, [], b"forbidden")
-            from .rpc import AdminRpcHandler
+        if path == "/check" and req.method == "GET":
+            return await self._check_domain(req)
+        if not self._authorized(req, self.garage.config.admin_token):
+            return Response(403, [], b"forbidden")
+        try:
+            resp = await self._route_v1(req)
+        except (BadRequest, NoSuchBucket, NoSuchKey, GarageError) as e:
+            code = 404 if isinstance(e, (NoSuchBucket, NoSuchKey)) else 400
+            return _json({"code": type(e).__name__, "message": str(e)},
+                         code)
+        except (KeyError, ValueError) as e:
+            return _json({"code": "InvalidRequest", "message": str(e)}, 400)
+        if resp is None:
+            return _json({"code": "NotFound",
+                          "message": f"no such endpoint {req.method} {path}"},
+                         404)
+        return resp
 
+    # ---- v1 management REST (ref: router_v1.rs:97-131) -----------------
+
+    async def _route_v1(self, req: Request):  # noqa: C901
+        m = req.method
+        path = req.path
+        if path.startswith("/v0/"):
+            path = "/v1/" + path[4:]  # v0 compat: same handlers
+        q = req.query
+
+        async def body_json():
+            raw = await req.body.read_all(limit=1 << 20)
+            return json.loads(raw.decode()) if raw else None
+
+        if path in ("/status", "/v1/status") and m == "GET":
+            r = await self.rpc.op_status({})
+            return _json({
+                "node": r["node_id"].hex(),
+                "garageVersion": "garage-tpu-0.3",
+                "clusterHealth": r["health"],
+                "layoutVersion": r["layout_version"],
+                "nodes": [{
+                    "id": n["id"].hex(),
+                    "addr": (f"{n['addr'][0]}:{n['addr'][1]}"
+                             if n.get("addr") else None),
+                    "isUp": n["is_up"],
+                    "hostname": n.get("hostname", ""),
+                    "role": n.get("role"),
+                } for n in r["nodes"]],
+            })
+        if path == "/v1/health" and m == "GET":
             h = self.garage.system.health()
-            body = {
-                "node": self.garage.system.id.hex(),
-                "garageVersion": "garage-tpu-0.2",
-                "clusterHealth": h.status.value,
+            return _json({
+                "status": h.status.value,
                 "knownNodes": h.known_nodes,
                 "connectedNodes": h.connected_nodes,
-                "layoutVersion":
-                    self.garage.system.layout_manager.history.current().version,
-            }
-            return Response(200, [("content-type", "application/json")],
-                            json.dumps(body).encode())
-        return Response(404, [], b"not found")
+                "storageNodes": h.storage_nodes,
+                "storageNodesOk": h.storage_nodes_up,
+                "partitions": 256,
+                "partitionsQuorum": h.partitions_quorum,
+            })
+        if path == "/v1/connect" and m == "POST":
+            peers = await body_json() or []
+            from ..model.garage import parse_peer
+
+            results = []
+            for p in peers:
+                try:
+                    addr, nid = parse_peer(p)
+                    await self.rpc.op_connect(
+                        {"addr": list(addr), "id": nid})
+                    results.append({"success": True, "error": None})
+                except Exception as e:
+                    results.append({"success": False, "error": str(e)})
+            return _json(results)
+
+        if path == "/v1/layout" and m == "GET":
+            r = await self.rpc.op_layout_show({})
+            return _json({"version": r["version"], "roles": r["roles"],
+                          "stagedRoleChanges": r["staged"]})
+        if path == "/v1/layout" and m == "POST":
+            changes = await body_json() or []
+            for c in changes:
+                nid = bytes.fromhex(c["id"])
+                if c.get("remove"):
+                    await self.rpc.op_layout_remove({"node": nid})
+                else:
+                    # a role change must be complete — defaulting zone or
+                    # capacity would silently relocate/drain the node
+                    if "zone" not in c or "capacity" not in c:
+                        raise BadRequest(
+                            "role change requires zone and capacity "
+                            "(capacity null = gateway)")
+                    cap = c["capacity"]
+                    if isinstance(cap, str):
+                        from ..utils.config import parse_capacity
+
+                        cap = parse_capacity(cap)
+                    await self.rpc.op_layout_assign({
+                        "node": nid, "zone": c["zone"],
+                        "capacity": cap,
+                        "tags": c.get("tags", []),
+                    })
+            return _json({"ok": True})
+        if path == "/v1/layout/apply" and m == "POST":
+            spec = await body_json() or {}
+            r = await self.rpc.op_layout_apply(
+                {"version": spec.get("version")})
+            return _json({"layout": {"version": r["version"]}})
+        if path == "/v1/layout/revert" and m == "POST":
+            self.garage.system.layout_manager.revert_staged()
+            return _json({"ok": True})
+
+        if path == "/v1/key" and m == "GET":
+            if q.get("id") or q.get("search"):
+                key_id = q.get("id")
+                if not key_id:
+                    for k in (await self.rpc.op_key_list({}))["keys"]:
+                        if k["id"].startswith(q["search"]) \
+                                or q["search"] in k["name"]:
+                            key_id = k["id"]
+                            break
+                    if not key_id:
+                        raise NoSuchKey(q["search"])
+                r = await self.rpc.op_key_info(
+                    {"key": key_id,
+                     "show_secret": q.get("showSecretKey") == "true"})
+                return _json(self._key_info_json(r))
+            r = await self.rpc.op_key_list({})
+            return _json([{"id": k["id"], "name": k["name"]}
+                          for k in r["keys"]])
+        if path == "/v1/key" and m == "POST":
+            if q.get("id"):
+                spec = await body_json() or {}
+                if spec.get("allow", {}).get("createBucket"):
+                    await self.rpc.op_key_allow({"key": q["id"],
+                                                 "create_bucket": True})
+                if spec.get("deny", {}).get("createBucket"):
+                    await self.rpc.op_key_deny({"key": q["id"],
+                                                "create_bucket": True})
+                r = await self.rpc.op_key_info({"key": q["id"]})
+                return _json(self._key_info_json(r))
+            spec = await body_json() or {}
+            r = await self.rpc.op_key_new({"name": spec.get("name", "")})
+            return _json({"accessKeyId": r["key_id"],
+                          "secretAccessKey": r["secret_key"]})
+        if path == "/v1/key/import" and m == "POST":
+            spec = await body_json() or {}
+            r = await self.rpc.op_key_import({
+                "key_id": spec["accessKeyId"],
+                "secret_key": spec["secretAccessKey"],
+                "name": spec.get("name", ""),
+            })
+            return _json({"accessKeyId": r["key_id"]})
+        if path == "/v1/key" and m == "DELETE":
+            await self.rpc.op_key_delete({"key": q["id"]})
+            return Response(204)
+
+        if path == "/v1/bucket" and m == "GET":
+            if q.get("id") or q.get("globalAlias"):
+                name = q.get("globalAlias") or q["id"]
+                r = await self.rpc.op_bucket_info({"name": name})
+                return _json({
+                    "id": r["id"], "globalAliases": r["aliases"],
+                    "keys": r["keys"], "objects": r["objects"],
+                    "bytes": r["bytes"],
+                    "unfinishedUploads": r["unfinished_uploads"],
+                })
+            r = await self.rpc.op_bucket_list({})
+            return _json([{"id": b["id"], "globalAliases": [b["name"]]}
+                          for b in r["buckets"]])
+        if path == "/v1/bucket" and m == "POST":
+            spec = await body_json() or {}
+            alias = spec.get("globalAlias")
+            if not alias:
+                raise BadRequest("globalAlias is required")
+            r = await self.rpc.op_bucket_create({"name": alias})
+            return _json({"id": r["id"], "globalAliases": [alias]})
+        if path == "/v1/bucket" and m == "DELETE":
+            await self.rpc.helper.delete_bucket(bytes.fromhex(q["id"]))
+            return Response(204)
+
+        if path == "/v1/bucket/allow" and m == "POST":
+            spec = await body_json() or {}
+            perms = spec.get("permissions", {})
+            await self.rpc.op_bucket_allow({
+                "bucket": spec["bucketId"], "key": spec["accessKeyId"],
+                "read": perms.get("read"), "write": perms.get("write"),
+                "owner": perms.get("owner"),
+            })
+            return _json({"ok": True})
+        if path == "/v1/bucket/deny" and m == "POST":
+            spec = await body_json() or {}
+            perms = spec.get("permissions", {})
+            await self.rpc.op_bucket_deny({
+                "bucket": spec["bucketId"], "key": spec["accessKeyId"],
+                "read": perms.get("read"), "write": perms.get("write"),
+                "owner": perms.get("owner"),
+            })
+            return _json({"ok": True})
+
+        if path == "/v1/bucket/alias/global":
+            helper = self.rpc.helper
+            bid = bytes.fromhex(q["id"])
+            if m == "PUT":
+                await helper.global_alias_bucket(bid, q["alias"])
+                return _json({"ok": True})
+            if m == "DELETE":
+                await helper.global_unalias_bucket(bid, q["alias"])
+                return _json({"ok": True})
+        if path == "/v1/bucket/alias/local":
+            helper = self.rpc.helper
+            bid = bytes.fromhex(q["id"])
+            if m == "PUT":
+                await helper.local_alias_bucket(bid, q["accessKeyId"],
+                                                q["alias"])
+                return _json({"ok": True})
+            if m == "DELETE":
+                await helper.local_unalias_bucket(bid, q["accessKeyId"],
+                                                  q["alias"])
+                return _json({"ok": True})
+
+        return None
+
+    async def _check_domain(self, req: Request) -> Response:
+        """Website vhost check for reverse proxies; deliberately
+        UNAUTHENTICATED like the reference (api_server.rs routes
+        CheckDomain before auth — on-demand-TLS issuers don't hold
+        admin tokens)."""
+        domain = req.query.get("domain", "")
+        helper = self.rpc.helper
+        name = domain.split(":")[0]
+        root = self.garage.config.web_root_domain
+        if name.endswith(root):
+            name = name[: -len(root)]
+        try:
+            bid = await helper.resolve_global_bucket_name(name)
+            if bid is not None:
+                b = await helper.get_existing_bucket(bid)
+                if b.params.website_config.value is not None:
+                    return Response(200, [], b"Domain is managed\n")
+        except (NoSuchBucket, BadRequest):
+            pass
+        return Response(400, [], b"Domain not managed\n")
+
+    @staticmethod
+    def _key_info_json(r: dict) -> dict:
+        return {
+            "accessKeyId": r["id"], "name": r["name"],
+            "secretAccessKey": r.get("secret_key"),
+            "permissions": {"createBucket": r.get("create_bucket", False)},
+            "buckets": [
+                {"id": bid, "permissions": perms}
+                for bid, perms in r.get("buckets", {}).items()
+            ],
+        }
 
     def render_metrics(self) -> str:
         """Prometheus text exposition from live counters
@@ -101,6 +351,19 @@ class AdminHttpServer:
             s = t.data.stats()
             for k, v in s.items():
                 gauge(f"table_{k}", v, table=t.name)
+
+        # op counters/durations from the process-wide registry
+        # (rpc/table/api/block series; ref: rpc/metrics.rs etc.)
+        from ..utils.metrics import registry
+
+        out.extend(registry().render())
+
+        # device feeder calibration (TPU-native observability)
+        for opbe, mbps in g.block_manager.feeder.perf_summary().items():
+            op, _, be = opbe.partition("/")
+            gauge("feeder_throughput_mbps", mbps, op=op, backend=be)
+        for k, v in g.block_manager.feeder.stats.items():
+            gauge(f"feeder_{k}", v)
 
         for wid, info in g.runner.worker_info().items():
             gauge("worker_busy", 1 if info.state == "busy" else 0,

@@ -12,7 +12,7 @@ import logging
 
 from ..model.helper import GarageHelper, allow_all
 from ..rpc.layout.version import NodeRole
-from ..utils.error import BadRequest, GarageError
+from ..utils.error import BadRequest, GarageError, NoSuchBucket
 
 log = logging.getLogger("garage_tpu.admin")
 
@@ -126,14 +126,14 @@ class AdminRpcHandler:
     async def op_bucket_delete(self, p):
         bid = await self.helper.resolve_global_bucket_name(p["name"])
         if bid is None:
-            raise BadRequest(f"no bucket {p['name']!r}")
+            raise NoSuchBucket(p["name"])
         await self.helper.delete_bucket(bid)
         return {"ok": True}
 
     async def op_bucket_info(self, p):
         bid = await self.helper.resolve_global_bucket_name(p["name"])
         if bid is None:
-            raise BadRequest(f"no bucket {p['name']!r}")
+            raise NoSuchBucket(p["name"])
         b = await self.helper.get_existing_bucket(bid)
         counters = await self.garage.object_counter.read(
             bid, b"", list(self.garage.system.layout_manager.history
@@ -151,7 +151,7 @@ class AdminRpcHandler:
     async def op_bucket_allow(self, p):
         bid = await self.helper.resolve_global_bucket_name(p["bucket"])
         if bid is None:
-            raise BadRequest(f"no bucket {p['bucket']!r}")
+            raise NoSuchBucket(p["bucket"])
         key = await self.helper.get_existing_key(p["key"])
         from ..model.permission import BucketKeyPerm
         from ..utils.crdt import now_msec
@@ -169,7 +169,7 @@ class AdminRpcHandler:
     async def op_bucket_deny(self, p):
         bid = await self.helper.resolve_global_bucket_name(p["bucket"])
         if bid is None:
-            raise BadRequest(f"no bucket {p['bucket']!r}")
+            raise NoSuchBucket(p["bucket"])
         key = await self.helper.get_existing_key(p["key"])
         from ..model.permission import BucketKeyPerm
         from ..utils.crdt import now_msec
@@ -203,6 +203,7 @@ class AdminRpcHandler:
         return {
             "id": k.key_id,
             "name": k.params.name.value,
+            "create_bucket": k.params.allow_create_bucket.value,
             "secret_key": k.params.secret_key if p.get("show_secret") else None,
             "buckets": {bid.hex(): {"read": perm.allow_read,
                                     "write": perm.allow_write,

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import AsyncIterator, Callable, Optional
 from urllib.parse import unquote_plus
 
@@ -301,6 +302,9 @@ class HttpServer:
                     await writer.drain()
                 keep = req.header("connection", "").lower() != "close"
                 self.metrics["requests"] += 1
+                from ..utils.metrics import registry
+
+                t0 = time.perf_counter()
                 try:
                     resp = await self.handler(req)
                 except HttpError as e:
@@ -311,6 +315,11 @@ class HttpServer:
                     self.metrics["errors"] += 1
                     resp = Response(500, [("content-type", "text/plain")],
                                     b"internal error")
+                registry().observe(
+                    "api_request_duration_seconds",
+                    time.perf_counter() - t0,
+                    api=self.name, method=req.method,
+                    status=resp.status // 100 * 100)
                 try:
                     await req.body.drain()  # finish consuming the body
                 except Exception:

@@ -48,12 +48,14 @@ async def handle_insert_batch(ctx, req: Request) -> Response:
 def _parse_query(qjson: dict) -> dict:
     if not isinstance(qjson, dict) or "partitionKey" not in qjson:
         raise S3Error("InvalidRequest", 400, "query needs partitionKey")
+    raw_limit = qjson.get("limit")
     return {
         "partition_key": qjson["partitionKey"],
         "prefix": qjson.get("prefix"),
         "start": qjson.get("start"),
         "end": qjson.get("end"),
-        "limit": min(int(qjson.get("limit") or MAX_LIMIT), MAX_LIMIT),
+        "limit": (min(int(raw_limit), MAX_LIMIT)
+                  if raw_limit is not None else MAX_LIMIT),
         "reverse": bool(qjson.get("reverse", False)),
         "single_item": bool(qjson.get("singleItem", False)),
         "conflicts_only": bool(qjson.get("conflictsOnly", False)),
@@ -149,13 +151,22 @@ async def handle_delete_batch(ctx, req: Request) -> Response:
                     item.causal_context(), None)
                 deleted = 1
         else:
-            items = await _range_items(ctx, q, q["limit"])
-            batch = [(q["partition_key"], i.sort_key_str,
-                      i.causal_context(), None)
-                     for i in items if not i.is_tombstone()]
-            if batch:
-                await ctx.garage.k2v_rpc.insert_batch(ctx.bucket_id, batch)
-            deleted = len(batch)
+            # drain the whole range in pages — a silent cap would
+            # report success while leaving items behind
+            deleted = 0
+            page = dict(q)
+            while True:
+                items = await _range_items(ctx, page, MAX_LIMIT)
+                batch = [(q["partition_key"], i.sort_key_str,
+                          i.causal_context(), None)
+                         for i in items if not i.is_tombstone()]
+                if batch:
+                    await ctx.garage.k2v_rpc.insert_batch(ctx.bucket_id,
+                                                          batch)
+                deleted += len(batch)
+                if len(items) < MAX_LIMIT:
+                    break
+                page["start"] = items[-1].sort_key_str + "\x00"
         results.append({
             "partitionKey": q["partition_key"], "prefix": q["prefix"],
             "start": q["start"], "end": q["end"],

@@ -46,7 +46,7 @@ async def run_server(cfg_path: str) -> None:
     if cfg.admin_api_bind_addr:
         from ..admin.http import AdminHttpServer
 
-        ad = AdminHttpServer(garage)
+        ad = AdminHttpServer(garage, admin_rpc=admin)
         host, port = parse_addr(cfg.admin_api_bind_addr)
         await ad.start(host, port)
         servers.append(ad)

@@ -136,6 +136,85 @@ class GarageHelper:
         async with self.g.bucket_lock:
             await self._set_perm_unlocked(bucket_id, key_id, perm)
 
+    async def global_alias_bucket(self, bucket_id: bytes,
+                                  alias: str) -> None:
+        """Point a global alias at a bucket (ref: helper/bucket.rs
+        set_global_bucket_alias)."""
+        if not is_valid_bucket_name(alias):
+            raise BadRequest(f"invalid alias name {alias!r}")
+        async with self.g.bucket_lock:
+            existing = await self.resolve_global_bucket_name(alias)
+            if existing is not None and existing != bucket_id:
+                raise BadRequest(f"alias {alias!r} already in use")
+            bucket = await self.get_existing_bucket(bucket_id)
+            params = bucket.params
+            params.aliases = params.aliases.insert(alias, True)
+            await self.g.bucket_table.insert(bucket.with_params(params))
+            await self.g.bucket_alias_table.insert(
+                BucketAlias(alias, Lww.new(bucket_id)))
+
+    async def global_unalias_bucket(self, bucket_id: bytes,
+                                    alias: str) -> None:
+        async with self.g.bucket_lock:
+            cur = await self.resolve_global_bucket_name(alias)
+            if cur != bucket_id:
+                raise BadRequest(
+                    f"alias {alias!r} does not point to this bucket")
+            bucket = await self.get_existing_bucket(bucket_id)
+            params = bucket.params
+            live = [a for a, v in params.aliases.items() if v and a != alias]
+            has_local = any(v for _, v in params.local_aliases.items())
+            if not live and not has_local:
+                raise BadRequest(
+                    "cannot remove the bucket's last alias")
+            params.aliases = params.aliases.insert(alias, False)
+            await self.g.bucket_table.insert(bucket.with_params(params))
+            await self.g.bucket_alias_table.insert(
+                BucketAlias(alias, Lww.new(None)))
+
+    async def local_alias_bucket(self, bucket_id: bytes, key_id: str,
+                                 alias: str) -> None:
+        """Key-local bucket alias (ref: helper/bucket.rs
+        set_local_bucket_alias)."""
+        if not is_valid_bucket_name(alias):
+            raise BadRequest(f"invalid alias name {alias!r}")
+        async with self.g.bucket_lock:
+            bucket = await self.get_existing_bucket(bucket_id)
+            key = await self.get_existing_key(key_id)
+            kp = key.params
+            cur = kp.local_aliases.get(alias)
+            if cur is not None and cur != bucket_id:
+                raise BadRequest(f"local alias {alias!r} already in use")
+            kp.local_aliases = kp.local_aliases.insert(alias, bucket_id)
+            await self.g.key_table.insert(
+                Key(key_id, Deletable.present(kp)))
+            params = bucket.params
+            params.local_aliases = params.local_aliases.insert(
+                (key_id, alias), True)
+            await self.g.bucket_table.insert(bucket.with_params(params))
+
+    async def local_unalias_bucket(self, bucket_id: bytes, key_id: str,
+                                   alias: str) -> None:
+        async with self.g.bucket_lock:
+            bucket = await self.get_existing_bucket(bucket_id)
+            key = await self.get_existing_key(key_id)
+            kp = key.params
+            if kp.local_aliases.get(alias) != bucket_id:
+                raise BadRequest(
+                    f"local alias {alias!r} does not point to this bucket")
+            params = bucket.params
+            live = [a for a, v in params.aliases.items() if v]
+            others = [k for k, v in params.local_aliases.items()
+                      if v and k != (key_id, alias)]
+            if not live and not others:
+                raise BadRequest("cannot remove the bucket's last alias")
+            kp.local_aliases = kp.local_aliases.insert(alias, None)
+            await self.g.key_table.insert(
+                Key(key_id, Deletable.present(kp)))
+            params.local_aliases = params.local_aliases.insert(
+                (key_id, alias), False)
+            await self.g.bucket_table.insert(bucket.with_params(params))
+
     async def update_bucket_config(self, bucket_id: bytes, field: str,
                                    value) -> None:
         """Read-modify-write one Lww config register (website_config /

@@ -200,12 +200,17 @@ class K2VRpcHandler:
                 await self.item_table.insert(item)
             return {"ok": True}
         if op == "insert_many":
-            updated = []
-            for pk, sk, ct, value in payload["items"]:
-                item = self._local_insert(payload["bucket"], pk, sk, ct,
-                                          value)
-                if item is not None:
-                    updated.append(item)
+            def apply_all():
+                out = []
+                for pk, sk, ct, value in payload["items"]:
+                    item = self._local_insert(payload["bucket"], pk, sk,
+                                              ct, value)
+                    if item is not None:
+                        out.append(item)
+                return out
+
+            # bulk transactions off the event loop (db.py convention)
+            updated = await asyncio.to_thread(apply_all)
             for item in updated:
                 await self.item_table.insert(item)
             return {"ok": True}

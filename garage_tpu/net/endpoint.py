@@ -52,6 +52,15 @@ class Endpoint:
     ):
         """Call this endpoint on `node` (loopback if node is ourself).
         Returns (payload, reply_stream|None)."""
-        return await self.netapp.call(
-            node, self.path, payload, prio, stream=stream, timeout=timeout, order=order
-        )
+        from ..utils.metrics import registry
+
+        with registry().timer("rpc_request_duration_seconds",
+                              endpoint=self.path):
+            try:
+                return await self.netapp.call(
+                    node, self.path, payload, prio, stream=stream,
+                    timeout=timeout, order=order
+                )
+            except Exception:
+                registry().inc("rpc_request_errors", endpoint=self.path)
+                raise

@@ -60,6 +60,9 @@ class Table:
 
     async def insert(self, entry: Entry) -> None:
         """ref: table/table.rs:106-144."""
+        from ..utils.metrics import registry
+
+        registry().inc("table_put_total", table=self.name)
         raw = self.schema.encode_entry(entry)
         ph = partition_hash(entry.partition_key())
         with self.replication.write_lock():
@@ -108,6 +111,9 @@ class Table:
     async def get(self, pk: bytes, sk: bytes) -> Optional[Entry]:
         """Read-quorum get with CRDT merge + background read-repair.
         ref: table.rs:287-361."""
+        from ..utils.metrics import registry
+
+        registry().inc("table_get_total", table=self.name)
         ph = partition_hash(pk)
         nodes = self.replication.read_nodes(ph)
         resps = await self.rpc.try_call_many(

@@ -1,0 +1,93 @@
+"""Lightweight in-process metrics: labeled counters + duration stats.
+
+Ref parity: src/util/metrics.rs + the per-subsystem metric modules
+(rpc/metrics.rs, table/metrics.rs, block/metrics.rs,
+api/common/generic_server.rs). The reference uses OpenTelemetry; this
+build keeps a dependency-free registry that the admin /metrics endpoint
+renders in Prometheus text format. Durations aggregate as
+count / sum / max so rates and averages are derivable.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterator, Optional
+
+
+class _Series:
+    __slots__ = ("count", "total", "max")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (name, labels-tuple) -> _Series
+        self._series: dict[tuple, _Series] = {}
+
+    def _get(self, name: str, labels: tuple) -> _Series:
+        key = (name, labels)
+        s = self._series.get(key)
+        if s is None:
+            with self._lock:
+                s = self._series.setdefault(key, _Series())
+        return s
+
+    def inc(self, name: str, value: float = 1, **labels) -> None:
+        s = self._get(name, tuple(sorted(labels.items())))
+        s.count += 1
+        s.total += value
+        s.max = max(s.max, value)
+
+    def observe(self, name: str, seconds: float, **labels) -> None:
+        self.inc(name, seconds, **labels)
+
+    def timer(self, name: str, **labels) -> "_Timer":
+        return _Timer(self, name, labels)
+
+    def render(self) -> Iterator[str]:
+        """Prometheus text lines: <name>_count, <name>_sum, <name>_max."""
+        seen_help = set()
+        for (name, labels), s in sorted(self._series.items()):
+            if name not in seen_help:
+                seen_help.add(name)
+                yield f"# TYPE {name}_count counter"
+            lab = ",".join(f'{k}="{v}"' for k, v in labels)
+            suffix = f"{{{lab}}}" if lab else ""
+            yield f"{name}_count{suffix} {s.count}"
+            yield f"{name}_sum{suffix} {s.total:.6f}"
+            yield f"{name}_max{suffix} {s.max:.6f}"
+
+
+class _Timer:
+    __slots__ = ("reg", "name", "labels", "t0")
+
+    def __init__(self, reg: MetricsRegistry, name: str, labels: dict):
+        self.reg = reg
+        self.name = name
+        self.labels = labels
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.reg.observe(self.name, time.perf_counter() - self.t0,
+                         **self.labels)
+        return False
+
+
+_global: Optional[MetricsRegistry] = None
+
+
+def registry() -> MetricsRegistry:
+    """Process-wide registry (one server process = one node)."""
+    global _global
+    if _global is None:
+        _global = MetricsRegistry()
+    return _global

@@ -926,3 +926,134 @@ def test_k2v_read_batch_pagination_no_duplicates(k2v):
                             "start": res2[0]["nextStart"]}])
     assert [i["sk"] for i in res3[0]["items"]] == ["k06"]
     assert res3[0]["more"] is False
+
+
+# ---- admin REST API (ref: api/admin/api_server.rs + router_v1.rs) -------
+
+
+def _admin(server, method, path, body=None, token="test-admin-token"):
+    import http.client
+    import json as _json
+
+    conn = http.client.HTTPConnection("127.0.0.1", server.admin_port,
+                                      timeout=30)
+    try:
+        headers = {}
+        if token:
+            headers["authorization"] = f"Bearer {token}"
+        payload = _json.dumps(body).encode() if body is not None else b""
+        conn.request(method, path, body=payload, headers=headers)
+        r = conn.getresponse()
+        raw = r.read()
+        try:
+            return r.status, _json.loads(raw.decode())
+        except ValueError:
+            return r.status, raw
+    finally:
+        conn.close()
+
+
+def test_admin_requires_token(server):
+    st, _ = _admin(server, "GET", "/v1/status", token=None)
+    assert st == 403
+    st, _ = _admin(server, "GET", "/v1/status", token="wrong")
+    assert st == 403
+
+
+def test_admin_status_and_health(server):
+    st, body = _admin(server, "GET", "/v1/status")
+    assert st == 200
+    assert body["clusterHealth"]["status"] == "healthy"
+    assert len(body["nodes"]) == 1
+    assert body["nodes"][0]["role"]["zone"] == "dc1"
+    st, h = _admin(server, "GET", "/v1/health")
+    assert st == 200 and h["status"] == "healthy"
+    assert h["partitionsQuorum"] == 256
+
+
+def test_admin_layout_get(server):
+    st, body = _admin(server, "GET", "/v1/layout")
+    assert st == 200
+    assert body["version"] == 1
+    assert len(body["roles"]) == 1
+
+
+def test_admin_key_lifecycle(server):
+    st, k = _admin(server, "POST", "/v1/key", body={"name": "rest-key"})
+    assert st == 200 and k["accessKeyId"].startswith("GK")
+    kid = k["accessKeyId"]
+    st, info = _admin(server, "GET",
+                      f"/v1/key?id={kid}&showSecretKey=true")
+    assert st == 200
+    assert info["secretAccessKey"] == k["secretAccessKey"]
+    assert info["permissions"]["createBucket"] is False
+    st, info = _admin(server, "POST", f"/v1/key?id={kid}",
+                      body={"allow": {"createBucket": True}})
+    assert st == 200 and info["permissions"]["createBucket"] is True
+    st, keys = _admin(server, "GET", "/v1/key")
+    assert st == 200 and any(x["id"] == kid for x in keys)
+    st, _ = _admin(server, "DELETE", f"/v1/key?id={kid}")
+    assert st == 204
+    st, _ = _admin(server, "GET", f"/v1/key?id={kid}")
+    assert st == 404
+
+
+def test_admin_bucket_lifecycle_and_aliases(server):
+    st, b = _admin(server, "POST", "/v1/bucket",
+                   body={"globalAlias": "rest-bucket"})
+    assert st == 200
+    bid = b["id"]
+    st, info = _admin(server, "GET", f"/v1/bucket?id={bid}")
+    assert st == 200 and "rest-bucket" in info["globalAliases"]
+    # permission grant via REST
+    st, k = _admin(server, "POST", "/v1/key", body={"name": "bkey"})
+    st, _ = _admin(server, "POST", "/v1/bucket/allow", body={
+        "bucketId": bid, "accessKeyId": k["accessKeyId"],
+        "permissions": {"read": True, "write": True},
+    })
+    assert st == 200
+    st, info = _admin(server, "GET", f"/v1/bucket?id={bid}")
+    assert k["accessKeyId"] in info["keys"]
+    # global alias add + remove
+    st, _ = _admin(server, "PUT",
+                   f"/v1/bucket/alias/global?id={bid}&alias=rest-alias")
+    assert st == 200
+    st, info = _admin(server, "GET", "/v1/bucket?globalAlias=rest-alias")
+    assert st == 200 and info["id"] == bid
+    st, _ = _admin(server, "DELETE",
+                   f"/v1/bucket/alias/global?id={bid}&alias=rest-alias")
+    assert st == 200
+    # deleting the LAST alias must fail
+    st, err = _admin(server, "DELETE",
+                     f"/v1/bucket/alias/global?id={bid}&alias=rest-bucket")
+    assert st == 400
+    # empty bucket deletes
+    st, _ = _admin(server, "DELETE", f"/v1/bucket?id={bid}")
+    assert st == 204
+
+
+def test_admin_check_domain(server, client, website_bucket):
+    st, body = _admin(server, "GET", "/check?domain=wsite.web.garage.test")
+    assert st == 200
+    st, _ = _admin(server, "GET", "/check?domain=nosuch.web.garage.test")
+    assert st == 400
+
+
+def test_metrics_exposition(server, client):
+    import http.client
+
+    client.request("PUT", "/conformance/metricsobj", body=b"m" * 100)
+    conn = http.client.HTTPConnection("127.0.0.1", server.admin_port,
+                                      timeout=30)
+    try:
+        conn.request("GET", "/metrics")
+        r = conn.getresponse()
+        text = r.read().decode()
+        assert r.status == 200
+    finally:
+        conn.close()
+    assert "cluster_healthy 1" in text
+    assert "api_request_duration_seconds_count" in text
+    assert "table_put_total_count" in text
+    assert "rpc_request_duration_seconds_count" in text
+    assert "feeder_batches" in text
