@@ -59,7 +59,13 @@ class AdminHttpServer:
                             self.render_metrics().encode())
         if path == "/check" and req.method == "GET":
             return await self._check_domain(req)
-        if not self._authorized(req, self.garage.config.admin_token):
+        # management endpoints: an UNSET admin token means access is
+        # always denied (the reference's admin_token semantics) —
+        # /metrics above differs deliberately (open when no
+        # metrics_token is configured, for scrapers)
+        if self.garage.config.admin_token is None \
+                or not self._authorized(req,
+                                        self.garage.config.admin_token):
             return Response(403, [], b"forbidden")
         try:
             resp = await self._route_v1(req)

@@ -318,7 +318,12 @@ class HttpServer:
                 registry().observe(
                     "api_request_duration_seconds",
                     time.perf_counter() - t0,
-                    api=self.name, method=req.method,
+                    api=self.name,
+                    # label cardinality is bounded: arbitrary client
+                    # method strings must not grow the registry forever
+                    method=(req.method if req.method in (
+                        "GET", "HEAD", "PUT", "POST", "DELETE",
+                        "OPTIONS") else "OTHER"),
                     status=resp.status // 100 * 100)
                 try:
                     await req.body.drain()  # finish consuming the body

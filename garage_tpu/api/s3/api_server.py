@@ -105,6 +105,16 @@ class S3ApiServer:
                     self.helper, api_key)
             raise S3Error("InvalidRequest", 400, "no bucket specified")
 
+        # browser form upload: authentication lives in the signed POST
+        # policy inside the form, not in headers (ref: post_object.rs)
+        if req.method == "POST" and key is None and (
+                req.header("content-type") or ""
+        ).startswith("multipart/form-data"):
+            from . import post_object as post_object_handlers
+
+            return await post_object_handlers.handle_post_object(
+                self, req, bucket_name)
+
         # CreateBucket resolves no existing bucket
         if req.method == "PUT" and key is None and not req.query:
             if api_key is None:
@@ -214,6 +224,9 @@ class S3ApiServer:
             return await get_handlers.handle_get(ctx, req, head=(m == "HEAD"))
         if m == "PUT":
             if "partNumber" in q and "uploadId" in q:
+                if "x-amz-copy-source" in req.headers:
+                    return await multipart_handlers.handle_upload_part_copy(
+                        ctx, req)
                 return await multipart_handlers.handle_put_part(ctx, req)
             if "x-amz-copy-source" in req.headers:
                 return await put_handlers.handle_copy(ctx, req)

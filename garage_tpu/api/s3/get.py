@@ -28,9 +28,14 @@ def _object_headers(version, meta) -> list[tuple[str, str]]:
            ("accept-ranges", "bytes"),
            ("x-amz-version-id", version.uuid.hex())]
     for name, v in sorted(meta.headers.items()):
+        if name.startswith("x-garage-ssec-"):
+            continue  # internal SSE-C markers; surfaced as x-amz-* below
         out.append((name, v))
     if "content-type" not in meta.headers:
         out.append(("content-type", "application/octet-stream"))
+    from .encryption import sse_response_headers
+
+    out.extend(sse_response_headers(meta))
     return out
 
 
@@ -56,12 +61,15 @@ def parse_range(spec: str, size: int) -> Optional[tuple[int, int]]:
 
 
 async def handle_get(ctx, req: Request, head: bool = False) -> Response:
+    from .encryption import check_key_for_meta, request_sse_key
+
     obj = await ctx.garage.object_table.get(ctx.bucket_id,
                                             ctx.key.encode())
     v = obj.last_data() if obj is not None else None
     if v is None:
         raise no_such_key(ctx.key)
     meta = v.state.data.meta
+    sse_key = check_key_for_meta(meta, request_sse_key(req))
 
     # conditionals (ref: get.rs try_answer_cached)
     im = req.header("if-match")
@@ -107,7 +115,8 @@ async def handle_get(ctx, req: Request, head: bool = False) -> Response:
 
     data = v.state.data
     if data.kind == "inline":
-        payload = data.blob
+        payload = (sse_key.decrypt_block(data.blob)
+                   if sse_key is not None else data.blob)
         if rng is not None:
             start, end = rng
             headers.append(("content-range",
@@ -138,18 +147,48 @@ async def handle_get(ctx, req: Request, head: bool = False) -> Response:
 
     if rng is None:
         return Response(200, headers + [("content-length", str(size))],
-                        _stream_blocks(ctx.garage, blocks, 0, size))
+                        _stream_blocks(ctx.garage, blocks, 0, size,
+                                       sse_key))
     start, end = rng
     headers.append(("content-range", f"bytes {start}-{end - 1}/{size}"))
     headers.append(("content-length", str(end - start)))
     return Response(206, headers, _stream_blocks(ctx.garage, blocks,
-                                                 start, end))
+                                                 start, end, sse_key))
 
 
-async def _stream_blocks(garage, blocks, start: int,
-                         end: int) -> AsyncIterator[bytes]:
+async def open_object_stream(garage, src_v, start: int, end: int,
+                             src_sse=None):
+    """Plaintext byte-stream reader over [start, end) of an object
+    version (inline or block-backed), decrypting with `src_sse` when
+    given. Shared by CopyObject and UploadPartCopy (ref: copy.rs
+    source-stream plumbing)."""
+    from .multipart import _StreamReader
+    from .xml import S3Error
+
+    if src_v.state.data.kind == "inline":
+        blob = src_v.state.data.blob
+        if src_sse is not None:
+            blob = src_sse.decrypt_block(blob)
+        piece = blob[start:end]
+
+        async def gen_inline():
+            yield piece
+
+        return _StreamReader(gen_inline())
+    src_version = await garage.version_table.get(src_v.uuid, b"")
+    if src_version is None:
+        raise S3Error("NoSuchKey", 404, "source version vanished")
+    blocks = list(src_version.blocks.items())
+    return _StreamReader(_stream_blocks(garage, blocks, start, end,
+                                        src_sse))
+
+
+async def _stream_blocks(garage, blocks, start: int, end: int,
+                         sse_key=None) -> AsyncIterator[bytes]:
     """Stream [start, end) of the concatenated block list
-    (ref: get.rs body_from_blocks_range)."""
+    (ref: get.rs body_from_blocks_range). Block sizes in the version
+    map are plaintext sizes; with `sse_key` each fetched block is
+    decrypted before slicing, so ranges address plaintext offsets."""
     pos = 0
     for _key, (h, size) in blocks:
         if pos + size <= start:
@@ -158,6 +197,8 @@ async def _stream_blocks(garage, blocks, start: int,
         if pos >= end:
             break
         data = await garage.block_manager.rpc_get_block(h)
+        if sse_key is not None:
+            data = sse_key.decrypt_block(data)
         lo = max(0, start - pos)
         hi = min(size, end - pos)
         yield data[lo:hi]

@@ -25,6 +25,16 @@ from .put import Chunker, extract_metadata_headers, read_and_put_blocks
 from .xml import S3Error, xml, xml_response
 
 
+class _UploadMeta:
+    """Adapter exposing an uploading version's headers dict with the
+    `.headers` attribute check_key_for_meta expects."""
+
+    __slots__ = ("headers",)
+
+    def __init__(self, headers: dict):
+        self.headers = headers
+
+
 async def _get_upload(ctx, upload_id_hex: str):
     """-> (mpu, object_version) or raises NoSuchUpload
     (ref: multipart.rs get_upload)."""
@@ -46,8 +56,14 @@ async def _get_upload(ctx, upload_id_hex: str):
 
 async def handle_create_multipart(ctx, req: Request) -> Response:
     """ref: multipart.rs handle_create_multipart_upload."""
+    from .encryption import META_SSEC_ALGO, META_SSEC_MD5, request_sse_key
+
     await req.body.drain()
     headers = extract_metadata_headers(req)
+    sse_key = request_sse_key(req)
+    if sse_key is not None:
+        headers = {**headers, META_SSEC_ALGO: "AES256",
+                   META_SSEC_MD5: sse_key.md5_b64}
     uuid = gen_uuid()
     ts = now_msec()
     obj = Object(ctx.bucket_id, ctx.key, [ObjectVersion(
@@ -70,11 +86,12 @@ async def handle_put_part(ctx, req: Request) -> Response:
             raise ValueError
     except (KeyError, ValueError):
         raise S3Error("InvalidArgument", 400, "bad partNumber")
-    mpu, _ov = await _get_upload(ctx, q.get("uploadId", ""))
+    mpu, ov = await _get_upload(ctx, q.get("uploadId", ""))
 
     # validate headers BEFORE inserting any rows — a 400 here must not
     # leak an uploading version/part placeholder
     from ..checksum import Checksummer, request_checksum_value
+    from .encryption import check_key_for_meta, request_sse_key
 
     try:
         expected_checksum = request_checksum_value(req.headers)
@@ -82,6 +99,9 @@ async def handle_put_part(ctx, req: Request) -> Response:
         raise S3Error("InvalidRequest", 400, str(e))
     checksummer = (Checksummer(expected_checksum[0])
                    if expected_checksum is not None else None)
+    # SSE-C: the part's key must match the key declared at create time
+    sse_key = check_key_for_meta(
+        _UploadMeta(ov.state.headers or {}), request_sse_key(req))
 
     ts = mpu.next_timestamp(part_number)
     version_uuid = gen_uuid()
@@ -100,7 +120,7 @@ async def handle_put_part(ctx, req: Request) -> Response:
     try:
         total, etag, _first_hash = await read_and_put_blocks(
             ctx.garage, version, part_number, first, chunker, md5,
-            checksummer=checksummer)
+            checksummer=checksummer, sse_key=sse_key)
         if checksummer is not None \
                 and checksummer.b64() != expected_checksum[1]:
             raise S3Error("BadDigest", 400, "checksum mismatch")
@@ -122,6 +142,126 @@ async def handle_put_part(ctx, req: Request) -> Response:
                                 MpuPart(version_uuid, etag, total))
     await ctx.garage.mpu_table.insert(done)
     return Response(200, [("etag", f'"{etag}"')])
+
+
+class _StreamReader:
+    """Adapts an async byte-chunk generator to the body-reader interface
+    Chunker expects (read(n) returning b'' at EOF)."""
+
+    def __init__(self, gen):
+        self._gen = gen
+        self._buf = bytearray()
+        self._eof = False
+
+    async def read(self, n: int = 65536) -> bytes:
+        while not self._eof and len(self._buf) < n:
+            try:
+                self._buf.extend(await self._gen.__anext__())
+            except StopAsyncIteration:
+                self._eof = True
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+
+async def handle_upload_part_copy(ctx, req: Request) -> Response:
+    """UploadPartCopy: fill a part from (a range of) an existing object
+    (ref: api/s3/copy.rs:340-520 handle_upload_part_copy). The source
+    streams through the normal put pipeline — re-chunked and, when the
+    upload is SSE-C, re-encrypted under the destination key — so any
+    source range and any encryption combination is correct; aligned
+    whole-block reuse is left to CopyObject."""
+    from urllib.parse import unquote
+
+    from ...model.helper import GarageHelper
+    from .encryption import (check_key_for_meta, copy_source_sse_key,
+                             request_sse_key)
+    from .get import _stream_blocks, parse_range
+
+    q = req.query
+    try:
+        part_number = int(q["partNumber"])
+        if not (1 <= part_number <= 10000):
+            raise ValueError
+    except (KeyError, ValueError):
+        raise S3Error("InvalidArgument", 400, "bad partNumber")
+    mpu, ov = await _get_upload(ctx, q.get("uploadId", ""))
+    dst_sse = check_key_for_meta(_UploadMeta(ov.state.headers or {}),
+                                 request_sse_key(req))
+
+    src = unquote(req.header("x-amz-copy-source") or "").lstrip("/")
+    src_bucket_name, _, src_key = src.partition("/")
+    if not src_bucket_name or not src_key:
+        raise S3Error("InvalidRequest", 400,
+                      "malformed x-amz-copy-source")
+    helper = GarageHelper(ctx.garage)
+    src_bucket_id = await helper.resolve_global_bucket_name(src_bucket_name)
+    if src_bucket_id is None:
+        raise S3Error("NoSuchBucket", 404, src_bucket_name)
+    if not ctx.api_key.allow_read(src_bucket_id):
+        raise S3Error("AccessDenied", 403, "no read access to source")
+    src_obj = await ctx.garage.object_table.get(src_bucket_id,
+                                                src_key.encode())
+    src_v = src_obj.last_data() if src_obj is not None else None
+    if src_v is None:
+        raise S3Error("NoSuchKey", 404, src_key)
+    src_meta = src_v.state.data.meta
+    src_sse = check_key_for_meta(src_meta, copy_source_sse_key(req))
+
+    size = src_meta.size
+    start, end = 0, size
+    range_hdr = req.header("x-amz-copy-source-range")
+    if range_hdr:
+        rng = parse_range(range_hdr, size)
+        if rng is None:
+            raise S3Error("InvalidRange", 416, "bad copy source range")
+        start, end = rng
+    # validate BEFORE inserting any rows — emptiness is knowable now
+    if end - start == 0:
+        raise S3Error("InvalidRequest", 400, "empty copy source range")
+    from .get import open_object_stream
+
+    source = await open_object_stream(ctx.garage, src_v, start, end,
+                                      src_sse)
+
+    await req.body.drain()
+    ts = mpu.next_timestamp(part_number)
+    version_uuid = gen_uuid()
+    mpu2 = MultipartUpload.new(mpu.upload_id, mpu.timestamp,
+                               ctx.bucket_id, ctx.key)
+    mpu2.parts = mpu2.parts.put((part_number, ts), MpuPart(version_uuid))
+    await ctx.garage.mpu_table.insert(mpu2)
+    version = Version.new(version_uuid, (BACKLINK_MPU, mpu.upload_id))
+    await ctx.garage.version_table.insert(version)
+
+    md5 = hashlib.md5()
+    try:
+        chunker = Chunker(source, ctx.garage.config.block_size)
+        first = await chunker.next()
+        if first is None:
+            raise S3Error("InvalidRequest", 400, "empty copy source")
+        total, etag, _ = await read_and_put_blocks(
+            ctx.garage, version, part_number, first, chunker, md5,
+            sse_key=dst_sse)
+    except BaseException:
+        try:
+            await ctx.garage.version_table.insert(Version.new(
+                version_uuid, (BACKLINK_MPU, mpu.upload_id),
+                deleted=True))
+        except Exception:
+            pass
+        raise
+
+    done = MultipartUpload.new(mpu.upload_id, mpu.timestamp,
+                               ctx.bucket_id, ctx.key)
+    done.parts = done.parts.put((part_number, ts),
+                                MpuPart(version_uuid, etag, total))
+    await ctx.garage.mpu_table.insert(done)
+    from .put import _http_date
+
+    return xml_response(xml("CopyPartResult",
+                            xml("LastModified", _http_date(now_msec())),
+                            xml("ETag", f'"{etag}"')))
 
 
 async def handle_complete_multipart(ctx, req: Request) -> Response:

@@ -79,15 +79,23 @@ def next_timestamp(existing: Optional[Object]) -> int:
 
 async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
                       body, content_md5: Optional[str] = None,
-                      expected_checksum: Optional[tuple[str, str]] = None):
+                      expected_checksum: Optional[tuple[str, str]] = None,
+                      sse_key=None):
     """-> (version_uuid, version_timestamp, etag, total_size).
     ref: put.rs:122-330 save_stream. `expected_checksum` is a declared
-    (algo, base64-value) x-amz-checksum-* header to enforce."""
+    (algo, base64-value) x-amz-checksum-* header to enforce; `sse_key`
+    is an SSE-C customer key — blocks (and inline payloads) are stored
+    AES-GCM encrypted, metadata records only the key's MD5."""
     checksummer = None
     if expected_checksum is not None:
         from ..checksum import Checksummer
 
         checksummer = Checksummer(expected_checksum[0])
+    if sse_key is not None:
+        from .encryption import META_SSEC_ALGO, META_SSEC_MD5
+
+        headers = {**headers, META_SSEC_ALGO: "AES256",
+                   META_SSEC_MD5: sse_key.md5_b64}
     block_size = garage.config.block_size
     chunker = Chunker(body, block_size)
     first_block, existing = await asyncio.gather(
@@ -108,8 +116,10 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
             if checksummer.b64() != expected_checksum[1]:
                 raise bad_request("checksum mismatch")
         meta = ObjectVersionMeta(headers, len(first_block), etag)
+        blob = (sse_key.encrypt_block(first_block) if sse_key is not None
+                else first_block)
         ov = ObjectVersion(uuid, ts, ObjectVersionState.complete(
-            ObjectVersionData.inline(meta, first_block)))
+            ObjectVersionData.inline(meta, blob)))
         await garage.object_table.insert(Object(bucket_id, key, [ov]))
         return uuid, ts, etag, len(first_block)
 
@@ -123,7 +133,7 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
     try:
         total, etag, first_hash = await read_and_put_blocks(
             garage, version, 1, first_block, chunker, md5,
-            checksummer=checksummer)
+            checksummer=checksummer, sse_key=sse_key)
         if content_md5 is not None and not _md5_matches(content_md5, etag):
             raise bad_request("Content-MD5 mismatch")
         if checksummer is not None \
@@ -157,24 +167,29 @@ def _md5_matches(content_md5_b64: str, etag_hex: str) -> bool:
 
 async def read_and_put_blocks(garage, version: Version, part_number: int,
                               first_block: bytes, chunker: Chunker, md5,
-                              checksummer=None):
+                              checksummer=None, sse_key=None):
     """The staged put pipeline (ref: put.rs:378-530): ≤3 concurrent
     block writes; version + block_ref rows inserted alongside each
-    block."""
+    block. With `sse_key`, blocks are AES-GCM encrypted before hashing
+    and storage (the content address covers the ciphertext, so scrub
+    verifies without the key); the version's block map keeps PLAINTEXT
+    sizes so range reads address plaintext offsets."""
     sem = asyncio.Semaphore(PUT_BLOCKS_MAX_PARALLEL)
     tasks: list[asyncio.Task] = []
     offset = 0
     first_hash = None
     block = first_block
 
-    async def put_one(blk: bytes, off: int, h: bytes):
+    async def put_one(blk: bytes, off: int, plain_len: int, h: bytes):
         async with sem:
             v = Version(version.uuid, version.deleted,
                         version.blocks.put((part_number, off),
-                                           (h, len(blk))),
+                                           (h, plain_len)),
                         version.backlink)
             await asyncio.gather(
-                garage.block_manager.rpc_put_block(h, blk),
+                garage.block_manager.rpc_put_block(
+                    h, blk, compress=False if sse_key is not None
+                    else None),
                 garage.version_table.insert(v),
                 garage.block_ref_table.insert(BlockRef.new(h, version.uuid)),
             )
@@ -185,11 +200,15 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
             if checksummer is not None:
                 # pure-python CRCs are slow; keep them off the event loop
                 await asyncio.to_thread(checksummer.update, block)
-            h = await garage.block_manager.hash_block(block)
+            plain_len = len(block)
+            stored = (await asyncio.to_thread(sse_key.encrypt_block, block)
+                      if sse_key is not None else block)
+            h = await garage.block_manager.hash_block(stored)
             if first_hash is None:
                 first_hash = h
-            tasks.append(asyncio.create_task(put_one(block, offset, h)))
-            offset += len(block)
+            tasks.append(asyncio.create_task(
+                put_one(stored, offset, plain_len, h)))
+            offset += plain_len
             # backpressure: don't build an unbounded task list
             while len(tasks) > PUT_BLOCKS_MAX_PARALLEL:
                 done, _ = await asyncio.wait(
@@ -215,19 +234,28 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
 async def handle_put(ctx, req: Request) -> Response:
     """ref: put.rs:60-120 handle_put."""
     from ..checksum import request_checksum_value
+    from .encryption import request_sse_key
 
     headers = extract_metadata_headers(req)
     try:
         expected_checksum = request_checksum_value(req.headers)
     except ValueError as e:
         raise bad_request(str(e))
+    sse_key = request_sse_key(req)
     uuid, ts, etag, _ = await save_stream(
         ctx.garage, ctx.bucket_id, ctx.key, headers, req.body,
         content_md5=req.header("content-md5"),
         expected_checksum=expected_checksum,
+        sse_key=sse_key,
     )
+    extra = []
+    if sse_key is not None:
+        from .encryption import ALGO_HEADER, KEY_MD5_HEADER
+
+        extra = [(ALGO_HEADER, "AES256"),
+                 (KEY_MD5_HEADER, sse_key.md5_b64)]
     return Response(200, [("etag", f'"{etag}"'),
-                          ("x-amz-version-id", uuid.hex())])
+                          ("x-amz-version-id", uuid.hex())] + extra)
 
 
 async def handle_copy(ctx, req: Request) -> Response:
@@ -254,6 +282,32 @@ async def handle_copy(ctx, req: Request) -> Response:
     src_v = src_obj.last_data() if src_obj is not None else None
     if src_v is None:
         raise S3Error("NoSuchKey", 404, src_key)
+
+    from .encryption import (check_key_for_meta, copy_source_sse_key,
+                             request_sse_key)
+
+    src_sse_hdr = copy_source_sse_key(req)
+    dst_sse = request_sse_key(req)
+    if src_sse_hdr is not None or dst_sse is not None:
+        # encryption boundary crossing: stream the source plaintext
+        # through the normal save path, re-encrypting under the
+        # destination key (ref: copy.rs re-encryption path)
+        src_meta = src_v.state.data.meta
+        src_sse = check_key_for_meta(src_meta, src_sse_hdr)
+        from .get import open_object_stream
+
+        source = await open_object_stream(helper_g, src_v, 0,
+                                          src_meta.size, src_sse)
+        headers = {k: v for k, v in src_meta.headers.items()
+                   if not k.startswith("x-garage-ssec-")}
+        uuid, ts, etag, _ = await save_stream(
+            helper_g, ctx.bucket_id, ctx.key, headers, source,
+            sse_key=dst_sse)
+        from .xml import xml, xml_response
+
+        return xml_response(xml("CopyObjectResult",
+                                xml("LastModified", _http_date(ts)),
+                                xml("ETag", f'"{etag}"')))
 
     uuid = gen_uuid()
     ts = now_msec()

@@ -198,11 +198,14 @@ class BlockManager:
         callers through the device feeder (API PUT path entry point)."""
         return await self.feeder.hash(data)
 
-    async def rpc_put_block(self, hash32: bytes, data: bytes) -> None:
+    async def rpc_put_block(self, hash32: bytes, data: bytes,
+                            compress: Optional[bool] = None) -> None:
         await self._ram_sem.acquire(len(data))
         try:
+            do_compress = (self.compression if compress is None
+                           else compress)
             blk = (await asyncio.to_thread(DataBlock.compress, data)
-                   if self.compression else DataBlock.plain(data))
+                   if do_compress else DataBlock.plain(data))
             packed = blk.pack()
             if self.erasure:
                 await self._put_erasure(hash32, packed)

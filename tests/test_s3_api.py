@@ -1057,3 +1057,268 @@ def test_metrics_exposition(server, client):
     assert "table_put_total_count" in text
     assert "rpc_request_duration_seconds_count" in text
     assert "feeder_batches" in text
+
+
+# ---- SSE-C, UploadPartCopy, PostObject ----------------------------------
+
+SSE_KEY = b"0123456789abcdef0123456789abcdef"
+
+
+def _sse_headers(key=SSE_KEY, prefix=""):
+    import base64
+    import hashlib as _h
+
+    return {
+        f"x-amz-{prefix}server-side-encryption-customer-algorithm": "AES256",
+        f"x-amz-{prefix}server-side-encryption-customer-key":
+            base64.b64encode(key).decode(),
+        f"x-amz-{prefix}server-side-encryption-customer-key-md5":
+            base64.b64encode(_h.md5(key).digest()).decode(),
+    }
+
+
+def test_ssec_put_get_roundtrip(client, server):
+    data = os.urandom(200_000)
+    st, hdrs, _ = client.request("PUT", "/conformance/secret",
+                                 body=data, headers=_sse_headers())
+    assert st == 200
+    assert hdrs.get(
+        "x-amz-server-side-encryption-customer-algorithm") == "AES256"
+    # read with the key
+    st, hdrs, got = client.request("GET", "/conformance/secret",
+                                   headers=_sse_headers())
+    assert st == 200 and got == data
+    # range read addresses plaintext offsets
+    st, _, got = client.request("GET", "/conformance/secret",
+                                headers={**_sse_headers(),
+                                         "range": "bytes=1000-1999"})
+    assert st == 206 and got == data[1000:2000]
+    # read without the key -> 400
+    st, _, body = client.request("GET", "/conformance/secret")
+    assert st == 400
+    # read with the wrong key -> 403
+    st, _, _ = client.request("GET", "/conformance/secret",
+                              headers=_sse_headers(b"x" * 32))
+    assert st == 403
+    # on-disk blocks must NOT contain plaintext
+    found_plain = False
+    for root, _, files in os.walk(os.path.join(server.dir, "data")):
+        for fn in files:
+            with open(os.path.join(root, fn), "rb") as f:
+                if data[:64] in f.read():
+                    found_plain = True
+    assert not found_plain
+
+
+def test_ssec_inline_object(client):
+    st, _, _ = client.request("PUT", "/conformance/tinysecret",
+                              body=b"small secret", headers=_sse_headers())
+    assert st == 200
+    st, _, got = client.request("GET", "/conformance/tinysecret",
+                                headers=_sse_headers())
+    assert st == 200 and got == b"small secret"
+    st, _, _ = client.request("GET", "/conformance/tinysecret")
+    assert st == 400
+
+
+def test_upload_part_copy(client):
+    src = os.urandom(150_000)
+    assert client.request("PUT", "/conformance/upc-src", body=src)[0] == 200
+    st, _, body = client.request("POST", "/conformance/upc-dst",
+                                 query=[("uploads", "")])
+    assert st == 200
+    upload_id = xml_find(body, "UploadId")[0]
+    # part 1: copied byte range; part 2: copied full object
+    st, _, body = client.request(
+        "PUT", "/conformance/upc-dst",
+        query=[("partNumber", "1"), ("uploadId", upload_id)],
+        headers={"x-amz-copy-source": "/conformance/upc-src",
+                 "x-amz-copy-source-range": "bytes=0-99999"})
+    assert st == 200, body
+    etag1 = xml_find(body, "ETag")[0].strip('"')
+    st, _, body = client.request(
+        "PUT", "/conformance/upc-dst",
+        query=[("partNumber", "2"), ("uploadId", upload_id)],
+        headers={"x-amz-copy-source": "/conformance/upc-src"})
+    assert st == 200, body
+    etag2 = xml_find(body, "ETag")[0].strip('"')
+    complete = (
+        '<CompleteMultipartUpload>'
+        f'<Part><PartNumber>1</PartNumber><ETag>"{etag1}"</ETag></Part>'
+        f'<Part><PartNumber>2</PartNumber><ETag>"{etag2}"</ETag></Part>'
+        '</CompleteMultipartUpload>').encode()
+    st, _, body = client.request("POST", "/conformance/upc-dst",
+                                 query=[("uploadId", upload_id)],
+                                 body=complete)
+    assert st == 200, body
+    st, _, got = client.request("GET", "/conformance/upc-dst")
+    assert st == 200
+    assert got == src[:100000] + src
+
+
+def test_copy_reencrypt(client):
+    data = os.urandom(50_000)
+    assert client.request("PUT", "/conformance/plain-src",
+                          body=data)[0] == 200
+    # plaintext -> SSE-C copy
+    st, _, _ = client.request(
+        "PUT", "/conformance/enc-copy",
+        headers={"x-amz-copy-source": "/conformance/plain-src",
+                 **_sse_headers()})
+    assert st == 200
+    st, _, got = client.request("GET", "/conformance/enc-copy",
+                                headers=_sse_headers())
+    assert st == 200 and got == data
+    # SSE-C -> plaintext copy (decrypting with copy-source headers)
+    st, _, _ = client.request(
+        "PUT", "/conformance/plain-again",
+        headers={"x-amz-copy-source": "/conformance/enc-copy",
+                 **_sse_headers(prefix="copy-source-")})
+    assert st == 200
+    st, _, got = client.request("GET", "/conformance/plain-again")
+    assert st == 200 and got == data
+
+
+def _post_policy_form(server, bucket, key_field, file_body,
+                      extra_fields=None, extra_conditions=None,
+                      filename="upload.bin"):
+    import base64
+    import datetime as dt
+    import hashlib as _h
+    import hmac as _hmac
+    import json as _json
+
+    exp = (dt.datetime.now(dt.timezone.utc)
+           + dt.timedelta(minutes=5)).strftime("%Y-%m-%dT%H:%M:%SZ")
+    date = dt.datetime.now(dt.timezone.utc).strftime("%Y%m%d")
+    credential = f"{server.key_id}/{date}/garage/s3/aws4_request"
+    conditions = [
+        {"bucket": bucket},
+        ["starts-with", "$key", key_field.split("${")[0]],
+        {"x-amz-credential": credential},
+    ] + (extra_conditions or [])
+    policy = base64.b64encode(_json.dumps(
+        {"expiration": exp, "conditions": conditions}).encode()).decode()
+    k = b"AWS4" + server.secret.encode()
+    for part in (date, "garage", "s3", "aws4_request"):
+        k = _hmac.new(k, part.encode(), _h.sha256).digest()
+    sig = _hmac.new(k, policy.encode(), _h.sha256).hexdigest()
+    fields = {
+        "key": key_field,
+        "x-amz-credential": credential,
+        "policy": policy,
+        "x-amz-signature": sig,
+        **(extra_fields or {}),
+    }
+    boundary = "testboundary123"
+    parts = []
+    for name, value in fields.items():
+        parts.append(
+            f'--{boundary}\r\nContent-Disposition: form-data; '
+            f'name="{name}"\r\n\r\n{value}\r\n'.encode())
+    parts.append(
+        f'--{boundary}\r\nContent-Disposition: form-data; name="file"; '
+        f'filename="{filename}"\r\n'
+        f'Content-Type: application/octet-stream\r\n\r\n'.encode()
+        + file_body + b"\r\n")
+    parts.append(f"--{boundary}--\r\n".encode())
+    body = b"".join(parts)
+    return body, f"multipart/form-data; boundary={boundary}"
+
+
+def test_post_object_upload(server, client):
+    import http.client
+
+    payload = os.urandom(80_000)
+    body, ctype = _post_policy_form(server, "conformance",
+                                    "posted/${filename}", payload,
+                                    filename="hello.bin")
+    conn = http.client.HTTPConnection("127.0.0.1", server.s3_port,
+                                      timeout=30)
+    try:
+        conn.request("POST", "/conformance", body=body,
+                     headers={"content-type": ctype,
+                              "host": f"127.0.0.1:{server.s3_port}"})
+        r = conn.getresponse()
+        assert r.status == 204, r.read()
+        r.read()
+    finally:
+        conn.close()
+    st, _, got = client.request("GET", "/conformance/posted/hello.bin")
+    assert st == 200 and got == payload
+
+
+def test_post_object_bad_signature_and_policy(server):
+    import http.client
+
+    body, ctype = _post_policy_form(server, "conformance", "p2/x",
+                                    b"data")
+    # corrupt the signature
+    body = body.replace(b'name="x-amz-signature"\r\n\r\n',
+                        b'name="x-amz-signature"\r\n\r\n0')
+    conn = http.client.HTTPConnection("127.0.0.1", server.s3_port,
+                                      timeout=30)
+    try:
+        conn.request("POST", "/conformance", body=body,
+                     headers={"content-type": ctype})
+        r = conn.getresponse()
+        assert r.status == 403
+        r.read()
+    finally:
+        conn.close()
+    # field not covered by policy -> denied
+    body, ctype = _post_policy_form(server, "conformance", "p2/x",
+                                    b"data",
+                                    extra_fields={"x-amz-meta-evil": "1"})
+    conn = http.client.HTTPConnection("127.0.0.1", server.s3_port,
+                                      timeout=30)
+    try:
+        conn.request("POST", "/conformance", body=body,
+                     headers={"content-type": ctype})
+        r = conn.getresponse()
+        assert r.status == 403
+        r.read()
+    finally:
+        conn.close()
+
+
+def test_post_object_content_length_range(server, client):
+    import http.client
+
+    body, ctype = _post_policy_form(
+        server, "conformance", "small/obj", b"x" * 5000,
+        extra_conditions=[["content-length-range", 1, 100]])
+    conn = http.client.HTTPConnection("127.0.0.1", server.s3_port,
+                                      timeout=30)
+    try:
+        conn.request("POST", "/conformance", body=body,
+                     headers={"content-type": ctype})
+        r = conn.getresponse()
+        assert r.status == 400
+        r.read()
+    finally:
+        conn.close()
+    st, _, _ = client.request("GET", "/conformance/small/obj")
+    assert st == 404  # nothing persisted
+
+
+def test_post_object_too_small_preserves_existing(server, client):
+    import http.client
+
+    client.request("PUT", "/conformance/keepsafe", body=b"original")
+    body, ctype = _post_policy_form(
+        server, "conformance", "keepsafe", b"tiny",
+        extra_conditions=[["content-length-range", 100, 1000]])
+    conn = http.client.HTTPConnection("127.0.0.1", server.s3_port,
+                                      timeout=30)
+    try:
+        conn.request("POST", "/conformance", body=body,
+                     headers={"content-type": ctype})
+        r = conn.getresponse()
+        assert r.status == 400
+        r.read()
+    finally:
+        conn.close()
+    # the pre-existing object is untouched
+    st, _, got = client.request("GET", "/conformance/keepsafe")
+    assert st == 200 and got == b"original"
