@@ -32,22 +32,41 @@ import numpy as np
 
 
 def bench_rs_encode(jax, platform: str) -> float:
+    """Sustained RS(10,4) encode GB/s, measured with a DEPENDENCY CHAIN:
+    each iteration's input folds in the previous parity, so iterations
+    cannot overlap and a single end-of-chain sync gives wall-clock for
+    exactly `iters` sequential encodes (per-call dispatch overhead
+    amortized — the number a busy PUT pipeline sustains)."""
+    import jax.numpy as jnp
+
     from garage_tpu.ops import rs
 
     k, m = 10, 4
     if platform == "cpu":
-        shard_len, batch, iters = 1 << 16, 4, 2  # keep CPU fallback quick
+        shard_len, batch, iters = 1 << 16, 4, 3  # keep CPU fallback quick
     else:
-        shard_len, batch, iters = 1 << 20, 8, 5  # 10 MiB stripes, 80 MiB/iter
+        shard_len, batch, iters = 1 << 20, 8, 20  # 80 MiB per step
     rng = np.random.default_rng(0)
     data = rng.integers(0, 256, size=(batch, k, shard_len), dtype=np.uint8)
     data = jax.device_put(data)
-    parity = rs.encode(k, m, data)  # compile + warm
-    jax.block_until_ready(parity)
+
+    @jax.jit
+    def step(x):
+        # the PRODUCTION encode entry point (rs.encode selects the XLA
+        # bit-matmul or, with GARAGE_TPU_PALLAS, the fused Pallas
+        # kernel); the xor/concat fold adds a little extra work, making
+        # the figure slightly conservative
+        p = rs.encode(k, m, x)
+        pad = jnp.zeros((batch, k - 2 * m, shard_len), jnp.uint8)
+        return x ^ jnp.concatenate([p, p, pad], axis=1)
+
+    x = step(data)  # compile + warm
+    _ = np.asarray(x[0, 0, :8])
     t0 = time.perf_counter()
+    x = data
     for _ in range(iters):
-        parity = rs.encode(k, m, data)
-    jax.block_until_ready(parity)
+        x = step(x)
+    _ = np.asarray(x[0, 0, :8])  # one tiny d2h: full-chain completion
     dt = time.perf_counter() - t0
     return batch * k * shard_len * iters / dt / 1e9
 

@@ -55,12 +55,20 @@ _EXPLORE_EVERY = 32
 _BATCH_TIMEOUT = 300.0
 
 PROBE_TIMEOUT = 60.0
-# per-uid cache path: a shared /tmp name would let another local user
-# pin the probe verdict for every process on the box
-_PROBE_CACHE = os.path.join(
-    tempfile.gettempdir(),
-    f"garage_tpu_device_probe.{os.getuid() if hasattr(os, 'getuid') else 0}.json",
-)
+
+
+def _probe_cache_path() -> str:
+    # per-uid (a shared /tmp name would let another local user pin the
+    # verdict for everyone) AND per-platform-pin: a JAX_PLATFORMS=cpu
+    # test process probing "cpu" must not poison the cache consulted by
+    # an unpinned server on the same box
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    pin = os.environ.get("JAX_PLATFORMS", "auto") or "auto"
+    pin = "".join(c if c.isalnum() else "_" for c in pin)[:16]
+    return os.path.join(tempfile.gettempdir(),
+                        f"garage_tpu_device_probe.{uid}.{pin}.json")
+
+
 _PROBE_TTL = 600.0
 
 _probe_lock = threading.Lock()
@@ -78,7 +86,7 @@ def probe_device(timeout: float = PROBE_TIMEOUT, force: bool = False) -> dict:
             return _probe_result
         if not force:
             try:
-                with open(_PROBE_CACHE) as f:
+                with open(_probe_cache_path()) as f:
                     cached = json.load(f)
                 age = time.time() - cached.get("at", 0)
                 if 0 <= age < _PROBE_TTL:  # reject future timestamps
@@ -105,9 +113,10 @@ def probe_device(timeout: float = PROBE_TIMEOUT, force: bool = False) -> dict:
             res["error"] = str(e)
         _probe_result = res
         try:
-            with open(_PROBE_CACHE + ".tmp", "w") as f:
+            cache = _probe_cache_path()
+            with open(cache + ".tmp", "w") as f:
                 json.dump(res, f)
-            os.replace(_PROBE_CACHE + ".tmp", _PROBE_CACHE)
+            os.replace(cache + ".tmp", cache)
         except OSError:
             pass
         return res
@@ -135,6 +144,7 @@ class DeviceFeeder:
         self._task: Optional[asyncio.Task] = None
         self._device_ok: Optional[bool] = None
         self._probing = False
+        self._calibrating = False
         self.stats = {"batches": 0, "items": 0, "device_batches": 0,
                       "device_items": 0, "max_batch": 0}
         # calibration: (op, backend) -> [bytes, seconds]; routing picks
@@ -183,21 +193,73 @@ class DeviceFeeder:
         if self._device_ok is not None or self._probing or self.mode != "auto":
             return
         self._probing = True
+        self._calibrating = True
 
         def run():
             try:
                 res = probe_device()
-                self._device_ok = bool(res["ok"])
-                if self._device_ok:
+                ok = bool(res["ok"])
+                if ok:
                     log.info("device data plane active: %s", res["platform"])
+                    # seed BOTH backends' throughput samples with
+                    # synthetic batches OFF the request path, so the
+                    # first production batch is routed on data instead
+                    # of paying a cold device trial inline. The device
+                    # calls run in a nested watchdog thread: a hung
+                    # tunnel (the failure mode _BATCH_TIMEOUT guards on
+                    # the batch path) disables the device; a transient
+                    # error merely penalizes it so _EXPLORE_EVERY can
+                    # re-discover a recovered device later.
+                    cal = threading.Thread(target=self._calibrate,
+                                           daemon=True,
+                                           name="feeder-calibrate")
+                    cal.start()
+                    cal.join(_BATCH_TIMEOUT)
+                    if cal.is_alive():
+                        log.error("device calibration stuck >%ss; "
+                                  "disabling device path", _BATCH_TIMEOUT)
+                        ok = False
                 elif res["error"]:
                     log.info("device probe failed, host data plane: %s",
                              res["error"])
+                self._device_ok = ok
             finally:
+                self._calibrating = False
                 self._probing = False
 
         threading.Thread(target=run, daemon=True,
                          name="feeder-probe").start()
+
+    def _calibrate(self) -> None:
+        from ..utils import data as _data
+
+        blob = bytes(np.random.default_rng(0).integers(
+            0, 256, 1 << 20, dtype=np.uint8))
+        batch = [blob] * 4
+        for backend in ("host", "device"):
+            try:
+                # blake2 hashing never runs on device — recording a
+                # host timing under the device key would fabricate a
+                # backend that never ran
+                if _data._content_algo == "blake3" or backend == "host":
+                    t0 = time.perf_counter()
+                    self._do_hash(batch, backend)
+                    self._record("hash", backend, len(batch) << 20,
+                                 time.perf_counter() - t0)
+                if self.codec is not None:
+                    t0 = time.perf_counter()
+                    self._do_encode(batch, backend)
+                    self._record("encode", backend, len(batch) << 20,
+                                 time.perf_counter() - t0)
+            except Exception as e:
+                if backend == "device":
+                    log.info("device calibration error (%s: %s); "
+                             "penalizing device path", type(e).__name__, e)
+                    self._record("hash", "device", 0, 60.0)
+                    self._record("encode", "device", 0, 60.0)
+                else:
+                    raise
+        log.info("feeder calibration: %s", self.perf_summary())
 
     # ---- public async ops ---------------------------------------------
 
@@ -272,7 +334,7 @@ class DeviceFeeder:
     # ---- batch execution (worker thread) -------------------------------
 
     def _pick_backend(self, op: str, total_bytes: int, n_items: int) -> str:
-        if self._device_ok is not True:
+        if self._device_ok is not True or self._calibrating:
             return "host"
         if total_bytes < _DEVICE_MIN_BYTES and n_items < _DEVICE_MIN_ITEMS:
             return "host"  # tiny batches never amortize a round trip

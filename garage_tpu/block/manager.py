@@ -38,24 +38,61 @@ log = logging.getLogger("garage_tpu.block")
 
 INLINE_THRESHOLD = 3072  # ref: block/manager.rs:46
 
-_SHARD_MAGIC = b"GTS1"
+_SHARD_MAGIC_V1 = b"GTS1"  # blake2-256 checksum (legacy)
+_SHARD_MAGIC_C32C = b"GTS2"  # crc32c (native slice-by-8 kernel)
+_SHARD_MAGIC_C32 = b"GTS3"  # zlib crc32 (no native toolchain)
 
 
 def pack_shard(data: bytes, packed_len: int) -> bytes:
     """Shard file: magic + whole-block packed length + shard checksum +
-    shard bytes (the checksum lets scrub verify a shard without its k-1
-    siblings)."""
-    return (_SHARD_MAGIC + packed_len.to_bytes(8, "big")
-            + blake2sum(data) + data)
+    shard bytes (the checksum lets scrub detect bit rot in a shard
+    without its k-1 siblings; the cryptographic integrity anchor remains
+    the whole-block content hash, so a 32-byte blake2 here bought
+    nothing but ~9 ms/block). The magic names the CRC flavor, so a
+    native-less writer (zlib crc32) and a native reader interoperate —
+    never fall back to pure-Python CRC on this path."""
+    from .. import native
+
+    if native.loaded() or native.available():
+        magic = _SHARD_MAGIC_C32C
+        ck = native.crc32c(data)
+    else:
+        import zlib
+
+        magic = _SHARD_MAGIC_C32
+        ck = zlib.crc32(data)
+    return (magic + packed_len.to_bytes(8, "big")
+            + ck.to_bytes(4, "big") + data)
 
 
 def unpack_shard(raw: bytes) -> tuple[bytes, int]:
-    """-> (shard bytes, whole-block packed length); raises CorruptData."""
-    if raw[:4] != _SHARD_MAGIC:
-        raise CorruptData(b"")
+    """-> (shard bytes, whole-block packed length); raises CorruptData.
+    Reads every shard format (crc32c, zlib crc32, legacy blake2)."""
+    magic = raw[:4]
     packed_len = int.from_bytes(raw[4:12], "big")
-    ck, data = raw[12:44], raw[44:]
-    if blake2sum(data) != ck:
+    if magic == _SHARD_MAGIC_C32C:
+        ck, data = raw[12:16], raw[16:]
+        from .. import native
+
+        if native.available():
+            good = native.crc32c(data).to_bytes(4, "big") == ck
+        else:  # cross-node file from a native writer, no toolchain here
+            from ..api.checksum import _crc32c_py
+
+            good = _crc32c_py(data).to_bytes(4, "big") == ck
+        if not good:
+            raise CorruptData(b"")
+    elif magic == _SHARD_MAGIC_C32:
+        import zlib
+
+        ck, data = raw[12:16], raw[16:]
+        if zlib.crc32(data).to_bytes(4, "big") != ck:
+            raise CorruptData(b"")
+    elif magic == _SHARD_MAGIC_V1:
+        ck, data = raw[12:44], raw[44:]
+        if blake2sum(data) != ck:
+            raise CorruptData(b"")
+    else:
         raise CorruptData(b"")
     return data, packed_len
 

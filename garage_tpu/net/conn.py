@@ -15,13 +15,13 @@ secret gate), then both directions run ChaCha20-Poly1305 with counter
 nonces — same properties (mutual auth, cluster gate, confidentiality,
 forward secrecy) with standard primitives from `cryptography`.
 
-Frame flags (in the u16 len field):
-  0x8000 CONTINUES — more chunks follow for this section
-  0x4000 ERROR     — section is an error payload
-  0x2000 STREAM    — chunk belongs to the attached byte stream
-  len = field & 0x1FFF, <= MAX_CHUNK (0x1FF0)
-  field == 0xFFFF  — CANCEL marker for this request id
-  field == 0xFFFE  — CREDIT grant; payload = u32 additional window bytes
+Frame flags (in the u32 len field):
+  0x80000000 CONTINUES — more chunks follow for this section
+  0x40000000 ERROR     — section is an error payload
+  0x20000000 STREAM    — chunk belongs to the attached byte stream
+  len = field & 0x0FFFFFFF, <= MAX_CHUNK (0x3FFF0, 256 KiB)
+  field == 0xFFFFFFFF  — CANCEL marker for this request id
+  field == 0xFFFFFFFE  — CREDIT grant; payload = u32 additional window
 
 Concurrency invariant: ALL outgoing records flow through _send_loop (the
 single writer) — the AEAD nonce counter and frame ordering both depend
@@ -53,14 +53,18 @@ from .stream import ByteStream
 
 log = logging.getLogger("garage_tpu.net")
 
-MAGIC = b"GRGTPU\x01\x00"  # protocol version gate (ref: net/netapp.rs:35-40)
-MAX_CHUNK = 0x1FF0
-F_CONT = 0x8000
-F_ERROR = 0x4000
-F_STREAM = 0x2000
-LEN_MASK = 0x1FFF
-CANCEL = 0xFFFF
-CREDIT = 0xFFFE
+MAGIC = b"GRGTPU\x02\x00"  # protocol version gate (ref: net/netapp.rs:35-40)
+# 256 KiB chunks: per-chunk costs (AEAD pass + header + writer wakeup)
+# were the dominant CPU on the block path at the reference-style ~8 KiB
+# (a 1.5 MiB shard transfer = ~190 chunks); at ~1 ms serialization per
+# chunk the priority round-robin still keeps pings fresh
+MAX_CHUNK = 0x3FFF0
+F_CONT = 0x80000000
+F_ERROR = 0x40000000
+F_STREAM = 0x20000000
+LEN_MASK = 0x0FFFFFFF
+CANCEL = 0xFFFFFFFF
+CREDIT = 0xFFFFFFFE
 
 # Stream flow control: sender may have this many un-acked stream bytes in
 # flight per request; receiver grants more as the consumer drains.
@@ -383,12 +387,12 @@ class Conn:
     async def _send_one_chunk(self, item: _SendItem) -> None:
         if item.kind == "cancel":
             self._ctl_items.remove(item)
-            await self.chan.send_record(struct.pack("<IH", item.req_id, CANCEL))
+            await self.chan.send_record(struct.pack("<II", item.req_id, CANCEL))
             return
         if item.kind == "credit":
             self._ctl_items.remove(item)
             await self.chan.send_record(
-                struct.pack("<IH", item.req_id, CREDIT) + item.body
+                struct.pack("<II", item.req_id, CREDIT) + item.body
             )
             return
         self._send_clock += 1
@@ -400,7 +404,7 @@ class Conn:
             more_body = item.pos < len(item.body)
             flags = flags_base | (F_CONT if more_body else 0)
             await self.chan.send_record(
-                struct.pack("<IH", item.req_id, flags | len(chunk)) + chunk
+                struct.pack("<II", item.req_id, flags | len(chunk)) + chunk
             )
             if not more_body and item.stream is None:
                 self._finish_item(item)
@@ -408,12 +412,12 @@ class Conn:
         # stream section
         if item.chunk_state == "error":
             await self.chan.send_record(
-                struct.pack("<IH", item.req_id, F_STREAM | F_ERROR)
+                struct.pack("<II", item.req_id, F_STREAM | F_ERROR)
             )
             self._finish_item(item)
             return
         if item.chunk_state == "eof":
-            await self.chan.send_record(struct.pack("<IH", item.req_id, F_STREAM))
+            await self.chan.send_record(struct.pack("<II", item.req_id, F_STREAM))
             self._finish_item(item)
             return
         assert item.chunk_state == "ready"
@@ -427,7 +431,7 @@ class Conn:
             item.chunk_state = "none"
         item.window -= len(send_now)
         await self.chan.send_record(
-            struct.pack("<IH", item.req_id, F_STREAM | F_CONT | len(send_now)) + send_now
+            struct.pack("<II", item.req_id, F_STREAM | F_CONT | len(send_now)) + send_now
         )
 
     def _finish_item(self, item: _SendItem) -> None:
@@ -441,8 +445,8 @@ class Conn:
         try:
             while True:
                 rec = await self.chan.recv_record()
-                req_id, field = struct.unpack_from("<IH", rec)
-                payload = rec[6:]
+                req_id, field = struct.unpack_from("<II", rec)
+                payload = rec[8:]
                 if field == CANCEL:
                     self._handle_cancel(req_id)
                 elif field == CREDIT:
