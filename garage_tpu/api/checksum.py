@@ -15,37 +15,10 @@ from typing import Optional
 
 ALGORITHMS = ("crc32", "crc32c", "sha1", "sha256", "crc64nvme")
 
-_CRC32C_POLY = 0x82F63B78  # reflected Castagnoli
-_CRC64NVME_POLY = 0x9A6C9329AC4BC9B5  # reflected CRC-64/NVME
-
-
-def _make_table(poly: int, width: int) -> list[int]:
-    mask = (1 << width) - 1
-    table = []
-    for i in range(256):
-        crc = i
-        for _ in range(8):
-            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
-        table.append(crc & mask)
-    return table
-
-
-_CRC32C_TABLE = _make_table(_CRC32C_POLY, 32)
-_CRC64NVME_TABLE = _make_table(_CRC64NVME_POLY, 64)
-
-
-def _crc32c_py(data: bytes, crc: int = 0) -> int:
-    crc ^= 0xFFFFFFFF
-    for b in data:
-        crc = (crc >> 8) ^ _CRC32C_TABLE[(crc ^ b) & 0xFF]
-    return crc ^ 0xFFFFFFFF
-
-
-def _crc64nvme_py(data: bytes, crc: int = 0) -> int:
-    crc ^= 0xFFFFFFFFFFFFFFFF
-    for b in data:
-        crc = (crc >> 8) ^ _CRC64NVME_TABLE[(crc ^ b) & 0xFF]
-    return crc ^ 0xFFFFFFFFFFFFFFFF
+# pure-Python table fallbacks live with the native kernels so every
+# layer shares one implementation (garage_tpu/native)
+from ..native import crc32c_py as _crc32c_py
+from ..native import crc64nvme_py as _crc64nvme_py
 
 
 def crc32c(data: bytes, crc: int = 0) -> int:

@@ -28,8 +28,8 @@ def _object_headers(version, meta) -> list[tuple[str, str]]:
            ("accept-ranges", "bytes"),
            ("x-amz-version-id", version.uuid.hex())]
     for name, v in sorted(meta.headers.items()):
-        if name.startswith("x-garage-ssec-"):
-            continue  # internal SSE-C markers; surfaced as x-amz-* below
+        if name.startswith(("x-garage-ssec-", "x-garage-checksum-")):
+            continue  # internal markers; surfaced as x-amz-* on demand
         out.append((name, v))
     if "content-type" not in meta.headers:
         out.append(("content-type", "application/octet-stream"))
@@ -106,9 +106,27 @@ async def handle_get(ctx, req: Request, head: bool = False) -> Response:
             pass
 
     headers = _object_headers(v, meta)
+    if (req.header("x-amz-checksum-mode") or "").upper() == "ENABLED":
+        for name, val in meta.headers.items():
+            if name.startswith("x-garage-checksum-"):
+                algo = name[len("x-garage-checksum-"):]
+                headers.append((f"x-amz-checksum-{algo}", val))
     size = meta.size
     rng = None
-    if req.header("range"):
+    prefetched_version = None
+    part_q = req.query.get("partNumber")
+    if part_q is not None:
+        if req.header("range"):
+            raise S3Error("InvalidRequest", 400,
+                          "cannot combine partNumber and Range")
+        rng, n_parts, prefetched_version = await _part_range(
+            ctx, v, size, part_q)
+        headers.append(("x-amz-mp-parts-count", str(n_parts)))
+        if size == 0:
+            # a 0-byte object has no valid byte range; serve the whole
+            # (empty) body like AWS instead of "bytes 0--1/0"
+            rng = None
+    elif req.header("range"):
         rng = parse_range(req.header("range"), size)
         if rng is None:
             return Response(416, [("content-range", f"bytes */{size}")])
@@ -130,7 +148,8 @@ async def handle_get(ctx, req: Request, head: bool = False) -> Response:
             return Response(200, headers)
         return Response(200, headers, payload)
 
-    version = await ctx.garage.version_table.get(v.uuid, b"")
+    version = (prefetched_version if prefetched_version is not None
+               else await ctx.garage.version_table.get(v.uuid, b""))
     if version is None:
         raise no_such_key(ctx.key)
     blocks = list(version.blocks.items())  # sorted by (part, offset)
@@ -154,6 +173,34 @@ async def handle_get(ctx, req: Request, head: bool = False) -> Response:
     headers.append(("content-length", str(end - start)))
     return Response(206, headers, _stream_blocks(ctx.garage, blocks,
                                                  start, end, sse_key))
+
+
+async def _part_range(ctx, v, size: int, part_q: str):
+    """?partNumber=N -> (byte range, part count, prefetched Version or
+    None) — the Version row is returned so the block path doesn't fetch
+    it a second time (ref: get.rs handle_get with part_number).
+    Non-multipart objects are a single part 1 covering the whole body."""
+    try:
+        pn = int(part_q)
+        if pn < 1:
+            raise ValueError
+    except ValueError:
+        raise S3Error("InvalidArgument", 400, "bad partNumber")
+    version = None
+    if v.state.data.kind != "inline":
+        version = await ctx.garage.version_table.get(v.uuid, b"")
+    if version is None or not list(version.blocks.items()):
+        if pn != 1:
+            raise S3Error("InvalidPartNumber", 416, "no such part")
+        return (0, size), 1, version
+    part_sizes: dict[int, int] = {}
+    for (part, _off), (_h, blen) in version.blocks.items():
+        part_sizes[part] = part_sizes.get(part, 0) + blen
+    parts = sorted(part_sizes)
+    if pn not in part_sizes:
+        raise S3Error("InvalidPartNumber", 416, "no such part")
+    start = sum(part_sizes[p] for p in parts if p < pn)
+    return (start, start + part_sizes[pn]), len(parts), version
 
 
 async def open_object_stream(garage, src_v, start: int, end: int,

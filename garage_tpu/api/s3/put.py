@@ -96,6 +96,12 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
 
         headers = {**headers, META_SSEC_ALGO: "AES256",
                    META_SSEC_MD5: sse_key.md5_b64}
+    if expected_checksum is not None:
+        # persist the validated checksum so GET/HEAD can return it
+        # under x-amz-checksum-mode: ENABLED (ref: checksum.rs storage)
+        headers = {**headers,
+                   f"x-garage-checksum-{expected_checksum[0]}":
+                       expected_checksum[1]}
     block_size = garage.config.block_size
     chunker = Chunker(body, block_size)
     first_block, existing = await asyncio.gather(

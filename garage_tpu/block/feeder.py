@@ -252,13 +252,15 @@ class DeviceFeeder:
                     self._record("encode", backend, len(batch) << 20,
                                  time.perf_counter() - t0)
             except Exception as e:
+                # a host-leg failure must not kill the thread silently
+                # (the device leg would then never run and the first
+                # production batch would pay the cold device trial the
+                # calibration exists to avoid)
+                log.warning("%s calibration leg failed (%s: %s)",
+                            backend, type(e).__name__, e)
                 if backend == "device":
-                    log.info("device calibration error (%s: %s); "
-                             "penalizing device path", type(e).__name__, e)
                     self._record("hash", "device", 0, 60.0)
                     self._record("encode", "device", 0, 60.0)
-                else:
-                    raise
         log.info("feeder calibration: %s", self.perf_summary())
 
     # ---- public async ops ---------------------------------------------

@@ -53,7 +53,9 @@ def pack_shard(data: bytes, packed_len: int) -> bytes:
     never fall back to pure-Python CRC on this path."""
     from .. import native
 
-    if native.loaded() or native.available():
+    # loaded() only — triggering the C build here would block the event
+    # loop for seconds; until warm_async() lands, write the zlib flavor
+    if native.loaded():
         magic = _SHARD_MAGIC_C32C
         ck = native.crc32c(data)
     else:
@@ -74,12 +76,10 @@ def unpack_shard(raw: bytes) -> tuple[bytes, int]:
         ck, data = raw[12:16], raw[16:]
         from .. import native
 
-        if native.available():
+        if native.loaded():
             good = native.crc32c(data).to_bytes(4, "big") == ck
-        else:  # cross-node file from a native writer, no toolchain here
-            from ..api.checksum import _crc32c_py
-
-            good = _crc32c_py(data).to_bytes(4, "big") == ck
+        else:  # cross-node file from a native writer, no library here
+            good = native.crc32c_py(data).to_bytes(4, "big") == ck
         if not good:
             raise CorruptData(b"")
     elif magic == _SHARD_MAGIC_C32:

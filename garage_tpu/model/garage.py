@@ -101,10 +101,13 @@ class Garage:
             kwargs["status_interval"] = status_interval
         if ping_interval is not None:
             kwargs["ping_interval"] = ping_interval
+        from ..rpc.discovery import providers_from_config
+
         self.system = System(
             self.netapp, self.replication, config.metadata_dir,
             data_dirs=[d.path for d in config.data_dirs],
-            bootstrap_peers=bootstrap, **kwargs,
+            bootstrap_peers=bootstrap,
+            discovery=providers_from_config(config), **kwargs,
         )
         rpc = RpcHelper(self.system)
         self.rpc = rpc

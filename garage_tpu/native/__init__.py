@@ -142,6 +142,36 @@ def blake3_many(blobs: list[bytes]) -> list[bytes]:
     return [out[i].tobytes() for i in range(n)]
 
 
+def _make_crc_table(poly: int, width: int) -> list:
+    mask = (1 << width) - 1
+    table = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        table.append(crc & mask)
+    return table
+
+
+_CRC32C_TABLE = _make_crc_table(0x82F63B78, 32)
+_CRC64NVME_TABLE = _make_crc_table(0x9A6C9329AC4BC9B5, 64)
+
+
+def crc32c_py(data: bytes, crc: int = 0) -> int:
+    """Pure-Python fallback (slow; last resort when no toolchain)."""
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ _CRC32C_TABLE[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+def crc64nvme_py(data: bytes, crc: int = 0) -> int:
+    crc ^= 0xFFFFFFFFFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ _CRC64NVME_TABLE[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFFFFFFFFFF
+
+
 def crc32c(data: bytes, crc: int = 0) -> int:
     lib = _get()
     if lib is None:

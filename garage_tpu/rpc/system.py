@@ -133,6 +133,8 @@ class System:
         bootstrap_peers: Optional[list] = None,
         status_interval: float = STATUS_EXCHANGE_INTERVAL,
         ping_interval: Optional[float] = None,
+        discovery: Optional[list] = None,
+        discovery_interval: float = 60.0,
     ):
         self.netapp = netapp
         self.replication = replication
@@ -152,6 +154,9 @@ class System:
         if ping_interval is not None:
             kwargs = {"ping_interval": ping_interval, "retry_interval": ping_interval}
         self.peering = PeeringManager(netapp, bootstrap, **kwargs)
+
+        self.discovery = list(discovery or [])
+        self.discovery_interval = discovery_interval
 
         self.layout_manager = LayoutManager(netapp, meta_dir, replication)
         self.node_status: dict[bytes, tuple[float, NodeStatus]] = {}
@@ -174,6 +179,9 @@ class System:
             asyncio.create_task(self.peering.run()),
             asyncio.create_task(self._status_exchange_loop()),
         ]
+        if self.discovery:
+            self._tasks.append(
+                asyncio.create_task(self._discovery_loop()))
         await self._stop.wait()
         await self.peering.stop()
         for t in self._tasks:
@@ -204,6 +212,27 @@ class System:
             meta_disk_avail=disk([self.meta_dir]),
             data_disk_avail=disk(self.data_dirs),
         )
+
+    async def _discovery_loop(self) -> None:
+        """Publish ourself and pull peers from external providers
+        (Consul catalog / Kubernetes CRDs; ref: rpc/system.rs:627
+        discovery_loop). Providers are advisory: failures log and the
+        loop keeps going on bootstrap + gossip."""
+        while True:
+            addr = self.netapp.public_addr
+            for prov in self.discovery:
+                name = type(prov).__name__
+                try:
+                    if addr is not None:
+                        await prov.register(self.id, addr)
+                    for peer_addr, nid in await prov.get_peers():
+                        if nid == self.id or (nid is None
+                                              and peer_addr == addr):
+                            continue
+                        self.peering.add_peer(tuple(peer_addr), nid)
+                except Exception as e:
+                    log.info("discovery via %s failed: %s", name, e)
+            await asyncio.sleep(self.discovery_interval)
 
     async def _status_exchange_loop(self) -> None:
         while True:

@@ -53,6 +53,12 @@ class Config:
     rpc_bind_addr: str = "127.0.0.1:3901"
     rpc_public_addr: Optional[str] = None
     bootstrap_peers: list[str] = field(default_factory=list)
+    # external discovery (ref: rpc/consul.rs, rpc/kubernetes.rs);
+    # TOML sections [consul_discovery] / [kubernetes_discovery]
+    consul_http_addr: Optional[str] = None
+    consul_service_name: Optional[str] = None
+    kubernetes_namespace: Optional[str] = None
+    kubernetes_service_name: Optional[str] = None
 
     db_engine: str = "sqlite"  # sqlite|memory (lmdb not in this image)
 
@@ -126,9 +132,13 @@ def config_from_dict(raw: dict) -> Config:
             cfg.data_dir = _parse_data_dir(val)
         elif key == "tpu" and isinstance(val, dict):
             cfg.tpu = TpuConfig(**val)
-        elif key in ("s3_api", "k2v_api", "admin", "web"):
+        elif key in ("s3_api", "k2v_api", "admin", "web",
+                     "consul_discovery", "kubernetes_discovery"):
             # nested sections like the reference layout
-            prefix = {"s3_api": "s3_", "k2v_api": "k2v_", "admin": "admin_", "web": "web_"}[key]
+            prefix = {"s3_api": "s3_", "k2v_api": "k2v_",
+                      "admin": "admin_", "web": "web_",
+                      "consul_discovery": "consul_",
+                      "kubernetes_discovery": "kubernetes_"}[key]
             for k2, v2 in val.items():
                 attr = k2 if k2.startswith(prefix) else None
                 # prefixed name first: [web] root_domain must map to

@@ -1,0 +1,183 @@
+"""External discovery: Consul + Kubernetes providers against in-process
+fake HTTP servers, and the System discovery loop converging a cluster
+with NO bootstrap peers (ref: rpc/consul.rs, rpc/kubernetes.rs).
+"""
+
+import asyncio
+import json
+
+from garage_tpu.rpc.discovery import (ConsulDiscovery, KubernetesDiscovery,
+                                      providers_from_config)
+from garage_tpu.utils.config import config_from_dict
+
+from test_block import NETID, run  # noqa: F401
+
+
+class FakeConsul:
+    """Minimal /v1/agent/service/register + /v1/catalog/service/<name>."""
+
+    def __init__(self):
+        self.services: dict[str, dict] = {}
+        self.server = None
+        self.port = None
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._conn, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self.server.close()
+        await self.server.wait_closed()
+
+    async def _conn(self, reader, writer):
+        try:
+            req = await reader.readline()
+            method, path, _ = req.decode().split(" ", 2)
+            length = 0
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b""):
+                    break
+                if line.lower().startswith(b"content-length:"):
+                    length = int(line.split(b":")[1])
+            body = await reader.readexactly(length) if length else b""
+            status, resp = self._route(method, path, body)
+            payload = json.dumps(resp).encode()
+            writer.write(
+                f"HTTP/1.1 {status} X\r\ncontent-type: application/json"
+                f"\r\ncontent-length: {len(payload)}\r\n\r\n".encode()
+                + payload)
+            await writer.drain()
+        finally:
+            writer.close()
+
+    def _route(self, method, path, body):
+        if method == "PUT" and path == "/v1/agent/service/register":
+            svc = json.loads(body.decode())
+            self.services[svc["ID"]] = svc
+            return 200, {}
+        if method == "GET" and path.startswith("/v1/catalog/service/"):
+            name = path.rsplit("/", 1)[1]
+            return 200, [
+                {"ServiceAddress": s["Address"], "ServicePort": s["Port"],
+                 "ServiceMeta": s.get("Meta", {})}
+                for s in self.services.values() if s["Name"] == name
+            ]
+        return 404, {"error": "not found"}
+
+
+def test_consul_register_and_discover():
+    async def main():
+        consul = FakeConsul()
+        await consul.start()
+        try:
+            prov = ConsulDiscovery(f"127.0.0.1:{consul.port}", "garage")
+            nid_a, nid_b = b"\x01" * 32, b"\x02" * 32
+            await prov.register(nid_a, ("10.0.0.1", 3901))
+            await prov.register(nid_b, ("10.0.0.2", 3901))
+            peers = sorted(await prov.get_peers())
+            assert peers == [(("10.0.0.1", 3901), nid_a),
+                             (("10.0.0.2", 3901), nid_b)]
+        finally:
+            await consul.stop()
+
+    run(main())
+
+
+def test_kubernetes_crd_provider():
+    """The k8s provider drives the same fake-HTTP pattern: upsert a CR,
+    then list; the fake speaks just enough of the CRD REST surface."""
+
+    class FakeK8s(FakeConsul):
+        def __init__(self):
+            super().__init__()
+            self.crs: dict[str, dict] = {}
+
+        def _route(self, method, path, body):
+            base = "/apis/deuxfleurs.fr/v1/namespaces/ns1/garagenodes"
+            if path == base and method == "GET":
+                return 200, {"items": list(self.crs.values())}
+            if path == base and method == "POST":
+                cr = json.loads(body.decode())
+                self.crs[cr["metadata"]["name"]] = cr
+                return 201, cr
+            if path.startswith(base + "/") and method == "PUT":
+                name = path.rsplit("/", 1)[1]
+                if name not in self.crs:
+                    return 404, {}
+                cr = json.loads(body.decode())
+                self.crs[name] = cr
+                return 200, cr
+            return 404, {}
+
+    async def main():
+        k8s = FakeK8s()
+        await k8s.start()
+        try:
+            prov = KubernetesDiscovery(
+                "ns1", "garage",
+                api_server=f"http://127.0.0.1:{k8s.port}", token="t")
+            nid = b"\x07" * 32
+            await prov.register(nid, ("10.1.0.1", 3901))
+            await prov.register(nid, ("10.1.0.1", 3902))  # update via PUT
+            peers = await prov.get_peers()
+            assert peers == [(("10.1.0.1", 3902), nid)]
+        finally:
+            await k8s.stop()
+
+    run(main())
+
+
+def test_system_discovery_loop_connects_cluster(tmp_path):
+    """Two real nodes with NO bootstrap peers find each other purely
+    through the (fake) Consul catalog."""
+    from garage_tpu.net import LocalNetwork, NetApp
+    from garage_tpu.rpc import ReplicationMode, System
+
+    async def main():
+        consul = FakeConsul()
+        await consul.start()
+        net = LocalNetwork()
+        systems, tasks = [], []
+        try:
+            for i in range(2):
+                app = NetApp(NETID)
+                net.register(app)
+                prov = ConsulDiscovery(f"127.0.0.1:{consul.port}",
+                                       "garage")
+                s = System(app, ReplicationMode.parse(1),
+                           str(tmp_path / f"n{i}"),
+                           status_interval=5.0, ping_interval=5.0,
+                           discovery=[prov], discovery_interval=0.1)
+                systems.append(s)
+            tasks = [asyncio.create_task(s.run()) for s in systems]
+            deadline = asyncio.get_event_loop().time() + 15
+            while asyncio.get_event_loop().time() < deadline:
+                if all(len(s.netapp.conns) == 1 for s in systems):
+                    break
+                await asyncio.sleep(0.05)
+            assert all(len(s.netapp.conns) == 1 for s in systems)
+        finally:
+            for s in systems:
+                await s.stop()
+            for t in tasks:
+                t.cancel()
+            await consul.stop()
+
+    run(main())
+
+
+def test_providers_from_config():
+    cfg = config_from_dict({
+        "metadata_dir": "/tmp/x",
+        "consul_discovery": {"consul_http_addr": "127.0.0.1:8500",
+                             "service_name": "garage-test"},
+        "kubernetes_discovery": {"namespace": "prod",
+                                 "service_name": "garage"},
+    })
+    provs = providers_from_config(cfg)
+    assert len(provs) == 2
+    assert isinstance(provs[0], ConsulDiscovery)
+    assert provs[0].service_name == "garage-test"
+    assert isinstance(provs[1], KubernetesDiscovery)
+    assert provs[1].namespace == "prod"

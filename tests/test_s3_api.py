@@ -1322,3 +1322,52 @@ def test_post_object_too_small_preserves_existing(server, client):
     # the pre-existing object is untouched
     st, _, got = client.request("GET", "/conformance/keepsafe")
     assert st == 200 and got == b"original"
+
+
+def test_get_part_number(client):
+    part1 = os.urandom(70_000)
+    part2 = os.urandom(80_000)
+    st, _, body = client.request("POST", "/conformance/pnget",
+                                 query=[("uploads", "")])
+    upload_id = xml_find(body, "UploadId")[0]
+    etags = []
+    for i, part in enumerate((part1, part2), start=1):
+        st, hdrs, _ = client.request(
+            "PUT", "/conformance/pnget",
+            query=[("partNumber", str(i)), ("uploadId", upload_id)],
+            body=part)
+        etags.append(hdrs["etag"].strip('"'))
+    complete = ("<CompleteMultipartUpload>" + "".join(
+        f'<Part><PartNumber>{i}</PartNumber><ETag>"{e}"</ETag></Part>'
+        for i, e in enumerate(etags, start=1))
+        + "</CompleteMultipartUpload>").encode()
+    st, _, body = client.request("POST", "/conformance/pnget",
+                                 query=[("uploadId", upload_id)],
+                                 body=complete)
+    assert st == 200, body
+    st, hdrs, got = client.request("GET", "/conformance/pnget",
+                                   query=[("partNumber", "2")])
+    assert st == 206
+    assert got == part2
+    assert hdrs["x-amz-mp-parts-count"] == "2"
+    st, _, _ = client.request("GET", "/conformance/pnget",
+                              query=[("partNumber", "3")])
+    assert st == 416
+
+
+def test_checksum_stored_and_returned(client):
+    import base64
+    import zlib as _z
+
+    payload = os.urandom(5000)
+    crc = base64.b64encode(_z.crc32(payload).to_bytes(4, "big")).decode()
+    st, _, _ = client.request("PUT", "/conformance/ckobj", body=payload,
+                              headers={"x-amz-checksum-crc32": crc})
+    assert st == 200
+    # without checksum-mode: no checksum header
+    st, hdrs, _ = client.request("HEAD", "/conformance/ckobj")
+    assert "x-amz-checksum-crc32" not in hdrs
+    st, hdrs, _ = client.request("HEAD", "/conformance/ckobj",
+                                 headers={"x-amz-checksum-mode":
+                                          "ENABLED"})
+    assert hdrs.get("x-amz-checksum-crc32") == crc
