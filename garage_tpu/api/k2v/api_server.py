@@ -84,10 +84,13 @@ class K2VApiServer:
             raise no_such_bucket(bucket_name)
         bucket = await self.helper.get_existing_bucket(bucket_id)
 
-        if req.method in ("GET", "HEAD"):
-            allowed = api_key.allow_read(bucket_id)
-        else:
-            allowed = api_key.allow_write(bucket_id)
+        # PollRange is a READ despite traveling as POST (it carries a
+        # JSON body); gating it on write would both leak values to
+        # write-only keys and lock out read-only consumers
+        is_read = (req.method in ("GET", "HEAD")
+                   or (req.method == "POST" and "poll_range" in req.query))
+        allowed = (api_key.allow_read(bucket_id) if is_read
+                   else api_key.allow_write(bucket_id))
         if not allowed:
             raise access_denied()
 
@@ -110,6 +113,9 @@ class K2VApiServer:
                 return await batch_handlers.handle_insert_batch(ctx, req)
             raise S3Error("NotImplemented", 501,
                           f"unsupported K2V bucket operation {m}")
+        if m == "POST" and "poll_range" in q:
+            return await item_handlers.handle_poll_range(ctx, req,
+                                                         partition_key)
         if "sort_key" not in q:
             raise S3Error("InvalidRequest", 400, "sort_key is required")
         sort_key = q["sort_key"]

@@ -102,6 +102,42 @@ async def handle_delete_item(ctx, req: Request, partition_key: str,
     return Response(204)
 
 
+async def handle_poll_range(ctx, req: Request,
+                            partition_key: str) -> Response:
+    """POST /{bucket}/{partition}?poll_range — wait for changes in a
+    sort-key range vs a seen marker (ref: api/k2v poll_range +
+    model/k2v/seen.rs)."""
+    raw = await req.body.read_all(limit=1 << 20)
+    try:
+        spec = json.loads(raw.decode()) if raw else {}
+    except (ValueError, UnicodeDecodeError):
+        raise S3Error("InvalidRequest", 400, "body is not valid JSON")
+    try:
+        timeout = min(float(spec.get("timeout", 300)), 600.0)
+    except (TypeError, ValueError):
+        raise S3Error("InvalidRequest", 400, "bad timeout")
+    try:
+        res = await ctx.garage.k2v_rpc.poll_range(
+            ctx.bucket_id, partition_key,
+            spec.get("prefix"), spec.get("start"), spec.get("end"),
+            spec.get("seenMarker"), timeout)
+    except ValueError as e:
+        raise S3Error("InvalidRequest", 400, str(e))
+    if res is None:
+        return Response(304)
+    items, seen = res
+    body = json.dumps({
+        "items": [{
+            "sk": i.sort_key_str,
+            "ct": i.causal_context().serialize(),
+            "v": [None if v is None else base64.b64encode(v).decode()
+                  for v in i.values()],
+        } for i in items],
+        "seenMarker": seen,
+    }).encode()
+    return Response(200, [("content-type", "application/json")], body)
+
+
 async def handle_poll_item(ctx, req: Request, partition_key: str,
                            sort_key: str) -> Response:
     ct = parse_causality_token(req.query.get("causality_token", ""))

@@ -174,6 +174,36 @@ class K2vClient:
                 for v in json.loads(body.decode())]
         return K2vValue(hdrs[CAUSALITY_HEADER], vals)
 
+    def poll_range(self, pk: str, seen_marker: Optional[str] = None,
+                   prefix: Optional[str] = None,
+                   start: Optional[str] = None, end: Optional[str] = None,
+                   timeout: float = 300.0):
+        """Long-poll a sort-key range; -> (items, seen_marker) or None
+        on timeout. Items are dicts {sk, ct, v: [bytes|None]}."""
+        spec = {"timeout": timeout}
+        if seen_marker:
+            spec["seenMarker"] = seen_marker
+        if prefix is not None:
+            spec["prefix"] = prefix
+        if start is not None:
+            spec["start"] = start
+        if end is not None:
+            spec["end"] = end
+        st, _, body = self._req(
+            "POST", f"/{self.bucket}/{quote(pk, safe='')}",
+            query=[("poll_range", "")],
+            body=json.dumps(spec).encode(), timeout=timeout + 30.0)
+        if st == 304:
+            return None
+        if st != 200:
+            self._raise(st, body)
+        data = json.loads(body.decode())
+        items = [{"sk": i["sk"], "ct": i["ct"],
+                  "v": [None if v is None else base64.b64decode(v)
+                        for v in i["v"]]}
+                 for i in data["items"]]
+        return items, data["seenMarker"]
+
     # ---- index / batch -------------------------------------------------
 
     def read_index(self, prefix: Optional[str] = None,

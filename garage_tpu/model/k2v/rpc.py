@@ -50,6 +50,10 @@ class SubscriptionManager:
     def notify(self, item: K2VItem) -> None:
         with self._lock:
             waiters = self._events.pop(self._key(item), [])
+            # partition-level subscribers (PollRange) wake on ANY item
+            # change in the partition
+            waiters += self._events.pop(
+                (item.bucket_id, item.partition_key_str, None), [])
         for loop, ev in waiters:
             loop.call_soon_threadsafe(ev.set)
 
@@ -120,6 +124,44 @@ class K2VRpcHandler:
             for who, batch in by_nodes.items()
         ])
 
+    async def _poll_first_success(self, who: list[bytes], payload,
+                                  timeout: float, empty_key: str):
+        """Fan out a poll RPC; first non-empty response wins. Returns
+        None only for genuine peer-side timeouts — when every peer
+        failed HARD (unreachable etc.) this raises so the API answers
+        an error instead of disguising an outage as 'no changes'."""
+        async def one(node):
+            resp, _ = await self.endpoint.call(node, payload, PRIO_NORMAL,
+                                               timeout=timeout + 10.0)
+            if resp.get(empty_key) is None:
+                raise TimeoutError("poll timed out on peer")
+            return resp
+
+        tasks = [asyncio.create_task(one(n)) for n in who]
+        saw_timeout = False
+        errors: list[Exception] = []
+        try:
+            while tasks:
+                done, tasks_set = await asyncio.wait(
+                    tasks, return_when=asyncio.FIRST_COMPLETED)
+                tasks = list(tasks_set)
+                for t in done:
+                    e = t.exception()
+                    if e is None:
+                        return t.result()
+                    if isinstance(e, TimeoutError):
+                        saw_timeout = True
+                    else:
+                        errors.append(e)
+            if saw_timeout:
+                return None
+            raise RuntimeError(
+                f"poll failed on all {len(who)} storage nodes: "
+                f"{errors[:2]}")
+        finally:
+            for t in tasks:
+                t.cancel()
+
     async def poll_item(self, bucket_id: bytes, partition_key: str,
                         sort_key: str, causal_context: CausalContext,
                         timeout: float) -> Optional[K2VItem]:
@@ -130,29 +172,99 @@ class K2VRpcHandler:
                    "pk": partition_key, "sk": sort_key,
                    "ct": causal_context.serialize(),
                    "timeout_ms": int(timeout * 1000)}
-
-        async def one(node):
-            resp, _ = await self.endpoint.call(node, payload, PRIO_NORMAL,
-                                               timeout=timeout + 10.0)
-            if resp.get("item") is None:
-                raise TimeoutError("poll timed out on peer")
-            return resp["item"]
-
-        tasks = [asyncio.create_task(one(n)) for n in who]
-        try:
-            while tasks:
-                done, tasks_set = await asyncio.wait(
-                    tasks, return_when=asyncio.FIRST_COMPLETED)
-                tasks = list(tasks_set)
-                for t in done:
-                    if t.exception() is None:
-                        from ...utils import migrate
-
-                        return migrate.decode(K2VItem, t.result())
+        resp = await self._poll_first_success(who, payload, timeout,
+                                              "item")
+        if resp is None:
             return None
-        finally:
-            for t in tasks:
-                t.cancel()
+        from ...utils import migrate
+
+        return migrate.decode(K2VItem, resp["item"])
+
+    async def poll_range(self, bucket_id: bytes, partition_key: str,
+                         prefix: Optional[str], start: Optional[str],
+                         end: Optional[str], seen_str: Optional[str],
+                         timeout: float):
+        """Wait until any item in the range changes vs the seen marker;
+        -> (changed items, new marker string) or None on timeout
+        (ref: rpc.rs poll_range + seen.rs RangeSeenMarker)."""
+        from .seen import RangeSeenMarker
+
+        if RangeSeenMarker.parse(seen_str or "") is None:
+            raise ValueError("bad seen marker")
+        who = self._storage_nodes(bucket_id, partition_key)
+        payload = {"op": "poll_range", "bucket": bucket_id,
+                   "pk": partition_key, "prefix": prefix, "start": start,
+                   "end": end, "seen": seen_str or "",
+                   "timeout_ms": int(timeout * 1000)}
+        resp = await self._poll_first_success(who, payload, timeout,
+                                              "items")
+        if resp is None:
+            return None
+        from ...utils import migrate
+
+        items = [migrate.decode(K2VItem, raw) for raw in resp["items"]]
+        return items, resp["seen"]
+
+    _POLL_PAGE = 500
+    _POLL_MAX_CHANGED = 1000
+
+    def _range_changed(self, bucket_id: bytes, pk: str,
+                       prefix: Optional[str], start: Optional[str],
+                       end: Optional[str], marker) -> list[K2VItem]:
+        """Scan the WHOLE range in pages — a one-page horizon would make
+        items past it permanently invisible to pollers. Output is capped
+        (the marker only advances for returned items, so the remainder
+        re-surfaces immediately on the next poll)."""
+        data = self.item_table.data
+        out: list[K2VItem] = []
+        cursor = start.encode() if start else None
+        while True:
+            raws = data.read_range(
+                partition_pk(bucket_id, pk), cursor, None,
+                self._POLL_PAGE,
+                prefix_sk=prefix.encode() if prefix else None,
+                end_sk=end.encode() if end else None)
+            last_sk = None
+            for raw in raws:
+                item = data.decode_stored(raw)
+                last_sk = item.sort_key()
+                if marker.is_new(item.sort_key_str,
+                                 item.causal_context()):
+                    out.append(item)
+                    if len(out) >= self._POLL_MAX_CHANGED:
+                        return out
+            if len(raws) < self._POLL_PAGE or last_sk is None:
+                return out
+            cursor = last_sk + b"\x00"
+
+    async def _handle_poll_range(self, bucket_id: bytes, pk: str,
+                                 prefix, start, end, seen_str: str,
+                                 timeout: float):
+        from .seen import RangeSeenMarker
+
+        marker = RangeSeenMarker.parse(seen_str)
+        if marker is None:
+            raise ValueError("bad seen marker")
+        deadline = time.monotonic() + timeout
+        while True:
+            ev = self.subscriptions.subscribe(bucket_id, pk, None)
+            try:
+                changed = self._range_changed(bucket_id, pk, prefix,
+                                              start, end, marker)
+                if changed:
+                    for item in changed:
+                        marker.update(item.sort_key_str,
+                                      item.causal_context())
+                    return changed, marker.serialize()
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                try:
+                    await asyncio.wait_for(ev.wait(), remaining)
+                except asyncio.TimeoutError:
+                    return None
+            finally:
+                self.subscriptions.unsubscribe(bucket_id, pk, None, ev)
 
     # ---- local application --------------------------------------------
 
@@ -221,6 +333,18 @@ class K2VRpcHandler:
             from ...utils import migrate
 
             return {"item": migrate.encode(item) if item else None}
+        if op == "poll_range":
+            res = await self._handle_poll_range(
+                payload["bucket"], payload["pk"], payload.get("prefix"),
+                payload.get("start"), payload.get("end"),
+                payload.get("seen", ""), payload["timeout_ms"] / 1000.0)
+            if res is None:
+                return {"items": None, "seen": None}
+            from ...utils import migrate
+
+            items, seen = res
+            return {"items": [migrate.encode(i) for i in items],
+                    "seen": seen}
         raise ValueError(f"unknown k2v op {op!r}")
 
     async def _handle_poll(self, bucket_id: bytes, pk: str, sk: str,

@@ -299,3 +299,66 @@ def test_k2v_reverse_prefix_and_pagination(tmp_path):
             await stop_all(garages, tasks)
 
     run(main())
+
+
+def test_k2v_poll_range_wakes_and_resumes(tmp_path):
+    async def main():
+        net, garages, tasks = await make_garage_cluster(tmp_path, n=3, rf=3)
+        g0 = garages[0]
+        try:
+            bucket_id = gen_uuid()
+            await g0.k2v_rpc.insert(bucket_id, "p", "a1", None, b"v1")
+            # first poll with empty marker returns existing items
+            res = await g0.k2v_rpc.poll_range(
+                bucket_id, "p", None, None, None, None, timeout=5.0)
+            assert res is not None
+            items, marker = res
+            assert [i.sort_key_str for i in items] == ["a1"]
+
+            # nothing new -> timeout
+            res2 = await garages[1].k2v_rpc.poll_range(
+                bucket_id, "p", None, None, None, marker, timeout=0.5)
+            assert res2 is None
+
+            # a write in range wakes the poller
+            async def poller():
+                return await garages[1].k2v_rpc.poll_range(
+                    bucket_id, "p", None, None, None, marker,
+                    timeout=20.0)
+
+            task = asyncio.create_task(poller())
+            await asyncio.sleep(0.2)
+            assert not task.done()
+            await g0.k2v_rpc.insert(bucket_id, "p", "a2", None, b"v2")
+            got = await asyncio.wait_for(task, 20.0)
+            assert got is not None
+            items2, marker2 = got
+            assert any(i.sort_key_str == "a2" for i in items2)
+
+            # prefix filter excludes out-of-range writes
+            res3_task = asyncio.create_task(garages[2].k2v_rpc.poll_range(
+                bucket_id, "p", "a", None, None, marker2, timeout=1.0))
+            await asyncio.sleep(0.1)
+            await g0.k2v_rpc.insert(bucket_id, "p", "zzz", None, b"out")
+            res3 = await asyncio.wait_for(res3_task, 10.0)
+            assert res3 is None  # 'zzz' not under prefix 'a'
+        finally:
+            await stop_all(garages, tasks)
+
+    run(main())
+
+
+def test_seen_marker_roundtrip():
+    from garage_tpu.model.k2v.causality import CausalContext
+    from garage_tpu.model.k2v.seen import RangeSeenMarker
+
+    m = RangeSeenMarker()
+    m.update("k1", CausalContext({5: 10}))
+    m.update("k2", CausalContext({5: 3, 9: 1}))
+    m2 = RangeSeenMarker.parse(m.serialize())
+    assert m2.seen == m.seen
+    assert not m2.is_new("k1", CausalContext({5: 10}))
+    assert m2.is_new("k1", CausalContext({5: 11}))
+    assert m2.is_new("k3", CausalContext({1: 1}))
+    assert RangeSeenMarker.parse("!!bad!!") is None
+    assert RangeSeenMarker.parse("").seen == {}

@@ -1371,3 +1371,28 @@ def test_checksum_stored_and_returned(client):
                                  headers={"x-amz-checksum-mode":
                                           "ENABLED"})
     assert hdrs.get("x-amz-checksum-crc32") == crc
+
+
+def test_k2v_poll_range_api(server, k2v):
+    import threading
+
+    k2v.insert_item("pr", "x1", b"one")
+    res = k2v.poll_range("pr", timeout=5.0)
+    assert res is not None
+    items, marker = res
+    assert [i["sk"] for i in items] == ["x1"]
+    got = {}
+
+    def poller():
+        got["res"] = k2v.poll_range("pr", seen_marker=marker,
+                                    timeout=20.0)
+
+    t = threading.Thread(target=poller)
+    t.start()
+    time.sleep(0.5)
+    k2v.insert_item("pr", "x2", b"two")
+    t.join(timeout=25.0)
+    assert not t.is_alive()
+    assert got["res"] is not None
+    items2, _ = got["res"]
+    assert any(i["sk"] == "x2" for i in items2)
