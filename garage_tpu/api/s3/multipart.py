@@ -118,7 +118,7 @@ async def handle_put_part(ctx, req: Request) -> Response:
         raise S3Error("EntityTooSmall", 400, "empty part")
     md5 = hashlib.md5()
     try:
-        total, etag, _first_hash = await read_and_put_blocks(
+        total, _md5_hex, etag, _first_hash = await read_and_put_blocks(
             ctx.garage, version, part_number, first, chunker, md5,
             checksummer=checksummer, sse_key=sse_key)
         if checksummer is not None \
@@ -240,7 +240,7 @@ async def handle_upload_part_copy(ctx, req: Request) -> Response:
         first = await chunker.next()
         if first is None:
             raise S3Error("InvalidRequest", 400, "empty copy source")
-        total, etag, _ = await read_and_put_blocks(
+        total, _md5_hex, etag, _ = await read_and_put_blocks(
             ctx.garage, version, part_number, first, chunker, md5,
             sse_key=dst_sse)
     except BaseException:

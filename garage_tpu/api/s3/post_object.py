@@ -166,8 +166,13 @@ def _check_policy(policy_raw: bytes,
         elif isinstance(cond, list) and len(cond) == 3:
             op, k, v = cond
             if op == "content-length-range":
-                length[0] = max(length[0], int(k))
-                length[1] = min(length[1], int(v))
+                try:
+                    length[0] = max(length[0], int(k))
+                    length[1] = min(length[1], int(v))
+                except (TypeError, ValueError):
+                    raise S3Error("InvalidPolicyDocument", 400,
+                                  "content-length-range bounds must be "
+                                  "integers")
                 continue
             if not isinstance(k, str) or not k.startswith("$") \
                     or op not in ("eq", "starts-with"):

@@ -121,6 +121,11 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
             checksummer.update(first_block)
             if checksummer.b64() != expected_checksum[1]:
                 raise bad_request("checksum mismatch")
+        if sse_key is not None:
+            # never record/expose the plaintext MD5 of an encrypted
+            # object: a queryable plaintext digest lets any reader
+            # dictionary-attack SSE-C content (ref: encryption.rs:210)
+            etag = ssec_etag()
         meta = ObjectVersionMeta(headers, len(first_block), etag)
         blob = (sse_key.encrypt_block(first_block) if sse_key is not None
                 else first_block)
@@ -137,10 +142,11 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
     await garage.version_table.insert(version)
 
     try:
-        total, etag, first_hash = await read_and_put_blocks(
+        total, md5_hex, etag, first_hash = await read_and_put_blocks(
             garage, version, 1, first_block, chunker, md5,
             checksummer=checksummer, sse_key=sse_key)
-        if content_md5 is not None and not _md5_matches(content_md5, etag):
+        if content_md5 is not None \
+                and not _md5_matches(content_md5, md5_hex):
             raise bad_request("Content-MD5 mismatch")
         if checksummer is not None \
                 and checksummer.b64() != expected_checksum[1]:
@@ -162,6 +168,14 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
         raise
 
 
+def ssec_etag() -> str:
+    """Random ETag for SSE-C objects (ref: encryption.rs:210-222) —
+    32 hex chars so complete-multipart's bytes.fromhex still works."""
+    import os
+
+    return os.urandom(16).hex()
+
+
 def _md5_matches(content_md5_b64: str, etag_hex: str) -> bool:
     import base64
 
@@ -179,7 +193,13 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
     block. With `sse_key`, blocks are AES-GCM encrypted before hashing
     and storage (the content address covers the ciphertext, so scrub
     verifies without the key); the version's block map keeps PLAINTEXT
-    sizes so range reads address plaintext offsets."""
+    sizes so range reads address plaintext offsets.
+
+    -> (total_size, md5_hex, etag, first_hash). `md5_hex` is the
+    plaintext MD5 for Content-MD5 validation only; `etag` is what may
+    be stored/exposed — randomized here, structurally, whenever
+    sse_key is set (ref: encryption.rs:210-222), so no call site can
+    forget and leak the plaintext digest."""
     sem = asyncio.Semaphore(PUT_BLOCKS_MAX_PARALLEL)
     tasks: list[asyncio.Task] = []
     offset = 0
@@ -234,7 +254,9 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
         if tasks:
             await asyncio.gather(*tasks, return_exceptions=True)
         raise
-    return offset, md5.hexdigest(), first_hash
+    md5_hex = md5.hexdigest()
+    etag = ssec_etag() if sse_key is not None else md5_hex
+    return offset, md5_hex, etag, first_hash
 
 
 async def handle_put(ctx, req: Request) -> Response:
@@ -290,10 +312,19 @@ async def handle_copy(ctx, req: Request) -> Response:
         raise S3Error("NoSuchKey", 404, src_key)
 
     from .encryption import (check_key_for_meta, copy_source_sse_key,
-                             request_sse_key)
+                             meta_is_encrypted, request_sse_key)
 
     src_sse_hdr = copy_source_sse_key(req)
     dst_sse = request_sse_key(req)
+    if src_sse_hdr is None and dst_sse is None \
+            and meta_is_encrypted(src_v.state.data.meta):
+        # don't silently duplicate ciphertext the caller can't prove it
+        # can read (ref: encryption.rs check_decrypt_common)
+        raise S3Error(
+            "InvalidRequest", 400,
+            "source object is SSE-C encrypted; "
+            "x-amz-copy-source-server-side-encryption-customer-* "
+            "headers are required")
     if src_sse_hdr is not None or dst_sse is not None:
         # encryption boundary crossing: stream the source plaintext
         # through the normal save path, re-encrypting under the

@@ -30,6 +30,11 @@ log = logging.getLogger("garage_tpu.model.k2v")
 _TIMESTAMP_KEY = b"timestamp"
 
 
+class PeerPollTimeout(Exception):
+    """A storage node answered 'nothing changed within your window' —
+    distinct from a transport timeout reaching that node."""
+
+
 class SubscriptionManager:
     """Wakes local pollers when an item changes (ref: k2v/sub.rs).
 
@@ -134,7 +139,10 @@ class K2VRpcHandler:
             resp, _ = await self.endpoint.call(node, payload, PRIO_NORMAL,
                                                timeout=timeout + 10.0)
             if resp.get(empty_key) is None:
-                raise TimeoutError("poll timed out on peer")
+                # dedicated sentinel: on py3.11+ asyncio.TimeoutError IS
+                # TimeoutError, so a transport timeout to an unreachable
+                # node must not masquerade as a peer-side "no changes"
+                raise PeerPollTimeout("poll timed out on peer")
             return resp
 
         tasks = [asyncio.create_task(one(n)) for n in who]
@@ -149,7 +157,7 @@ class K2VRpcHandler:
                     e = t.exception()
                     if e is None:
                         return t.result()
-                    if isinstance(e, TimeoutError):
+                    if isinstance(e, PeerPollTimeout):
                         saw_timeout = True
                     else:
                         errors.append(e)

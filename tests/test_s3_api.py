@@ -1121,6 +1121,42 @@ def test_ssec_inline_object(client):
     assert st == 400
 
 
+def test_ssec_etag_hides_plaintext_md5(client):
+    """SSE-C ETags must not be the plaintext MD5 (a queryable plaintext
+    digest would let readers dictionary-attack encrypted content)."""
+    import hashlib
+
+    small = b"guessable secret"          # inline path
+    big = b"B" * 50_000                  # streamed path
+    st, hdrs, _ = client.request("PUT", "/conformance/etag-sec-inline",
+                                 body=small, headers=_sse_headers())
+    assert st == 200
+    assert hdrs["etag"].strip('"') != hashlib.md5(small).hexdigest()
+    st, hdrs, _ = client.request("PUT", "/conformance/etag-sec-big",
+                                 body=big, headers=_sse_headers())
+    assert st == 200
+    assert hdrs["etag"].strip('"') != hashlib.md5(big).hexdigest()
+    # list must show the randomized etag too
+    st, _, body = client.request("GET", "/conformance",
+                                 query=[("list-type", "2"),
+                                        ("prefix", "etag-sec-")])
+    assert st == 200
+    assert hashlib.md5(small).hexdigest().encode() not in body
+    assert hashlib.md5(big).hexdigest().encode() not in body
+
+
+def test_copy_ssec_source_requires_key(client):
+    """Plain CopyObject of an SSE-C object (no SSE headers at all) must
+    be rejected, not silently duplicate ciphertext."""
+    assert client.request("PUT", "/conformance/enc-nokey-src",
+                          body=b"s" * 9000,
+                          headers=_sse_headers())[0] == 200
+    st, _, body = client.request(
+        "PUT", "/conformance/enc-nokey-dst",
+        headers={"x-amz-copy-source": "/conformance/enc-nokey-src"})
+    assert st == 400 and b"InvalidRequest" in body
+
+
 def test_upload_part_copy(client):
     src = os.urandom(150_000)
     assert client.request("PUT", "/conformance/upc-src", body=src)[0] == 200
@@ -1246,6 +1282,25 @@ def test_post_object_upload(server, client):
         conn.close()
     st, _, got = client.request("GET", "/conformance/posted/hello.bin")
     assert st == 200 and got == payload
+
+
+def test_post_object_bad_length_range_bounds(server):
+    import http.client
+
+    body, ctype = _post_policy_form(
+        server, "conformance", "p3/x", b"data",
+        extra_conditions=[["content-length-range", "zero", "many"]])
+    conn = http.client.HTTPConnection("127.0.0.1", server.s3_port,
+                                      timeout=30)
+    try:
+        conn.request("POST", "/conformance", body=body,
+                     headers={"content-type": ctype})
+        r = conn.getresponse()
+        # must be a 400 InvalidPolicyDocument, not an uncaught 500
+        assert r.status == 400, r.read()
+        assert b"InvalidPolicyDocument" in r.read()
+    finally:
+        conn.close()
 
 
 def test_post_object_bad_signature_and_policy(server):
