@@ -139,6 +139,11 @@ class DeviceFeeder:
 
     def __init__(self, codec=None, mode: str = "auto"):
         self.codec = codec
+        if mode == "auto" and os.environ.get("GARAGE_TPU_DEVICE") == "off":
+            # test/CI kill-switch: never probe, never spawn calibration
+            # threads (a probed tunnel leaves C++ threads that abort on
+            # interpreter teardown — the r3 rc=134)
+            mode = "off"
         self.mode = mode
         self._q: Optional[asyncio.Queue] = None
         self._task: Optional[asyncio.Task] = None
@@ -146,12 +151,15 @@ class DeviceFeeder:
         self._probing = False
         self._calibrating = False
         self.stats = {"batches": 0, "items": 0, "device_batches": 0,
-                      "device_items": 0, "max_batch": 0}
+                      "device_items": 0, "inline_items": 0, "max_batch": 0}
         # calibration: (op, backend) -> [bytes, seconds]; routing picks
         # the backend with the best observed bytes/s, exploring the
         # other every _EXPLORE_EVERY batches
         self._perf: dict[tuple[str, str], list[float]] = {}
+        self._perf_lock = threading.Lock()  # inline (loop) vs worker thread
         self._routed: dict[str, int] = {}
+        self._inline_calls: dict[str, int] = {}
+        self._force_device: dict[str, bool] = {}
 
     def perf_summary(self) -> dict[str, float]:
         """Observed MB/s per (op, backend) — /metrics + bench surface."""
@@ -273,6 +281,18 @@ class DeviceFeeder:
 
     async def hash(self, data: bytes) -> bytes:
         """Content hash of one block (batched with concurrent callers)."""
+        if self._host_inline_ok("hash"):
+            from ..utils import data as _data
+
+            if _data._content_algo == "blake3":
+                from .. import native
+
+                self.stats["inline_items"] += 1
+                t0 = time.perf_counter()
+                out = native.blake3_many([data])[0]
+                self._record("hash", "host", len(data),
+                             time.perf_counter() - t0)
+                return out
         return await self._submit("hash", data)
 
     async def encode(self, packed: bytes) -> list[bytes]:
@@ -281,11 +301,81 @@ class DeviceFeeder:
             raise RuntimeError("feeder has no codec")
         return await self._submit("encode", packed)
 
+    def _host_inline_ok(self, op: str) -> bool:
+        """True when the queue+thread hop is pure overhead: the route is
+        host anyway and the native kernel (which releases the GIL) can
+        run inline on the event loop. The queue path exists to build
+        device batches; paying two thread handoffs per item to then run
+        host-side was a top cost in the r3 kernel-vs-system gap."""
+        from .. import native
+
+        if not native.loaded():
+            return False
+        if self.mode == "off":
+            return True
+        if self.mode == "require" or self._device_ok is None:
+            return False  # device mandatory / probe still undecided
+        if self._device_ok is False:
+            return True
+        dev = self._perf.get((op, "device"))
+        host = self._perf.get((op, "host"))
+        if dev and host and dev[0] / dev[1] < host[0] / host[1]:
+            # host is winning on data; still send every Nth call through
+            # the queue WITH a forced device trial (own counter — sharing
+            # _routed with _pick_backend made the re-probe unreachable)
+            self._inline_calls[op] = self._inline_calls.get(op, 0) + 1
+            if self._inline_calls[op] % _EXPLORE_EVERY == 0:
+                self._force_device[op] = True
+                return False
+            return True
+        return False
+
+    async def encode_put(self, data: bytes, prefix: bytes = b"") -> list:
+        """Erasure parts for one packed block (logical stream
+        prefix||data), each framed as a ready-to-send shard payload
+        (pack_shard format). The host path is ONE GIL-released native
+        call per block (split + parity + crc + headers fused:
+        native.rs_encode_packed); the device path batches the parity
+        matmul through XLA then packs host-side."""
+        if self.codec is None:
+            raise RuntimeError("feeder has no codec")
+        if self._host_inline_ok("encode"):
+            from .. import native
+            from ..ops import rs
+
+            self.stats["inline_items"] += 1
+            t0 = time.perf_counter()
+            out = native.rs_encode_packed(
+                data, self.codec.k, self.codec.m,
+                rs.parity_matrix(self.codec.k, self.codec.m), prefix=prefix)
+            self._record("encode", "host", len(prefix) + len(data),
+                         time.perf_counter() - t0)
+            return out
+        return await self._submit("encode_put", (prefix, data))
+
     async def verify_blocks(self, items: list[tuple[bytes, bytes]]
                             ) -> list[bool]:
         """[(hash32, plain)] -> per-item content-hash match (scrub)."""
         if not items:
             return []
+        if self._host_inline_ok("hash"):
+            from ..utils import data as _data
+
+            if _data._content_algo == "blake3":
+                from .. import native
+                from ..utils.data import content_hash_matches
+
+                self.stats["inline_items"] += len(items)
+                t0 = time.perf_counter()
+                # already batched -> one thread handoff is amortized;
+                # running it inline would park the event loop for the
+                # whole multi-MiB native call, every scrub batch
+                digs = await asyncio.to_thread(
+                    native.blake3_many, [d for _, d in items])
+                self._record("hash", "host", sum(len(d) for _, d in items),
+                             time.perf_counter() - t0)
+                return [dg == h or content_hash_matches(d, h)
+                        for dg, (h, d) in zip(digs, items)]
         futs = [self._submit("verify", (h, d)) for h, d in items]
         return list(await asyncio.gather(*futs))
 
@@ -336,8 +426,12 @@ class DeviceFeeder:
     # ---- batch execution (worker thread) -------------------------------
 
     def _pick_backend(self, op: str, total_bytes: int, n_items: int) -> str:
+        if self.mode == "require":
+            return "device"  # forced: bench/test proof of the device path
         if self._device_ok is not True or self._calibrating:
             return "host"
+        if self._force_device.pop(op, False):
+            return "device"  # inline fast-path escape: re-probe now
         if total_bytes < _DEVICE_MIN_BYTES and n_items < _DEVICE_MIN_ITEMS:
             return "host"  # tiny batches never amortize a round trip
         self._routed[op] = self._routed.get(op, 0) + 1
@@ -355,13 +449,14 @@ class DeviceFeeder:
                 else "host")
 
     def _record(self, op: str, backend: str, nbytes: int, dt: float) -> None:
-        ent = self._perf.setdefault((op, backend), [0.0, 0.0])
-        # exponential forgetting so old (e.g. cold-compile) samples fade
-        if ent[1] > 30.0:
-            ent[0] *= 0.5
-            ent[1] *= 0.5
-        ent[0] += nbytes
-        ent[1] += max(dt, 1e-6)
+        with self._perf_lock:  # inline paths record from the loop thread
+            ent = self._perf.setdefault((op, backend), [0.0, 0.0])
+            # exponential forgetting so old (cold-compile) samples fade
+            if ent[1] > 30.0:
+                ent[0] *= 0.5
+                ent[1] *= 0.5
+            ent[0] += nbytes
+            ent[1] += max(dt, 1e-6)
 
     def _run_batch(self, batch: list[_Item], force_host: bool = False
                    ) -> list:
@@ -374,12 +469,13 @@ class DeviceFeeder:
             by_op.setdefault(item.op, []).append(i)
         for op, idxs in by_op.items():
             blobs = [batch[i].data for i in idxs]
-            if op == "verify":
+            if op in ("verify", "encode_put"):  # items are 2-tuples
                 total = sum(len(b) for _, b in blobs)
             else:
                 total = sum(len(b) for b in blobs
                             if isinstance(b, (bytes, bytearray)))
-            perf_op = "hash" if op == "verify" else op
+            perf_op = ("hash" if op == "verify" else
+                       "encode" if op == "encode_put" else op)
             host_only = force_host
             if perf_op == "hash":
                 from ..utils import data as _data
@@ -428,6 +524,8 @@ class DeviceFeeder:
                     for d, (h, b) in zip(digs, blobs)]
         if op == "encode":
             return self._do_encode(blobs, backend)
+        if op == "encode_put":
+            return self._do_encode_put(blobs, backend)
         raise RuntimeError(f"unknown feeder op {op!r}")
 
     def _do_hash(self, blobs: list[bytes], backend: str) -> list[bytes]:
@@ -449,6 +547,35 @@ class DeviceFeeder:
         from ..utils.data import blake3sum
 
         return [blake3sum(b) for b in blobs]
+
+    def _do_encode_put(self, items: list[tuple[bytes, bytes]], backend: str
+                       ) -> list[list]:
+        """items = [(prefix, data)]; like _do_encode but each part is a
+        complete shard payload (pack_shard framing, crc32c). Host+native
+        is the PUT hot path."""
+        from .manager import pack_shard
+
+        codec = self.codec
+        if backend != "device":
+            try:
+                from .. import native
+
+                if native.available():
+                    from ..ops import rs
+
+                    pmat = rs.parity_matrix(codec.k, codec.m)
+                    return [native.rs_encode_packed(d, codec.k, codec.m,
+                                                    pmat, prefix=p)
+                            for p, d in items]
+            except Exception:
+                pass
+        # device, or host without native: delegate the encode itself to
+        # _do_encode (single source of truth) and wrap with pack_shard
+        blocks = [p + d for p, d in items]
+        parts_lists = (codec.encode_batch(blocks) if backend == "device"
+                       else self._do_encode(blocks, backend))
+        return [[pack_shard(pp, len(b)) for pp in parts]
+                for b, parts in zip(blocks, parts_lists)]
 
     def _do_encode(self, blocks: list[bytes], backend: str
                    ) -> list[list[bytes]]:

@@ -243,11 +243,13 @@ class BlockManager:
                            else compress)
             blk = (await asyncio.to_thread(DataBlock.compress, data)
                    if do_compress else DataBlock.plain(data))
-            packed = blk.pack()
             if self.erasure:
-                await self._put_erasure(hash32, packed)
+                # the 1-byte DataBlock header travels as a prefix so the
+                # megabyte payload is never concat-copied host-side
+                await self._put_erasure(hash32, bytes([blk.compression]),
+                                        blk.bytes)
             else:
-                await self._put_replicate(hash32, packed)
+                await self._put_replicate(hash32, blk.pack())
         finally:
             self._ram_sem.release(len(data))
 
@@ -263,8 +265,13 @@ class BlockManager:
                                 timeout=60.0),
             )
 
-    async def _put_erasure(self, hash32: bytes, packed: bytes) -> None:
-        parts = await self.feeder.encode(packed)
+    async def _put_erasure(self, hash32: bytes, prefix: bytes,
+                           data: bytes) -> None:
+        payloads = await self.feeder.encode_put(data, prefix=prefix)
+        # materialize once: msgpack needs bytes, and doing it in
+        # make_call would re-copy the shard on every retry
+        payloads = [p if isinstance(p, bytes) else bytes(p)
+                    for p in payloads]
         helper = self.system.layout_helper
         with helper.write_lock():
             # One shard placement per live layout version, mirroring
@@ -290,7 +297,7 @@ class BlockManager:
                 make_call=lambda key: self.endpoint.call(
                     key[0],
                     {"op": "put", "hash": hash32, "part": key[1],
-                     "data": pack_shard(parts[key[1]], len(packed))},
+                     "data": payloads[key[1]]},
                     PRIO_NORMAL, timeout=60.0,
                 ),
             )

@@ -23,6 +23,9 @@ log = logging.getLogger("garage_tpu.server")
 
 
 async def run_server(cfg_path: str) -> None:
+    from ..utils.runtime import tune
+
+    tune()
     cfg = read_config(cfg_path)
     garage = Garage(cfg)
     admin = AdminRpcHandler(garage)

@@ -79,6 +79,11 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     lib.crc64nvme_update.argtypes = [ctypes.c_uint64, ctypes.c_char_p,
                                      ctypes.c_uint64]
     lib.crc64nvme_update.restype = ctypes.c_uint64
+    lib.rs_encode_block_packed.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+    ]
     return lib
 
 
@@ -184,6 +189,32 @@ def crc64nvme(data: bytes, crc: int = 0) -> int:
     if lib is None:
         raise RuntimeError("native library unavailable")
     return lib.crc64nvme_update(crc, data, len(data))
+
+
+SHARD_HDR_LEN = 16  # [magic 4][block_len u64 BE][crc32c u32 BE]
+
+
+def rs_encode_packed(block: bytes, k: int, m: int, pmat: np.ndarray,
+                     prefix: bytes = b"") -> list[memoryview]:
+    """One GIL-released call: split the logical stream prefix||block into
+    k shards, compute m parity shards (pmat = (m, k) GF(2^8) parity
+    matrix), and return the k+m ready-to-send shard payloads in the
+    block store's shard file format (crc32c flavor) as zero-copy views
+    over one buffer. `prefix` carries the tiny DataBlock header so the
+    caller never concatenates it onto the megabyte payload."""
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    total = len(prefix) + len(block)
+    shard_len = (total + k - 1) // k
+    stride = SHARD_HDR_LEN + shard_len
+    pmat = np.ascontiguousarray(pmat, dtype=np.uint8)
+    out = np.empty((k + m) * stride, dtype=np.uint8)
+    lib.rs_encode_block_packed(prefix, len(prefix), block, len(block),
+                               k, m, pmat.ctypes.data, shard_len,
+                               out.ctypes.data)
+    view = memoryview(out.data).cast("B")
+    return [view[i * stride:(i + 1) * stride] for i in range(k + m)]
 
 
 def gf_matmul(mat: np.ndarray, x: np.ndarray) -> np.ndarray:

@@ -20,6 +20,11 @@
 #include <stddef.h>
 #include <string.h>
 
+#if defined(__x86_64__) || defined(__i386__)
+#include <immintrin.h>
+#define GT_X86 1
+#endif
+
 /* ================= BLAKE3 ================= */
 
 static const uint32_t IV[8] = {
@@ -228,7 +233,36 @@ static void crc_init(void) {
     crc_ready = 1;
 }
 
+#ifdef GT_X86
+/* SSE4.2 CRC32C: the crc32 instruction computes the Castagnoli
+ * polynomial directly, ~20x the slice-by-8 table walk. */
+__attribute__((target("sse4.2")))
+static uint32_t crc32c_hw(uint32_t crc, const uint8_t *p, uint64_t len) {
+    uint64_t c = ~crc;
+    while (len >= 8) {
+        uint64_t w;
+        memcpy(&w, p, 8);
+        c = _mm_crc32_u64(c, w);
+        p += 8;
+        len -= 8;
+    }
+    uint32_t c32 = (uint32_t)c;
+    while (len--)
+        c32 = _mm_crc32_u8(c32, *p++);
+    return ~c32;
+}
+
+static int cpu_sse42 = -1;
+static int cpu_avx2 = -1;
+#endif
+
 uint32_t crc32c_update(uint32_t crc, const uint8_t *p, uint64_t len) {
+#ifdef GT_X86
+    if (cpu_sse42 < 0)
+        cpu_sse42 = __builtin_cpu_supports("sse4.2") ? 1 : 0;
+    if (cpu_sse42)
+        return crc32c_hw(crc, p, len);
+#endif
     if (!crc_ready)
         crc_init();
     crc = ~crc;
@@ -268,27 +302,149 @@ uint64_t crc64nvme_update(uint64_t crc, const uint8_t *p, uint64_t len) {
     return ~crc;
 }
 
+/* Nibble tables for the PSHUFB formulation (ISA-L style): for each
+ * coefficient c, NIB[c] holds two 16-byte tables L, H with
+ * c*v = L[v & 0xF] ^ H[v >> 4]. 8 KiB total, built with GFMUL. */
+static uint8_t NIB[256][32];
+static int nib_ready = 0;
+
+static void nib_init(void) {
+    if (!gf_ready)
+        gf_init();
+    for (int c = 0; c < 256; c++) {
+        for (int v = 0; v < 16; v++) {
+            NIB[c][v] = GFMUL[c][v];
+            NIB[c][16 + v] = GFMUL[c][v << 4];
+        }
+    }
+    nib_ready = 1;
+}
+
+static void gf_axpy_scalar(uint8_t c, const uint8_t *x, uint8_t *o,
+                           int64_t n) {
+    const uint8_t *tab = GFMUL[c];
+    if (c == 1) {
+        for (int64_t t = 0; t < n; t++)
+            o[t] ^= x[t];
+    } else {
+        for (int64_t t = 0; t < n; t++)
+            o[t] ^= tab[x[t]];
+    }
+}
+
+#ifdef GT_X86
+/* o[0..n) ^= c * x[0..n) over GF(2^8), 32 bytes per step. */
+__attribute__((target("avx2")))
+static void gf_axpy_avx2(uint8_t c, const uint8_t *x, uint8_t *o,
+                         int64_t n) {
+    __m256i lo = _mm256_broadcastsi128_si256(
+        _mm_loadu_si128((const __m128i *)NIB[c]));
+    __m256i hi = _mm256_broadcastsi128_si256(
+        _mm_loadu_si128((const __m128i *)(NIB[c] + 16)));
+    __m256i mask = _mm256_set1_epi8(0x0F);
+    int64_t t = 0;
+    for (; t + 32 <= n; t += 32) {
+        __m256i v = _mm256_loadu_si256((const __m256i *)(x + t));
+        __m256i vl = _mm256_and_si256(v, mask);
+        __m256i vh = _mm256_and_si256(_mm256_srli_epi64(v, 4), mask);
+        __m256i p = _mm256_xor_si256(_mm256_shuffle_epi8(lo, vl),
+                                     _mm256_shuffle_epi8(hi, vh));
+        __m256i acc = _mm256_loadu_si256((const __m256i *)(o + t));
+        _mm256_storeu_si256((__m256i *)(o + t), _mm256_xor_si256(acc, p));
+    }
+    if (t < n)
+        gf_axpy_scalar(c, x + t, o + t, n - t);
+}
+#endif
+
+static void gf_axpy(uint8_t c, const uint8_t *x, uint8_t *o, int64_t n) {
+    if (c == 0)
+        return;
+#ifdef GT_X86
+    if (cpu_avx2 < 0)
+        cpu_avx2 = __builtin_cpu_supports("avx2") ? 1 : 0;
+    if (cpu_avx2 && c != 1 && n >= 64) {
+        gf_axpy_avx2(c, x, o, n);
+        return;
+    }
+#endif
+    gf_axpy_scalar(c, x, o, n);
+}
+
 /* out (r, n) = mat (r, s) @ x (s, n) over GF(2^8); rows contiguous. */
 void gf256_matmul(const uint8_t *mat, int64_t r, int64_t s,
                   const uint8_t *x, int64_t n, uint8_t *out) {
-    if (!gf_ready)
-        gf_init();
+    if (!nib_ready)
+        nib_init();
     for (int64_t i = 0; i < r; i++) {
         uint8_t *o = out + i * n;
         memset(o, 0, (size_t)n);
-        for (int64_t j = 0; j < s; j++) {
-            uint8_t c = mat[i * s + j];
-            if (c == 0)
-                continue;
-            const uint8_t *tab = GFMUL[c];
-            const uint8_t *xj = x + j * n;
-            if (c == 1) {
-                for (int64_t t = 0; t < n; t++)
-                    o[t] ^= xj[t];
-            } else {
-                for (int64_t t = 0; t < n; t++)
-                    o[t] ^= tab[xj[t]];
-            }
+        for (int64_t j = 0; j < s; j++)
+            gf_axpy(mat[i * s + j], x + j * n, o, n);
+    }
+}
+
+/* ================= one-call packed RS encode =================
+ * The PUT hot path: split `block` (block_len bytes) into k shards of
+ * shard_len (zero-padded tail), compute m parity shards with pmat
+ * (m x k, row-major), and emit k+m ready-to-send shard payloads at
+ * out + i*(16 + shard_len), each framed as the block store's shard
+ * file format (block/manager.py pack_shard, crc32c flavor):
+ *   [magic "GTS2"][block_len u64 BE][crc32c u32 BE][shard bytes]
+ * One GIL-released call replaces split_stripe + gf_matmul + per-shard
+ * pack_shard/crc (VERDICT r3 task 1: the kernel<->system gap). */
+void rs_encode_block_packed(const uint8_t *pfx, int64_t pfx_len,
+                            const uint8_t *block, int64_t data_len,
+                            int64_t k, int64_t m, const uint8_t *pmat,
+                            int64_t shard_len, uint8_t *out) {
+    const int64_t stride = 16 + shard_len;
+    const int64_t block_len = pfx_len + data_len;
+    /* data shards: copy straight from the logical stream pfx||block,
+     * zero-padding the tail (pfx is the 1-byte DataBlock header — taking
+     * it separately saves the caller a full-block concat copy) */
+    for (int64_t i = 0; i < k; i++) {
+        uint8_t *dst = out + i * stride + 16;
+        int64_t off = i * shard_len;
+        int64_t want = shard_len;
+        if (off < pfx_len) {
+            int64_t n = pfx_len - off < want ? pfx_len - off : want;
+            memcpy(dst, pfx + off, (size_t)n);
+            dst += n;
+            off += n;
+            want -= n;
         }
+        if (want > 0) {
+            int64_t doff = off - pfx_len;
+            int64_t have = data_len - doff;
+            if (have > want)
+                have = want;
+            if (have > 0) {
+                memcpy(dst, block + doff, (size_t)have);
+                dst += have;
+                want -= have;
+            }
+            if (want > 0)
+                memset(dst, 0, (size_t)want);
+        }
+    }
+    /* parity shards from the in-place data shards */
+    if (!nib_ready)
+        nib_init();
+    for (int64_t i = 0; i < m; i++) {
+        uint8_t *o = out + (k + i) * stride + 16;
+        memset(o, 0, (size_t)shard_len);
+        for (int64_t j = 0; j < k; j++)
+            gf_axpy(pmat[i * k + j], out + j * stride + 16, o, shard_len);
+    }
+    /* headers */
+    for (int64_t i = 0; i < k + m; i++) {
+        uint8_t *h = out + i * stride;
+        h[0] = 'G'; h[1] = 'T'; h[2] = 'S'; h[3] = '2';
+        uint64_t bl = (uint64_t)block_len;
+        for (int b = 0; b < 8; b++)
+            h[4 + b] = (uint8_t)(bl >> (56 - 8 * b));
+        uint32_t ck = crc32c_update(0, h + 16, (uint64_t)shard_len);
+        h[12] = (uint8_t)(ck >> 24); h[13] = (uint8_t)(ck >> 16);
+        h[14] = (uint8_t)(ck >> 8); h[15] = (uint8_t)ck;
     }
 }

@@ -9,6 +9,10 @@ so an explicit config.update is required — env vars are not enough.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# feeders in tests must never probe the real accelerator: the probe
+# subprocess would see the axon tunnel (which ignores JAX_PLATFORMS) and
+# start calibration threads whose C++ state aborts interpreter teardown
+os.environ["GARAGE_TPU_DEVICE"] = "off"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
