@@ -46,9 +46,12 @@ log = logging.getLogger("garage_tpu.block.feeder")
 # a (possibly remote) device round trip only pays above these sizes
 _DEVICE_MIN_BYTES = 4 << 20
 _DEVICE_MIN_ITEMS = 4
-# re-try the losing backend every N routed batches so a recovered
-# tunnel (or a warmed-up XLA program) gets re-discovered
-_EXPLORE_EVERY = 32
+# re-try the losing backend at most this often (wall clock) so a
+# recovered tunnel (or a warmed-up XLA program) gets re-discovered.
+# Time-based, not count-based: on a slow tunnel one exploration batch
+# can cost ~0.5 s, so a per-N-calls rule taxed busy traffic heavily
+# while an idle server never re-probed at all.
+_EXPLORE_SECS = 60.0
 # a batch stuck longer than this means the device backend hung (the
 # axon tunnel can block inside XLA calls); the batch re-runs host-side
 # and the device path is disabled
@@ -157,14 +160,23 @@ class DeviceFeeder:
         # other every _EXPLORE_EVERY batches
         self._perf: dict[tuple[str, str], list[float]] = {}
         self._perf_lock = threading.Lock()  # inline (loop) vs worker thread
-        self._routed: dict[str, int] = {}
-        self._inline_calls: dict[str, int] = {}
+        self._last_explore: dict[str, float] = {}
         self._force_device: dict[str, bool] = {}
 
     def perf_summary(self) -> dict[str, float]:
         """Observed MB/s per (op, backend) — /metrics + bench surface."""
-        return {f"{op}/{be}": round(b / t / 1e6, 1)
-                for (op, be), (b, t) in self._perf.items() if t > 0}
+        with self._perf_lock:
+            return {f"{op}/{be}": round(b / t / 1e6, 1)
+                    for (op, be), (b, t) in self._perf.items() if t > 0}
+
+    def _rates(self, op: str):
+        """(device_rate|None, host_rate|None) under the lock — readers
+        on the loop thread race _record in the worker thread."""
+        with self._perf_lock:
+            dev = self._perf.get((op, "device"))
+            host = self._perf.get((op, "host"))
+            return (dev[0] / dev[1] if dev else None,
+                    host[0] / host[1] if host else None)
 
     # ---- lifecycle ----------------------------------------------------
 
@@ -317,16 +329,28 @@ class DeviceFeeder:
             return False  # device mandatory / probe still undecided
         if self._device_ok is False:
             return True
-        dev = self._perf.get((op, "device"))
-        host = self._perf.get((op, "host"))
-        if dev and host and dev[0] / dev[1] < host[0] / host[1]:
-            # host is winning on data; still send every Nth call through
-            # the queue WITH a forced device trial (own counter — sharing
-            # _routed with _pick_backend made the re-probe unreachable)
-            self._inline_calls[op] = self._inline_calls.get(op, 0) + 1
-            if self._inline_calls[op] % _EXPLORE_EVERY == 0:
+        dev_rate, host_rate = self._rates(op)
+        if dev_rate is not None and host_rate is not None \
+                and dev_rate < host_rate:
+            # host is winning on data; still send an occasional call
+            # through the queue WITH a forced device trial so a
+            # recovered device gets re-discovered
+            if self._explore_due(op):
                 self._force_device[op] = True
                 return False
+            return True
+        return False
+
+    def _explore_due(self, op: str) -> bool:
+        now = time.monotonic()
+        if op not in self._last_explore:
+            # calibration just measured both backends — the clock starts
+            # there, not at zero (else the first production batch pays a
+            # pointless trial on the known-slow backend)
+            self._last_explore[op] = now
+            return False
+        if now - self._last_explore[op] >= _EXPLORE_SECS:
+            self._last_explore[op] = now
             return True
         return False
 
@@ -434,19 +458,15 @@ class DeviceFeeder:
             return "device"  # inline fast-path escape: re-probe now
         if total_bytes < _DEVICE_MIN_BYTES and n_items < _DEVICE_MIN_ITEMS:
             return "host"  # tiny batches never amortize a round trip
-        self._routed[op] = self._routed.get(op, 0) + 1
-        dev = self._perf.get((op, "device"))
-        host = self._perf.get((op, "host"))
-        if dev is None:
+        dev_rate, host_rate = self._rates(op)
+        if dev_rate is None:
             return "device"  # first sizeable batch: measure the device
-        if host is None:
+        if host_rate is None:
             return "host"
-        if self._routed[op] % _EXPLORE_EVERY == 0:
+        if self._explore_due(op):
             # periodic re-probe of whichever backend is currently losing
-            return ("device" if dev[0] / dev[1] < host[0] / host[1]
-                    else "host")
-        return ("device" if dev[0] / dev[1] >= host[0] / host[1]
-                else "host")
+            return "device" if dev_rate < host_rate else "host"
+        return "device" if dev_rate >= host_rate else "host"
 
     def _record(self, op: str, backend: str, nbytes: int, dt: float) -> None:
         with self._perf_lock:  # inline paths record from the loop thread

@@ -20,6 +20,7 @@ RPC ops on endpoint "garage_tpu/block":
 from __future__ import annotations
 
 import asyncio
+import itertools
 import logging
 import os
 import time
@@ -37,6 +38,9 @@ from .rc import BlockRc
 log = logging.getLogger("garage_tpu.block")
 
 INLINE_THRESHOLD = 3072  # ref: block/manager.rs:46
+
+_tmp_ctr = itertools.count()
+_TMP_MAX_AGE = 3600.0  # stale .tmpN orphans (crash mid-write) get swept
 
 _SHARD_MAGIC_V1 = b"GTS1"  # blake2-256 checksum (legacy)
 _SHARD_MAGIC_C32C = b"GTS2"  # crc32c (native slice-by-8 kernel)
@@ -426,7 +430,11 @@ class BlockManager:
 
     def _write_file(self, path: str, content: bytes) -> None:
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp"
+        # unique tmp per writer: two concurrent puts of the same
+        # content-addressed file must not steal each other's tmp (the
+        # reference serializes via hash-sharded mutexes, manager.rs:113;
+        # here either rename winning is fine — the bytes are identical)
+        tmp = path + f".tmp{next(_tmp_ctr)}"
         with open(tmp, "wb") as f:
             f.write(content)
             if self.fsync:
@@ -498,7 +506,7 @@ class BlockManager:
                 continue
             pre = hash32.hex() + ".s"
             for fn in os.listdir(d):
-                if fn.startswith(pre) and not fn.endswith(".tmp") \
+                if fn.startswith(pre) and ".tmp" not in fn \
                         and not fn.endswith(".corrupted"):
                     try:
                         out.append(int(fn[len(pre):]))
@@ -546,13 +554,30 @@ class BlockManager:
             pass
         self.resync.push_now(hash32)
 
+    def sweep_stale_tmp(self, root: str, files: list[str]) -> None:
+        """Delete .tmpN orphans older than _TMP_MAX_AGE (a writer that
+        crashed between open and rename leaves one; unique tmp names
+        mean nothing else ever reclaims it). Called from the walking
+        iterators so the scrub pass doubles as the janitor."""
+        now = time.time()
+        for fn in files:
+            if ".tmp" not in fn:
+                continue
+            p = os.path.join(root, fn)
+            try:
+                if now - os.stat(p).st_mtime > _TMP_MAX_AGE:
+                    os.remove(p)
+            except OSError:
+                pass
+
     def iter_local_blocks(self):
         """Yield (hash32, path) for every stored block/shard file."""
         seen = set()
         for d in self.data_layout.dirs:
             for root, _, files in os.walk(d.path):
+                self.sweep_stale_tmp(root, files)
                 for fn in files:
-                    if fn.endswith((".tmp", ".corrupted")):
+                    if ".tmp" in fn or fn.endswith(".corrupted"):
                         continue
                     hexpart = fn.split(".")[0]
                     try:
@@ -603,12 +628,14 @@ class BlockManager:
                 for r in lvl2s[lvl2]:
                     d = os.path.join(r, lvl1, lvl2)
                     try:
-                        names.update(os.listdir(d))
+                        ls = os.listdir(d)
                     except OSError:
-                        pass
+                        continue
+                    self.sweep_stale_tmp(d, ls)
+                    names.update(ls)
                 hashes = set()
                 for fn in names:
-                    if fn.endswith((".tmp", ".corrupted")):
+                    if ".tmp" in fn or fn.endswith(".corrupted"):
                         continue
                     try:
                         h = bytes.fromhex(fn.split(".")[0])

@@ -19,9 +19,18 @@ Frame flags (in the u32 len field):
   0x80000000 CONTINUES — more chunks follow for this section
   0x40000000 ERROR     — section is an error payload
   0x20000000 STREAM    — chunk belongs to the attached byte stream
-  len = field & 0x0FFFFFFF, <= MAX_CHUNK (0x3FFF0, 256 KiB)
+  len = field & 0x0FFFFFFF, <= the channel's max_chunk
   field == 0xFFFFFFFF  — CANCEL marker for this request id
   field == 0xFFFFFFFE  — CREDIT grant; payload = u32 additional window
+
+Body section layout (v3): [u16 hlen][msgpack header][raw blob bytes].
+The header's last element is a blob key: when a request/reply payload
+is a dict with one large bytes value (a block/shard), that value rides
+OUTSIDE msgpack as the raw tail of the body and is re-attached on
+receive. Together with scatter-gather frames (channels accept lists of
+buffers; LocalChannel passes them through untouched) this removes ~5
+full-payload copies per block RPC vs msgpack-embedding the bytes
+(r4 profile: the copies were a top-3 cost on the PUT path).
 
 Concurrency invariant: ALL outgoing records flow through _send_loop (the
 single writer) — the AEAD nonce counter and frame ordering both depend
@@ -53,11 +62,13 @@ from .stream import ByteStream
 
 log = logging.getLogger("garage_tpu.net")
 
-MAGIC = b"GRGTPU\x02\x00"  # protocol version gate (ref: net/netapp.rs:35-40)
-# 256 KiB chunks: per-chunk costs (AEAD pass + header + writer wakeup)
-# were the dominant CPU on the block path at the reference-style ~8 KiB
-# (a 1.5 MiB shard transfer = ~190 chunks); at ~1 ms serialization per
-# chunk the priority round-robin still keeps pings fresh
+MAGIC = b"GRGTPU\x03\x00"  # protocol version gate (ref: net/netapp.rs:35-40)
+# 256 KiB chunks on TCP: per-chunk costs (AEAD pass + header + writer
+# wakeup) were the dominant CPU on the block path at the reference-style
+# ~8 KiB (a 1.5 MiB shard transfer = ~190 chunks); at ~1 ms
+# serialization per chunk the priority round-robin still keeps pings
+# fresh. The in-process LocalChannel has no serialization cost, so it
+# takes whole messages in one frame (local.py sets max_chunk huge).
 MAX_CHUNK = 0x3FFF0
 F_CONT = 0x80000000
 F_ERROR = 0x40000000
@@ -65,6 +76,60 @@ F_STREAM = 0x20000000
 LEN_MASK = 0x0FFFFFFF
 CANCEL = 0xFFFFFFFF
 CREDIT = 0xFFFFFFFE
+
+# payload-dict values at least this big ride as a raw blob instead of
+# being embedded in msgpack (saves a serialize + parse copy per side)
+BLOB_MIN = 4096
+
+
+def split_blob(payload):
+    """-> (payload_without_blob, blob_key|None, blob|None). The largest
+    qualifying bytes value of a dict payload is hoisted out of msgpack.
+    Never mutates the caller's dict."""
+    if type(payload) is dict:
+        best_k, best = None, BLOB_MIN - 1
+        for k, v in payload.items():
+            if isinstance(v, (bytes, bytearray, memoryview)) \
+                    and len(v) > best:
+                best_k, best = k, len(v)
+        if best_k is not None:
+            rest = {k: v for k, v in payload.items() if k != best_k}
+            return rest, best_k, payload[best_k]
+    return payload, None, None
+
+
+def pack_body(header_obj, blob) -> list:
+    """Body = [u32 hlen][msgpack header][blob] as a scatter list.
+    u32: table-sync pushes batch whole entries into the header (e.g.
+    sync.py Items with 64 x multi-KiB entries), which blows a u16 cap."""
+    h = pack(header_obj)
+    first = struct.pack("<I", len(h)) + h
+    return [first, blob] if blob is not None else [first]
+
+
+def parse_body(parts: list):
+    """Inverse of pack_body over received buffers. Returns
+    (header_obj, blob: bytes|None). parts arrive either exactly as sent
+    (LocalChannel) or re-chunked (TCP); both shapes are handled."""
+    first = parts[0]
+    if len(first) >= 4:
+        (hlen,) = struct.unpack_from("<I", first)
+        if len(first) >= 4 + hlen:
+            header = unpack(bytes(first[4:4 + hlen]))
+            tail = first[4 + hlen:]
+            blobs = ([tail] if len(tail) else []) + parts[1:]
+            if not blobs:
+                return header, None
+            if len(blobs) == 1:
+                b = blobs[0]
+                return header, b if isinstance(b, bytes) else bytes(b)
+            return header, b"".join(bytes(x) for x in blobs)
+    # header split across frames (TCP re-chunking of a tiny first part)
+    body = b"".join(bytes(p) for p in parts)
+    (hlen,) = struct.unpack_from("<I", body)
+    header = unpack(body[4:4 + hlen])
+    blob = body[4 + hlen:] or None
+    return header, blob
 
 # Stream flow control: sender may have this many un-acked stream bytes in
 # flight per request; receiver grants more as the consumer drains.
@@ -158,7 +223,10 @@ async def server_handshake(
 
 
 class SecureChannel:
-    """ChaCha20-Poly1305 record layer: [u32 ct_len][ct]; counter nonces."""
+    """ChaCha20-Poly1305 record layer: [u32 ct_len][ct]; counter nonces.
+    Frames are [u32 req_id][u32 field][payload] inside the record."""
+
+    max_chunk = MAX_CHUNK
 
     def __init__(self, reader, writer, send_key: bytes, recv_key: bytes):
         self.reader = reader
@@ -171,18 +239,23 @@ class SecureChannel:
     def _nonce(self, ctr: int) -> bytes:
         return ctr.to_bytes(12, "little")
 
-    async def send_record(self, plaintext: bytes) -> None:
-        ct = self._send.encrypt(self._nonce(self._send_ctr), plaintext, None)
+    async def send_frame(self, req_id: int, field: int,
+                         parts: list = ()) -> None:
+        pt = struct.pack("<II", req_id, field) + b"".join(
+            p if isinstance(p, (bytes, bytearray)) else bytes(p)
+            for p in parts)
+        ct = self._send.encrypt(self._nonce(self._send_ctr), pt, None)
         self._send_ctr += 1
         self.writer.write(struct.pack("<I", len(ct)) + ct)
         await self.writer.drain()
 
-    async def recv_record(self) -> bytes:
+    async def recv_frame(self) -> tuple[int, int, list]:
         (n,) = struct.unpack("<I", await self.reader.readexactly(4))
         ct = await self.reader.readexactly(n)
         pt = self._recv.decrypt(self._nonce(self._recv_ctr), ct, None)
         self._recv_ctr += 1
-        return pt
+        req_id, field = struct.unpack_from("<II", pt)
+        return req_id, field, [memoryview(pt)[8:]]
 
     def close(self) -> None:
         try:
@@ -200,15 +273,18 @@ class _SendItem:
     """
 
     __slots__ = (
-        "req_id", "prio", "body", "pos", "stream", "is_error", "done",
-        "kind", "next_chunk", "chunk_state", "prefetch", "window", "order_clock",
+        "req_id", "prio", "body", "buf_idx", "pos", "body_done", "stream",
+        "is_error", "done", "kind", "next_chunk", "chunk_state", "prefetch",
+        "window", "order_clock",
     )
 
     def __init__(self, req_id, prio, body, stream, is_error, kind="msg"):
         self.req_id = req_id
         self.prio = prio
-        self.body = body
+        self.body = body  # list of buffers (scatter-gather)
+        self.buf_idx = 0
         self.pos = 0
+        self.body_done = False
         self.stream = stream
         self.is_error = is_error
         self.kind = kind  # "msg" | "cancel" | "credit"
@@ -223,10 +299,10 @@ class _SendItem:
 class _RecvState:
     """Reassembly of one incoming message."""
 
-    __slots__ = ("body", "stream", "is_error", "credited")
+    __slots__ = ("parts", "stream", "is_error", "credited")
 
     def __init__(self):
-        self.body = bytearray()
+        self.parts: list = []
         self.stream: Optional[ByteStream] = None
         self.is_error = False
         self.credited = 0
@@ -279,7 +355,7 @@ class Conn:
         self,
         req_id: int,
         prio: int,
-        body: bytes,
+        body: list,
         stream: Optional[ByteStream] = None,
         is_error: bool = False,
     ) -> _SendItem:
@@ -289,7 +365,7 @@ class Conn:
         return item
 
     def _enqueue_ctl(self, kind: str, req_id: int, payload: bytes = b"") -> None:
-        item = _SendItem(req_id, 0, payload, None, False, kind=kind)
+        item = _SendItem(req_id, 0, [payload], None, False, kind=kind)
         self._ctl_items.append(item)
         self._send_wakeup.set()
 
@@ -304,10 +380,12 @@ class Conn:
     ):
         """Send a request, await (payload, reply_stream)."""
         req_id = self._alloc_id()
-        header = pack([path, prio, stream is not None, order, payload])
+        rest, blob_key, blob = split_blob(payload)
+        body = pack_body([path, prio, stream is not None, order, rest,
+                          blob_key], blob)
         fut = asyncio.get_event_loop().create_future()
         self._reply_waiters[req_id] = fut
-        self.enqueue(req_id, prio, header, stream)
+        self.enqueue(req_id, prio, body, stream)
         try:
             return await asyncio.wait_for(fut, timeout)
         except (asyncio.TimeoutError, asyncio.CancelledError):
@@ -358,7 +436,7 @@ class Conn:
         return best
 
     def _sendable(self, item: _SendItem) -> bool:
-        if item.pos < len(item.body) or (item.pos == 0 and not item.body):
+        if not item.body_done:
             return True
         if item.stream is None:
             return True  # finished body, will finalize
@@ -384,40 +462,54 @@ class Conn:
 
         item.prefetch = asyncio.create_task(fetch())
 
+    @staticmethod
+    def _next_body_parts(item: _SendItem, max_chunk: int) -> tuple[list, int]:
+        """Advance the body cursor by up to max_chunk bytes; returns the
+        scatter list (memoryview slices — no copies) and its length."""
+        parts: list = []
+        n = 0
+        while item.buf_idx < len(item.body) and n < max_chunk:
+            buf = item.body[item.buf_idx]
+            blen = len(buf)
+            take = min(blen - item.pos, max_chunk - n)
+            if take == blen and item.pos == 0:
+                parts.append(buf)
+            else:
+                parts.append(memoryview(buf)[item.pos:item.pos + take])
+            item.pos += take
+            n += take
+            if item.pos >= blen:
+                item.buf_idx += 1
+                item.pos = 0
+        return parts, n
+
     async def _send_one_chunk(self, item: _SendItem) -> None:
         if item.kind == "cancel":
             self._ctl_items.remove(item)
-            await self.chan.send_record(struct.pack("<II", item.req_id, CANCEL))
+            await self.chan.send_frame(item.req_id, CANCEL)
             return
         if item.kind == "credit":
             self._ctl_items.remove(item)
-            await self.chan.send_record(
-                struct.pack("<II", item.req_id, CREDIT) + item.body
-            )
+            await self.chan.send_frame(item.req_id, CREDIT, item.body)
             return
         self._send_clock += 1
         item.order_clock = self._send_clock
         flags_base = F_ERROR if item.is_error else 0
-        if item.pos < len(item.body) or (item.pos == 0 and not item.body):
-            chunk = item.body[item.pos : item.pos + MAX_CHUNK]
-            item.pos = max(item.pos + len(chunk), 1)  # 1 marks empty body sent
-            more_body = item.pos < len(item.body)
-            flags = flags_base | (F_CONT if more_body else 0)
-            await self.chan.send_record(
-                struct.pack("<II", item.req_id, flags | len(chunk)) + chunk
-            )
-            if not more_body and item.stream is None:
+        if not item.body_done:
+            parts, n = self._next_body_parts(item, self.chan.max_chunk)
+            item.body_done = item.buf_idx >= len(item.body)
+            flags = flags_base | (0 if item.body_done else F_CONT)
+            await self.chan.send_frame(item.req_id, flags | n, parts)
+            if item.body_done and item.stream is None:
                 self._finish_item(item)
             return
         # stream section
         if item.chunk_state == "error":
-            await self.chan.send_record(
-                struct.pack("<II", item.req_id, F_STREAM | F_ERROR)
-            )
+            await self.chan.send_frame(item.req_id, F_STREAM | F_ERROR)
             self._finish_item(item)
             return
         if item.chunk_state == "eof":
-            await self.chan.send_record(struct.pack("<II", item.req_id, F_STREAM))
+            await self.chan.send_frame(item.req_id, F_STREAM)
             self._finish_item(item)
             return
         assert item.chunk_state == "ready"
@@ -430,9 +522,8 @@ class Conn:
             item.next_chunk = None
             item.chunk_state = "none"
         item.window -= len(send_now)
-        await self.chan.send_record(
-            struct.pack("<II", item.req_id, F_STREAM | F_CONT | len(send_now)) + send_now
-        )
+        await self.chan.send_frame(
+            item.req_id, F_STREAM | F_CONT | len(send_now), [send_now])
 
     def _finish_item(self, item: _SendItem) -> None:
         self._send_items.pop(item.req_id, None)
@@ -444,15 +535,14 @@ class Conn:
     async def _recv_loop(self) -> None:
         try:
             while True:
-                rec = await self.chan.recv_record()
-                req_id, field = struct.unpack_from("<II", rec)
-                payload = rec[8:]
+                req_id, field, parts = await self.chan.recv_frame()
                 if field == CANCEL:
                     self._handle_cancel(req_id)
                 elif field == CREDIT:
-                    self._handle_credit(req_id, payload)
+                    self._handle_credit(
+                        req_id, bytes(parts[0][:4]) if parts else b"")
                 else:
-                    self._handle_chunk(req_id, field, payload)
+                    self._handle_chunk(req_id, field, parts)
         except asyncio.CancelledError:
             raise
         except Exception as e:
@@ -485,7 +575,7 @@ class Conn:
 
         stream.on_consume = consumed
 
-    def _handle_chunk(self, req_id: int, field: int, payload: bytes) -> None:
+    def _handle_chunk(self, req_id: int, field: int, parts: list) -> None:
         mine = (req_id % 2 == 0) == (self._next_id % 2 == 0)
         st = self._recv_states.get(req_id)
         if st is None:
@@ -497,35 +587,47 @@ class Conn:
                 st.stream.push_error(RpcError("peer stream failed"))
                 self._recv_states.pop(req_id, None)
             elif field & F_CONT:
-                st.stream.push(payload)
+                for p in parts:
+                    if len(p):
+                        st.stream.push(p if isinstance(p, bytes)
+                                       else bytes(p))
             else:
-                if payload:
-                    st.stream.push(payload)
+                for p in parts:
+                    if len(p):
+                        st.stream.push(p if isinstance(p, bytes)
+                                       else bytes(p))
                 st.stream.push_eof()
                 self._recv_states.pop(req_id, None)
             return
-        st.body.extend(payload)
+        st.parts.extend(parts)
         st.is_error = st.is_error or bool(field & F_ERROR)
         if field & F_CONT:
             return
         try:
-            header = unpack(bytes(st.body))
+            header, blob = parse_body(st.parts)
         except Exception:
             # fragment of a cancelled request whose state we dropped —
             # drop it; the request id is dead
             self._recv_states.pop(req_id, None)
             return
         if mine:
-            self._deliver_reply(req_id, st, header)
+            self._deliver_reply(req_id, st, header, blob)
         else:
-            self._dispatch_request(req_id, st, header)
+            self._dispatch_request(req_id, st, header, blob)
 
     @staticmethod
     def _expect_stream(header) -> bool:
-        # reply header: [ok, payload, has_stream]
+        # reply header: [ok, payload, has_stream, blob_key]
         return bool(header[2]) if isinstance(header, list) and len(header) >= 3 else False
 
-    def _deliver_reply(self, req_id: int, st: _RecvState, header) -> None:
+    @staticmethod
+    def _attach_blob(header, payload, blob):
+        blob_key = header[-1] if isinstance(header, list) and len(header) >= 4 else None
+        if blob_key is not None and type(payload) is dict:
+            payload[blob_key] = blob if blob is not None else b""
+        return payload
+
+    def _deliver_reply(self, req_id: int, st: _RecvState, header, blob) -> None:
         fut = self._reply_waiters.pop(req_id, None)
         has_stream = self._expect_stream(header)
         if has_stream and st.stream is None:
@@ -542,11 +644,13 @@ class Conn:
         else:
             if st.stream is not None:
                 self._grant_credit(req_id, st.stream)
-            fut.set_result((header[1], st.stream))
+            fut.set_result((self._attach_blob(header, header[1], blob),
+                            st.stream))
 
-    def _dispatch_request(self, req_id: int, st: _RecvState, header) -> None:
-        # request header: [path, prio, has_stream, order, payload]
-        path, prio, has_stream, order, payload = header
+    def _dispatch_request(self, req_id: int, st: _RecvState, header, blob) -> None:
+        # request header: [path, prio, has_stream, order, payload, blob_key]
+        path, prio, has_stream, order, payload, _bkey = header
+        payload = self._attach_blob(header, payload, blob)
         if has_stream and st.stream is None:
             st.stream = ByteStream()
         if st.stream is not None:
@@ -564,13 +668,16 @@ class Conn:
             result, reply_stream = await self.handler(
                 self.peer_id, path, prio, order, payload, stream
             )
-            body = pack([True, result, reply_stream is not None])
+            rest, blob_key, blob = split_blob(result)
+            body = pack_body([True, rest, reply_stream is not None,
+                              blob_key], blob)
             self.enqueue(req_id, prio, body, reply_stream)
         except asyncio.CancelledError:
             pass
         except Exception as e:
             log.debug("handler error on %s: %s", path, e, exc_info=True)
-            self.enqueue(req_id, prio, pack([False, f"{type(e).__name__}: {e}", False]))
+            self.enqueue(req_id, prio, pack_body(
+                [False, f"{type(e).__name__}: {e}", False, None], None))
 
     # ---- lifecycle -----------------------------------------------------
 

@@ -16,19 +16,25 @@ from .conn import Conn
 
 
 class LocalChannel:
-    """Queue-backed duck-type of SecureChannel (send_record/recv_record)."""
+    """Queue-backed duck-type of SecureChannel (send_frame/recv_frame).
+    Frames pass through as (req_id, field, parts) tuples — zero copies,
+    zero serialization — and max_chunk is effectively unbounded so a
+    whole message is one queue item (there is no wire to preempt)."""
+
+    max_chunk = 1 << 27
 
     def __init__(self, tx: asyncio.Queue, rx: asyncio.Queue):
         self.tx = tx
         self.rx = rx
         self._closed = False
 
-    async def send_record(self, plaintext: bytes) -> None:
+    async def send_frame(self, req_id: int, field: int,
+                         parts: list = ()) -> None:
         if self._closed:
             raise ConnectionError("channel closed")
-        await self.tx.put(bytes(plaintext))
+        await self.tx.put((req_id, field, list(parts)))
 
-    async def recv_record(self) -> bytes:
+    async def recv_frame(self):
         item = await self.rx.get()
         if item is None:
             raise ConnectionError("channel closed by peer")
