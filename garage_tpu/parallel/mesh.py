@@ -13,9 +13,13 @@ Shapes (all static under jit):
   parity:       (B, m, S) uint8
   hashes:       (B, n, 32) uint8 — BLAKE3 of each of the n = k+m shards
 
-Divisibility: dp must divide B, tp must divide both S and n = k+m (the
-two layouts shard those dims). data_plane_mesh picks tp=2 by default —
-every (k, m) this framework ships has even n (4+2, 10+4, 2+1 excepted).
+Divisibility: dp must divide B and tp must divide S (the byte-split
+layout always shards the byte axis). The whole-shard layout shards the
+n = k+m axis when tp divides n; otherwise it falls back to sharding S
+there too (e.g. RS(2,1) n=3 on tp=2, or RS(10,4) n=14 on tp=4) — the
+all_to_all between layouts disappears and hashing partitions over the
+byte axis instead (chunk compressions are independent in S, the tree
+reduction crosses tp via XLA collectives).
 """
 
 from __future__ import annotations
@@ -50,6 +54,20 @@ def _sh(mesh, *spec):
     return NamedSharding(mesh, PartitionSpec(*spec))
 
 
+def _layouts(mesh, n: int, shard_len: int):
+    """(bytes_sh, shards_sh, n_sharded) for a (B, n, S) stripe batch.
+    Validates tp | S; shards the n axis in the whole-shard layout only
+    when tp | n, else keeps sharding S (see module docstring)."""
+    tp = mesh.shape["tp"]
+    if shard_len % tp:
+        raise ValueError(
+            f"tp={tp} must divide shard_len={shard_len} (byte-split layout)")
+    bytes_sh = _sh(mesh, "dp", None, "tp")
+    if n % tp == 0:
+        return bytes_sh, _sh(mesh, "dp", "tp", None), True
+    return bytes_sh, bytes_sh, False
+
+
 def _hash_all_shards(shards, n_chunks: int):
     """(B, n, S) uint8 -> (B, n, 32) uint8 BLAKE3 digests (full shards)."""
     import jax.numpy as jnp
@@ -79,8 +97,7 @@ def make_put_step(mesh, k: int, m: int, shard_len: int):
         raise ValueError(f"shard_len must be a multiple of {treehash.CHUNK_LEN}")
     n_chunks = shard_len // treehash.CHUNK_LEN
     parity_bits = gf256.bitmat_t_for(rs.parity_matrix(k, m))
-    bytes_sh = _sh(mesh, "dp", None, "tp")
-    shards_sh = _sh(mesh, "dp", "tp", None)
+    bytes_sh, shards_sh, _ = _layouts(mesh, k + m, shard_len)
 
     def step(data):
         # encode in byte-split layout (local matmul per byte-column)
@@ -111,13 +128,12 @@ def make_scrub_step(mesh, k: int, m: int, shard_len: int):
     import jax
     import jax.numpy as jnp
 
-    n_chunks = shard_len // treehash.CHUNK_LEN
-    parity_bits = gf256.bitmat_t_for(rs.parity_matrix(k, m))
-    bytes_sh = _sh(mesh, "dp", None, "tp")
-    shards_sh = _sh(mesh, "dp", "tp", None)
-
     if shard_len % treehash.CHUNK_LEN:
         raise ValueError(f"shard_len must be a multiple of {treehash.CHUNK_LEN}")
+    n_chunks = shard_len // treehash.CHUNK_LEN
+    parity_bits = gf256.bitmat_t_for(rs.parity_matrix(k, m))
+    bytes_sh, shards_sh, n_sharded = _layouts(mesh, k + m, shard_len)
+    mask_sh = _sh(mesh, "dp", "tp") if n_sharded else _sh(mesh, "dp")
 
     def step(shards, expected_hashes):
         shards = jax.lax.with_sharding_constraint(shards, shards_sh)
@@ -143,7 +159,7 @@ def make_scrub_step(mesh, k: int, m: int, shard_len: int):
     return jax.jit(
         step,
         in_shardings=(shards_sh, shards_sh),
-        out_shardings=(_sh(mesh, "dp", "tp"), _sh(mesh)),
+        out_shardings=(mask_sh, _sh(mesh)),
     )
 
 
@@ -161,7 +177,7 @@ def make_repair_step(
         raise ValueError(f"shard_len must be a multiple of {treehash.CHUNK_LEN}")
     n_chunks = shard_len // treehash.CHUNK_LEN
     mat_bits = gf256.bitmat_t_for(rs.repair_matrix(k, m, present, missing))
-    bytes_sh = _sh(mesh, "dp", None, "tp")
+    bytes_sh, _, _ = _layouts(mesh, k + m, shard_len)
 
     def step(surviving):  # (B, k, S) rows `present` in ascending order
         surviving = jax.lax.with_sharding_constraint(surviving, bytes_sh)
