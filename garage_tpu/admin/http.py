@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import json
 
-from ..api.http import HttpServer, Request, Response
+from ..api.http import HttpServer, Request, Response, parse_query
 from ..utils.error import BadRequest, GarageError, NoSuchBucket, NoSuchKey
 
 
@@ -59,6 +59,26 @@ class AdminHttpServer:
                             self.render_metrics().encode())
         if path == "/check" and req.method == "GET":
             return await self._check_domain(req)
+        if path == "/v1/trace" and req.method == "GET":
+            # span ring tail (admin-token gated like management routes);
+            # ?limit=N caps the tail. Ref: the reference exports spans
+            # via OTLP (garage/tracing_setup.rs); this surfaces the same
+            # span stream without a collector.
+            if self.garage.config.admin_token is None \
+                    or not self._authorized(req,
+                                            self.garage.config.admin_token):
+                return Response(403, [], b"forbidden")
+            from ..utils.tracing import tracer
+
+            q, _ = parse_query(req.raw_query)
+            try:
+                limit = int(q.get("limit", "200"))
+            except ValueError:
+                return _json({"code": "InvalidRequest",
+                              "message": "limit must be an integer"}, 400)
+            limit = max(1, min(limit, 2048))
+            spans = list(tracer.ring)[-limit:]
+            return _json({"enabled": tracer.enabled, "spans": spans}, 200)
         # management endpoints: an UNSET admin token means access is
         # always denied (the reference's admin_token semantics) —
         # /metrics above differs deliberately (open when no

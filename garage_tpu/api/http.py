@@ -304,9 +304,13 @@ class HttpServer:
                 self.metrics["requests"] += 1
                 from ..utils.metrics import registry
 
+                from ..utils.tracing import span
+
                 t0 = time.perf_counter()
                 try:
-                    resp = await self.handler(req)
+                    async with span("http.request", api=self.name,
+                                    method=req.method, path=req.path[:128]):
+                        resp = await self.handler(req)
                 except HttpError as e:
                     resp = Response(e.status, [("content-type", "text/plain")],
                                     e.reason.encode())

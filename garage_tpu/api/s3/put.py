@@ -102,11 +102,14 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
         headers = {**headers,
                    f"x-garage-checksum-{expected_checksum[0]}":
                        expected_checksum[1]}
+    from ...utils.tracing import span
+
     block_size = garage.config.block_size
     chunker = Chunker(body, block_size)
-    first_block, existing = await asyncio.gather(
-        chunker.next(), garage.object_table.get(bucket_id, key.encode())
-    )
+    async with span("s3.put.first_read_and_lookup"):
+        first_block, existing = await asyncio.gather(
+            chunker.next(), garage.object_table.get(bucket_id, key.encode())
+        )
     first_block = first_block or b""
     uuid = gen_uuid()
     ts = next_timestamp(existing)
@@ -207,7 +210,9 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
     block = first_block
 
     async def put_one(blk: bytes, off: int, plain_len: int, h: bytes):
-        async with sem:
+        from ...utils.tracing import span
+
+        async with sem, span("s3.put.block", offset=off, size=len(blk)):
             v = Version(version.uuid, version.deleted,
                         version.blocks.put((part_number, off),
                                            (h, plain_len)),
@@ -220,6 +225,8 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
                 garage.block_ref_table.insert(BlockRef.new(h, version.uuid)),
             )
 
+    from ...utils.tracing import span
+
     try:
         while block is not None:
             md5.update(block)
@@ -229,7 +236,8 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
             plain_len = len(block)
             stored = (await asyncio.to_thread(sse_key.encrypt_block, block)
                       if sse_key is not None else block)
-            h = await garage.block_manager.hash_block(stored)
+            async with span("s3.put.hash", size=len(stored)):
+                h = await garage.block_manager.hash_block(stored)
             if first_hash is None:
                 first_hash = h
             tasks.append(asyncio.create_task(
@@ -243,7 +251,8 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
                     if t.exception() is not None:
                         raise t.exception()
                 tasks = [t for t in tasks if not t.done()]
-            block = await chunker.next()
+            async with span("s3.put.chunk_read"):
+                block = await chunker.next()
         if tasks:
             await asyncio.gather(*tasks)
     except BaseException:

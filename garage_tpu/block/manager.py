@@ -241,19 +241,23 @@ class BlockManager:
 
     async def rpc_put_block(self, hash32: bytes, data: bytes,
                             compress: Optional[bool] = None) -> None:
+        from ..utils.tracing import span
+
         await self._ram_sem.acquire(len(data))
         try:
-            do_compress = (self.compression if compress is None
-                           else compress)
-            blk = (await asyncio.to_thread(DataBlock.compress, data)
-                   if do_compress else DataBlock.plain(data))
-            if self.erasure:
-                # the 1-byte DataBlock header travels as a prefix so the
-                # megabyte payload is never concat-copied host-side
-                await self._put_erasure(hash32, bytes([blk.compression]),
-                                        blk.bytes)
-            else:
-                await self._put_replicate(hash32, blk.pack())
+            async with span("block.put", size=len(data), hash=hash32):
+                do_compress = (self.compression if compress is None
+                               else compress)
+                blk = (await asyncio.to_thread(DataBlock.compress, data)
+                       if do_compress else DataBlock.plain(data))
+                if self.erasure:
+                    # the 1-byte DataBlock header travels as a prefix so
+                    # the megabyte payload is never concat-copied
+                    await self._put_erasure(hash32,
+                                            bytes([blk.compression]),
+                                            blk.bytes)
+                else:
+                    await self._put_replicate(hash32, blk.pack())
         finally:
             self._ram_sem.release(len(data))
 
@@ -271,7 +275,10 @@ class BlockManager:
 
     async def _put_erasure(self, hash32: bytes, prefix: bytes,
                            data: bytes) -> None:
-        payloads = await self.feeder.encode_put(data, prefix=prefix)
+        from ..utils.tracing import span
+
+        async with span("block.encode", size=len(data)):
+            payloads = await self.feeder.encode_put(data, prefix=prefix)
         # materialize once: msgpack needs bytes, and doing it in
         # make_call would re-copy the shard on every retry
         payloads = [p if isinstance(p, bytes) else bytes(p)
@@ -294,17 +301,21 @@ class BlockManager:
             # quorum unit = placement entry (node, shard index): a node
             # may be assigned different shard indices under different
             # layout versions, so keys are tuples, not bare node ids
-            await self.rpc.try_write_many_sets(
-                self.endpoint, sets, None,
-                RequestStrategy(quorum=self.codec.write_quorum,
-                                prio=PRIO_NORMAL, timeout=60.0),
-                make_call=lambda key: self.endpoint.call(
-                    key[0],
-                    {"op": "put", "hash": hash32, "part": key[1],
-                     "data": payloads[key[1]]},
-                    PRIO_NORMAL, timeout=60.0,
-                ),
-            )
+            async with span("block.write_shards", width=self.codec.width):
+                await self._write_shard_sets(hash32, payloads, sets)
+
+    async def _write_shard_sets(self, hash32, payloads, sets) -> None:
+        await self.rpc.try_write_many_sets(
+            self.endpoint, sets, None,
+            RequestStrategy(quorum=self.codec.write_quorum,
+                            prio=PRIO_NORMAL, timeout=60.0),
+            make_call=lambda key: self.endpoint.call(
+                key[0],
+                {"op": "put", "hash": hash32, "part": key[1],
+                 "data": payloads[key[1]]},
+                PRIO_NORMAL, timeout=60.0,
+            ),
+        )
 
     # ==== cluster read path (ref: manager.rs:243-363) ===================
 

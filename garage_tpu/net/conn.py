@@ -62,7 +62,7 @@ from .stream import ByteStream
 
 log = logging.getLogger("garage_tpu.net")
 
-MAGIC = b"GRGTPU\x03\x00"  # protocol version gate (ref: net/netapp.rs:35-40)
+MAGIC = b"GRGTPU\x04\x00"  # protocol version gate (ref: net/netapp.rs:35-40)
 # 256 KiB chunks on TCP: per-chunk costs (AEAD pass + header + writer
 # wakeup) were the dominant CPU on the block path at the reference-style
 # ~8 KiB (a 1.5 MiB shard transfer = ~190 chunks); at ~1 ms
@@ -96,6 +96,13 @@ def split_blob(payload):
             rest = {k: v for k, v in payload.items() if k != best_k}
             return rest, best_k, payload[best_k]
     return payload, None, None
+
+
+def _attach_blob(payload, blob_key, blob):
+    """Re-attach a hoisted blob value into its dict payload."""
+    if blob_key is not None and type(payload) is dict:
+        payload[blob_key] = blob if blob is not None else b""
+    return payload
 
 
 def pack_body(header_obj, blob) -> list:
@@ -379,10 +386,12 @@ class Conn:
         order: Optional[tuple[int, int]] = None,
     ):
         """Send a request, await (payload, reply_stream)."""
+        from ..utils.tracing import current_trace_id
+
         req_id = self._alloc_id()
         rest, blob_key, blob = split_blob(payload)
         body = pack_body([path, prio, stream is not None, order, rest,
-                          blob_key], blob)
+                          blob_key, current_trace_id()], blob)
         fut = asyncio.get_event_loop().create_future()
         self._reply_waiters[req_id] = fut
         self.enqueue(req_id, prio, body, stream)
@@ -620,14 +629,8 @@ class Conn:
         # reply header: [ok, payload, has_stream, blob_key]
         return bool(header[2]) if isinstance(header, list) and len(header) >= 3 else False
 
-    @staticmethod
-    def _attach_blob(header, payload, blob):
-        blob_key = header[-1] if isinstance(header, list) and len(header) >= 4 else None
-        if blob_key is not None and type(payload) is dict:
-            payload[blob_key] = blob if blob is not None else b""
-        return payload
-
     def _deliver_reply(self, req_id: int, st: _RecvState, header, blob) -> None:
+        # reply header: [ok, payload, has_stream, blob_key]
         fut = self._reply_waiters.pop(req_id, None)
         has_stream = self._expect_stream(header)
         if has_stream and st.stream is None:
@@ -644,13 +647,15 @@ class Conn:
         else:
             if st.stream is not None:
                 self._grant_credit(req_id, st.stream)
-            fut.set_result((self._attach_blob(header, header[1], blob),
-                            st.stream))
+            bkey = header[3] if len(header) > 3 else None
+            fut.set_result((_attach_blob(header[1], bkey, blob), st.stream))
 
     def _dispatch_request(self, req_id: int, st: _RecvState, header, blob) -> None:
-        # request header: [path, prio, has_stream, order, payload, blob_key]
-        path, prio, has_stream, order, payload, _bkey = header
-        payload = self._attach_blob(header, payload, blob)
+        # request header:
+        # [path, prio, has_stream, order, payload, blob_key, trace_id]
+        path, prio, has_stream, order, payload, bkey = header[:6]
+        trace_id = header[6] if len(header) > 6 else None
+        payload = _attach_blob(payload, bkey, blob)
         if has_stream and st.stream is None:
             st.stream = ByteStream()
         if st.stream is not None:
@@ -658,13 +663,19 @@ class Conn:
         if not has_stream:
             self._recv_states.pop(req_id, None)
         task = asyncio.create_task(
-            self._run_handler(req_id, path, prio, order, payload, st.stream)
+            self._run_handler(req_id, path, prio, order, payload, st.stream,
+                              trace_id)
         )
         self._handler_tasks[req_id] = task
         task.add_done_callback(lambda t: self._handler_tasks.pop(req_id, None))
 
-    async def _run_handler(self, req_id, path, prio, order, payload, stream) -> None:
+    async def _run_handler(self, req_id, path, prio, order, payload, stream,
+                           trace_id=None) -> None:
         try:
+            if trace_id is not None:
+                from ..utils.tracing import set_remote_context
+
+                set_remote_context(trace_id)
             result, reply_stream = await self.handler(
                 self.peer_id, path, prio, order, payload, stream
             )

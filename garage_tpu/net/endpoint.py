@@ -53,14 +53,17 @@ class Endpoint:
         """Call this endpoint on `node` (loopback if node is ourself).
         Returns (payload, reply_stream|None)."""
         from ..utils.metrics import registry
+        from ..utils.tracing import span
 
         with registry().timer("rpc_request_duration_seconds",
                               endpoint=self.path):
             try:
-                return await self.netapp.call(
-                    node, self.path, payload, prio, stream=stream,
-                    timeout=timeout, order=order
-                )
+                async with span("rpc.call", endpoint=self.path,
+                                node=node[:4].hex()):
+                    return await self.netapp.call(
+                        node, self.path, payload, prio, stream=stream,
+                        timeout=timeout, order=order
+                    )
             except Exception:
                 registry().inc("rpc_request_errors", endpoint=self.path)
                 raise

@@ -61,8 +61,13 @@ class Table:
     async def insert(self, entry: Entry) -> None:
         """ref: table/table.rs:106-144."""
         from ..utils.metrics import registry
+        from ..utils.tracing import span
 
         registry().inc("table_put_total", table=self.name)
+        async with span("table.insert", table=self.name):
+            await self._insert_traced(entry)
+
+    async def _insert_traced(self, entry: Entry) -> None:
         raw = self.schema.encode_entry(entry)
         ph = partition_hash(entry.partition_key())
         with self.replication.write_lock():
@@ -112,8 +117,13 @@ class Table:
         """Read-quorum get with CRDT merge + background read-repair.
         ref: table.rs:287-361."""
         from ..utils.metrics import registry
+        from ..utils.tracing import span
 
         registry().inc("table_get_total", table=self.name)
+        async with span("table.get", table=self.name):
+            return await self._get_traced(pk, sk)
+
+    async def _get_traced(self, pk: bytes, sk: bytes) -> Optional[Entry]:
         ph = partition_hash(pk)
         nodes = self.replication.read_nodes(ph)
         resps = await self.rpc.try_call_many(

@@ -1,0 +1,131 @@
+"""Capture one S3 PutObject trace from a real forked server process and
+render TRACE_SAMPLE.md (VERDICT r3 task 6 deliverable).
+
+Usage: python scripts/capture_trace.py [size_bytes]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("GARAGE_TPU_DEVICE", "off")
+    size = int(sys.argv[1]) if len(sys.argv) > 1 else 4 << 20
+
+    from s3util import S3Client
+    from test_s3_api import Server
+
+    tmp = tempfile.mkdtemp(prefix="gt_trace_")
+    trace_path = os.path.join(tmp, "spans.jsonl")
+    os.environ["GARAGE_TPU_TRACE"] = trace_path
+    srv = Server(tmp)
+    try:
+        srv.start()
+        srv.setup_layout_and_key()
+        cli = S3Client("127.0.0.1", srv.s3_port, srv.key_id, srv.secret,
+                       "garage")
+        status, _, rbody = cli.request("PUT", "/trace-bucket")
+        assert status in (200, 409), (status, rbody[:200])
+        body = os.urandom(size)
+        status, _, rbody = cli.request("PUT", "/trace-bucket/sample-object",
+                                       body=body)
+        assert status == 200, (status, rbody[:200])
+    finally:
+        srv.stop()
+
+    spans = [json.loads(line) for line in open(trace_path)]
+    # find the PUT object request trace
+    roots = [s for s in spans
+             if s["name"] == "http.request"
+             and s.get("attrs", {}).get("path", "").endswith("sample-object")]
+    assert roots, "no http.request span for the object PUT"
+    root = roots[-1]
+    tid = root["trace"]
+    mine = sorted((s for s in spans if s["trace"] == tid),
+                  key=lambda s: s["start_us"])
+
+    by_parent: dict = {}
+    for s in mine:
+        by_parent.setdefault(s["parent"], []).append(s)
+
+    lines = []
+
+    def walk(sp, depth):
+        attrs = sp.get("attrs", {})
+        akeys = ("size", "endpoint", "node", "table", "offset", "width",
+                 "method", "path")
+        astr = " ".join(f"{k}={attrs[k]}" for k in akeys if k in attrs)
+        lines.append(f"| {'&nbsp;&nbsp;' * depth}{sp['name']} "
+                     f"| {sp['dur_us']:,} | {astr} |")
+        for c in by_parent.get(sp["span"], []):
+            walk(c, depth + 1)
+
+    walk(root, 0)
+
+    agg: dict[str, list[float]] = {}
+    for s in mine:
+        agg.setdefault(s["name"], []).append(s["dur_us"])
+
+    with open(os.path.join(REPO, "TRACE_SAMPLE.md"), "w") as f:
+        f.write(f"""# TRACE_SAMPLE — one S3 PutObject, end to end
+
+Captured by `python scripts/capture_trace.py {size}` from a REAL forked
+single-node server (tests/test_s3_api.py harness, sqlite metadata,
+64 KiB blocks, replication_factor=1, host data plane), tracing enabled
+via `GARAGE_TPU_TRACE`. Object size: {size:,} bytes
+({size // 65536} blocks). Spans: garage_tpu/utils/tracing.py; the trace
+id crosses the RPC wire (net/conn.py request header), so multi-node
+traces correlate the same way.
+
+Total request wall time: **{root['dur_us']:,} us**.
+
+## Span tree (one PUT /trace-bucket/sample-object)
+
+| span | dur_us | attrs |
+|---|---:|---|
+""")
+        f.write("\n".join(lines))
+        f.write("""
+
+## Aggregates over this trace
+
+| span name | count | total us | avg us |
+|---|---:|---:|---:|
+""")
+        for name, durs in sorted(agg.items(),
+                                 key=lambda kv: -sum(kv[1])):
+            f.write(f"| {name} | {len(durs)} | {sum(durs):,.0f} "
+                    f"| {sum(durs) / len(durs):,.0f} |\n")
+        f.write("""
+## Reading it
+
+- `http.request` wraps SigV4 verification + routing + `save_stream`;
+  the gap between it and the sum of child spans is framework overhead
+  (header parsing, signature HMAC chain, response write).
+- `s3.put.chunk_read` is the client-socket read of the next 64 KiB
+  block — on loopback this is small; over WAN it dominates and the
+  pipeline overlaps it with block writes.
+- `s3.put.hash` is the BLAKE3 content address (feeder: native C inline
+  or device batch).
+- `s3.put.block` covers one block's fan-out: `block.put` ->
+  `block.encode` (RS shard + crc, one fused native call) +
+  `block.write_shards` -> per-node `rpc.call`s, overlapped up to the
+  pipeline's parallelism limit; `table.insert` rows (version +
+  block_ref) ride the same gather.
+- remote nodes adopt the caller's trace id (`set_remote_context`), so
+  in a multi-node cluster their server-side spans join this tree.
+""")
+    print(f"TRACE_SAMPLE.md written; {len(mine)} spans in trace {tid}")
+
+
+if __name__ == "__main__":
+    main()
