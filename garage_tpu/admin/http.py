@@ -43,6 +43,22 @@ class AdminHttpServer:
             return True
         return req.header("authorization") == f"Bearer {token}"
 
+    @staticmethod
+    def _bucket_info_json(r: dict) -> dict:
+        wc = r.get("website")
+        return {
+            "id": r["id"], "globalAliases": r["aliases"],
+            "keys": r["keys"], "objects": r["objects"],
+            "bytes": r["bytes"],
+            "unfinishedUploads": r["unfinished_uploads"],
+            "websiteAccess": wc is not None,
+            "websiteConfig": ({"indexDocument": wc.get("index_document"),
+                               "errorDocument": wc.get("error_document")}
+                              if wc else None),
+            "quotas": {"maxSize": r.get("quotas", {}).get("max_size"),
+                       "maxObjects": r.get("quotas", {}).get("max_objects")},
+        }
+
     async def handle(self, req: Request) -> Response:
         path = req.path
         if path == "/health":
@@ -242,15 +258,49 @@ class AdminHttpServer:
             if q.get("id") or q.get("globalAlias"):
                 name = q.get("globalAlias") or q["id"]
                 r = await self.rpc.op_bucket_info({"name": name})
-                return _json({
-                    "id": r["id"], "globalAliases": r["aliases"],
-                    "keys": r["keys"], "objects": r["objects"],
-                    "bytes": r["bytes"],
-                    "unfinishedUploads": r["unfinished_uploads"],
-                })
+                return _json(self._bucket_info_json(r))
             r = await self.rpc.op_bucket_list({})
             return _json([{"id": b["id"], "globalAliases": [b["name"]]}
                           for b in r["buckets"]])
+        if path == "/v1/bucket" and m == "POST" and q.get("id"):
+            # UpdateBucket: website access flags + quotas
+            # (ref: src/api/admin/bucket.rs:405-452 handle_update_bucket)
+            bid = bytes.fromhex(q["id"])
+            await self.rpc.helper.get_existing_bucket(bid)
+            spec = await body_json() or {}
+            # validate EVERYTHING first, then apply atomically — a 400
+            # must never leave half the update persisted
+            updates: dict = {}
+            if "websiteAccess" in spec:
+                wa = spec["websiteAccess"]
+                if not isinstance(wa, dict):
+                    raise BadRequest("websiteAccess must be an object")
+                if wa.get("enabled"):
+                    idx = wa.get("indexDocument")
+                    if not idx:
+                        raise BadRequest(
+                            "indexDocument is required to enable website "
+                            "access")
+                    updates["website_config"] = {
+                        "index_document": idx,
+                        "error_document": wa.get("errorDocument")}
+                else:
+                    updates["website_config"] = None
+            if "quotas" in spec:
+                qt = spec["quotas"]
+                if not isinstance(qt, dict):
+                    raise BadRequest("quotas must be an object")
+                ms, mo = qt.get("maxSize"), qt.get("maxObjects")
+                if (ms is not None and int(ms) <= 0) \
+                        or (mo is not None and int(mo) <= 0):
+                    raise BadRequest("quota values must be positive")
+                updates["quotas"] = {
+                    "max_size": int(ms) if ms is not None else None,
+                    "max_objects": int(mo) if mo is not None else None}
+            if updates:
+                await self.rpc.helper.update_bucket_configs(bid, updates)
+            r = await self.rpc.op_bucket_info({"name": q["id"]})
+            return _json(self._bucket_info_json(r))
         if path == "/v1/bucket" and m == "POST":
             spec = await body_json() or {}
             alias = spec.get("globalAlias")

@@ -146,6 +146,9 @@ class AdminRpcHandler:
             "objects": counters.get("objects", 0),
             "bytes": counters.get("bytes", 0),
             "unfinished_uploads": counters.get("unfinished_uploads", 0),
+            "website": b.params.website_config.value,
+            "quotas": b.params.quotas.value
+            or {"max_size": None, "max_objects": None},
         }
 
     async def op_bucket_allow(self, p):

@@ -320,6 +320,13 @@ async def handle_complete_multipart(ctx, req: Request) -> Response:
                             final.backlink)
             total_size += sz
         etag_md5.update(bytes.fromhex(part.etag))
+    # quotas are enforced at completion, when the real total is known
+    # (ref: multipart.rs handle_complete_multipart_upload check_quotas)
+    from .put import check_quotas
+
+    existing = await ctx.garage.object_table.get(ctx.bucket_id,
+                                                 ctx.key.encode())
+    await check_quotas(ctx.garage, ctx.bucket_id, total_size, existing)
     await ctx.garage.version_table.insert(final)
     # re-point block refs from part versions to the final version
     for pn, part in parts:

@@ -77,10 +77,40 @@ def next_timestamp(existing: Optional[Object]) -> int:
     return max(now, max(v.timestamp for v in existing.versions) + 1)
 
 
+async def check_quotas(garage, bucket_id: bytes,
+                       size_hint: Optional[int], existing) -> None:
+    """Reject early when this upload would exceed the bucket's quotas
+    (ref: src/api/s3/put.rs check_quotas). Loads the bucket itself so
+    EVERY write path (put, copy, post_object, multipart complete)
+    enforces the same rule. `size_hint` is the declared payload length
+    (None = unknown: only the object-count quota can be enforced up
+    front); replacing an object frees its current size."""
+    bucket = await garage.bucket_table.get(bucket_id, b"")
+    params = bucket.params if bucket is not None else None
+    q = (params.quotas.value if params is not None else None) or {}
+    max_size, max_objects = q.get("max_size"), q.get("max_objects")
+    if max_size is None and max_objects is None:
+        return
+    nodes = list(
+        garage.system.layout_manager.history.all_nongateway_nodes())
+    counters = await garage.object_counter.read(bucket_id, b"", nodes)
+    replaced = existing.last_data() if existing is not None else None
+    if max_objects is not None and replaced is None:
+        if counters.get("objects", 0) + 1 > max_objects:
+            raise S3Error("AccessDenied", 403,
+                          "Object quota is reached on this bucket")
+    if max_size is not None and size_hint is not None:
+        freed = replaced.state.data.meta.size if replaced is not None else 0
+        if counters.get("bytes", 0) - freed + size_hint > max_size:
+            raise S3Error("AccessDenied", 403,
+                          "Bucket size quota is reached")
+
+
 async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
                       body, content_md5: Optional[str] = None,
                       expected_checksum: Optional[tuple[str, str]] = None,
-                      sse_key=None):
+                      sse_key=None,
+                      content_length: Optional[int] = None):
     """-> (version_uuid, version_timestamp, etag, total_size).
     ref: put.rs:122-330 save_stream. `expected_checksum` is a declared
     (algo, base64-value) x-amz-checksum-* header to enforce; `sse_key`
@@ -110,6 +140,7 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
         first_block, existing = await asyncio.gather(
             chunker.next(), garage.object_table.get(bucket_id, key.encode())
         )
+    await check_quotas(garage, bucket_id, content_length, existing)
     first_block = first_block or b""
     uuid = gen_uuid()
     ts = next_timestamp(existing)
@@ -279,11 +310,16 @@ async def handle_put(ctx, req: Request) -> Response:
     except ValueError as e:
         raise bad_request(str(e))
     sse_key = request_sse_key(req)
+    # aws-chunked bodies declare the true payload size separately; the
+    # raw content-length there includes per-chunk framing
+    cl = req.header("x-amz-decoded-content-length") \
+        or req.header("content-length")
     uuid, ts, etag, _ = await save_stream(
         ctx.garage, ctx.bucket_id, ctx.key, headers, req.body,
         content_md5=req.header("content-md5"),
         expected_checksum=expected_checksum,
         sse_key=sse_key,
+        content_length=int(cl) if cl and cl.isdigit() else None,
     )
     extra = []
     if sse_key is not None:
@@ -348,7 +384,7 @@ async def handle_copy(ctx, req: Request) -> Response:
                    if not k.startswith("x-garage-ssec-")}
         uuid, ts, etag, _ = await save_stream(
             helper_g, ctx.bucket_id, ctx.key, headers, source,
-            sse_key=dst_sse)
+            sse_key=dst_sse, content_length=src_meta.size)
         from .xml import xml, xml_response
 
         return xml_response(xml("CopyObjectResult",

@@ -221,10 +221,18 @@ class GarageHelper:
         cors_config / lifecycle_config / quotas) under the bucket lock
         (ref: api/s3/website.rs + cors.rs update paths through
         helper/locked.rs)."""
+        await self.update_bucket_configs(bucket_id, {field: value})
+
+    async def update_bucket_configs(self, bucket_id: bytes,
+                                    updates: dict) -> None:
+        """Atomically update several Lww config registers in ONE locked
+        read-modify-write (admin UpdateBucket sets website + quotas
+        together; two separate inserts could persist half on error)."""
         async with self.g.bucket_lock:
             bucket = await self.get_existing_bucket(bucket_id)
             params = bucket.params
-            setattr(params, field, getattr(params, field).update(value))
+            for field, value in updates.items():
+                setattr(params, field, getattr(params, field).update(value))
             await self.g.bucket_table.insert(bucket.with_params(params))
 
     async def _set_perm_unlocked(self, bucket_id: bytes, key_id: str,

@@ -1451,3 +1451,109 @@ def test_k2v_poll_range_api(server, k2v):
     assert got["res"] is not None
     items2, _ = got["res"]
     assert any(i["sk"] == "x2" for i in items2)
+
+
+def test_admin_update_bucket_quotas_and_website(server, client):
+    """UpdateBucket (ref: api/admin/bucket.rs:405): set quotas + website
+    flags via the admin API; quotas are then ENFORCED on PUT."""
+    st, _, _ = client.request("PUT", "/quota-bucket")
+    assert st == 200
+    st, info = _admin(server, "GET", "/v1/bucket?globalAlias=quota-bucket")
+    assert st == 200
+    bid = info["id"]
+    assert info["quotas"] == {"maxSize": None, "maxObjects": None}
+    assert info["websiteAccess"] is False
+
+    # set quotas + website config in one UpdateBucket call
+    st, info = _admin(server, "POST", f"/v1/bucket?id={bid}", body={
+        "quotas": {"maxSize": 150000, "maxObjects": 2},
+        "websiteAccess": {"enabled": True, "indexDocument": "index.html",
+                          "errorDocument": "err.html"},
+    })
+    assert st == 200
+    assert info["quotas"] == {"maxSize": 150000, "maxObjects": 2}
+    assert info["websiteAccess"] is True
+    assert info["websiteConfig"]["indexDocument"] == "index.html"
+
+    # size quota: a PUT with content-length over maxSize is rejected 403
+    st, _, body = client.request("PUT", "/quota-bucket/too-big",
+                                 body=os.urandom(200000))
+    assert st == 403, body
+    assert xml_error_code(body) == "AccessDenied"
+
+    # under the size quota: accepted (counts as object 1)
+    st, _, body = client.request("PUT", "/quota-bucket/obj1",
+                                 body=os.urandom(60000))
+    assert st == 200, body
+    # wait for counter propagation, then object 2 fills the count quota
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        st, info = _admin(server, "GET", f"/v1/bucket?id={bid}")
+        if info["objects"] >= 1:
+            break
+        time.sleep(0.2)
+    assert info["objects"] >= 1
+    st, _, body = client.request("PUT", "/quota-bucket/obj2",
+                                 body=os.urandom(10000))
+    assert st == 200, body
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        st, info = _admin(server, "GET", f"/v1/bucket?id={bid}")
+        if info["objects"] >= 2:
+            break
+        time.sleep(0.2)
+    # object-count quota: a THIRD object is rejected...
+    st, _, body = client.request("PUT", "/quota-bucket/obj3",
+                                 body=os.urandom(1000))
+    assert st == 403, body
+    # ...but REPLACING an existing object is allowed (doesn't add one)
+    st, _, body = client.request("PUT", "/quota-bucket/obj1",
+                                 body=os.urandom(1000))
+    assert st == 200, body
+
+    # disable website + clear quotas
+    st, info = _admin(server, "POST", f"/v1/bucket?id={bid}", body={
+        "quotas": {"maxSize": None, "maxObjects": None},
+        "websiteAccess": {"enabled": False},
+    })
+    assert st == 200
+    assert info["websiteAccess"] is False
+    assert info["quotas"] == {"maxSize": None, "maxObjects": None}
+    st, _, body = client.request("PUT", "/quota-bucket/obj3",
+                                 body=os.urandom(1000))
+    assert st == 200, body
+
+    # invalid quota values are a 400 (and must not half-apply)
+    st, _ = _admin(server, "POST", f"/v1/bucket?id={bid}", body={
+        "websiteAccess": {"enabled": True, "indexDocument": "i.html"},
+        "quotas": {"maxSize": -5}})
+    assert st == 400
+    st, info = _admin(server, "GET", f"/v1/bucket?id={bid}")
+    assert info["websiteAccess"] is False  # atomic: nothing applied
+    # malformed shapes are 400, not 500
+    st, _ = _admin(server, "POST", f"/v1/bucket?id={bid}",
+                   body={"websiteAccess": True})
+    assert st == 400
+
+    # multipart uploads are quota-checked at completion
+    st, info = _admin(server, "POST", f"/v1/bucket?id={bid}",
+                      body={"quotas": {"maxSize": 100000}})
+    assert st == 200
+    st, _, body = client.request("POST", "/quota-bucket/mpu-big",
+                                 query=[("uploads", "")])
+    assert st == 200
+    upload_id = xml_find(body, "UploadId")[0]
+    st, hdrs, body = client.request(
+        "PUT", "/quota-bucket/mpu-big",
+        query=[("partNumber", "1"), ("uploadId", upload_id)],
+        body=os.urandom(150000))
+    assert st == 200, body
+    part_etag = hdrs["etag"].strip('"')
+    complete = (f'<CompleteMultipartUpload><Part><PartNumber>1'
+                f'</PartNumber><ETag>"{part_etag}"</ETag></Part>'
+                f'</CompleteMultipartUpload>').encode()
+    st, _, body = client.request(
+        "POST", "/quota-bucket/mpu-big",
+        query=[("uploadId", upload_id)], body=complete)
+    assert st == 403, body
+    assert xml_error_code(body) == "AccessDenied"
