@@ -262,8 +262,9 @@ class AdminHttpServer:
             r = await self.rpc.op_bucket_list({})
             return _json([{"id": b["id"], "globalAliases": [b["name"]]}
                           for b in r["buckets"]])
-        if path == "/v1/bucket" and m == "POST" and q.get("id"):
-            # UpdateBucket: website access flags + quotas
+        if path == "/v1/bucket" and m == "PUT" and q.get("id"):
+            # UpdateBucket: website access flags + quotas — PUT with id,
+            # matching the reference admin v1 route so admin SDKs work
             # (ref: src/api/admin/bucket.rs:405-452 handle_update_bucket)
             bid = bytes.fromhex(q["id"])
             await self.rpc.helper.get_existing_bucket(bid)

@@ -84,12 +84,16 @@ class AdminRpcHandler:
             if r:
                 roles[nid.hex()] = {"zone": r.zone, "capacity": r.capacity,
                                     "tags": list(r.tags)}
+        # only the actual staged DIFF — staged_roles() is the merged
+        # view (current + staging) and would show every existing role
+        # as "staged" forever
         staged = {
             nid.hex(): ({"zone": r.zone, "capacity": r.capacity,
                          "tags": list(r.tags)} if r else None)
-            for nid, r in hist.staged_roles().items()
+            for nid, r in hist.staging.roles.items()
         }
-        return {"version": cur.version, "roles": roles, "staged": staged}
+        return {"version": cur.version, "roles": roles, "staged": staged,
+                "staged_parameters": hist.staging.parameters.value}
 
     async def op_layout_assign(self, p):
         lm = self.garage.system.layout_manager
@@ -97,12 +101,14 @@ class AdminRpcHandler:
                         capacity=p.get("capacity"),
                         tags=tuple(p.get("tags", [])))
         lm.history.stage_role(bytes(p["node"]), role)
+        lm.save()
         await lm.broadcast()
         return {"ok": True}
 
     async def op_layout_remove(self, p):
         lm = self.garage.system.layout_manager
         lm.history.stage_role(bytes(p["node"]), None)
+        lm.save()
         await lm.broadcast()
         return {"ok": True}
 
@@ -110,6 +116,62 @@ class AdminRpcHandler:
         lm = self.garage.system.layout_manager
         lm.apply_staged(p.get("version"))
         return {"version": lm.history.current().version}
+
+    async def op_layout_revert(self, p):
+        """Drop all staged role/parameter changes
+        (ref: cli/layout.rs cmd_revert_layout)."""
+        lm = self.garage.system.layout_manager
+        lm.revert_staged()
+        await lm.broadcast()
+        return {"version": lm.history.current().version}
+
+    async def op_layout_config(self, p):
+        """Stage layout parameters — currently zone_redundancy
+        (ref: cli/structs.rs:113-123 layout config -r)."""
+        lm = self.garage.system.layout_manager
+        zr = p.get("zone_redundancy")
+        if zr is None:
+            raise ValueError("zone_redundancy is required")
+        if zr != "maximum":
+            zr = int(zr)
+            if zr < 1:
+                raise ValueError("zone_redundancy must be >= 1 or "
+                                 "'maximum'")
+        lm.history.stage_parameters(zr)
+        lm.save()  # staged params must survive a restart
+        await lm.broadcast()
+        cur = lm.history.staging.parameters.value
+        return {"staged_parameters": cur}
+
+    async def op_layout_skip_dead_nodes(self, p):
+        """Advance the ack (and, with allow_missing_data, sync) trackers
+        of DOWN nodes to `version`, so a permanently lost node no longer
+        wedges tracker convergence and old-version GC
+        (ref: cli/layout.rs cmd_layout_skip_dead_nodes,
+        cli/structs.rs:182)."""
+        lm = self.garage.system.layout_manager
+        hist = lm.history
+        version = p.get("version") or hist.current().version
+        if version > hist.current().version:
+            raise ValueError(f"version {version} is in the future")
+        allow_missing = bool(p.get("allow_missing_data"))
+        updated = []
+        for node in hist.all_nongateway_nodes():
+            if self.garage.system.is_up(node):
+                continue
+            ch = hist.update_trackers.set_max("ack", node, version)
+            if allow_missing:
+                ch = hist.update_trackers.set_max("sync", node,
+                                                  version) or ch
+                ch = hist.update_trackers.set_max("sync_ack", node,
+                                                  version) or ch
+            if ch:
+                updated.append(node.hex())
+        if updated:
+            hist.cleanup_old_versions()
+            lm.save()
+            await lm.broadcast()
+        return {"updated": updated, "version": version}
 
     # ---- buckets -------------------------------------------------------
 

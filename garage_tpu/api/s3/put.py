@@ -77,17 +77,24 @@ def next_timestamp(existing: Optional[Object]) -> int:
     return max(now, max(v.timestamp for v in existing.versions) + 1)
 
 
-async def check_quotas(garage, bucket_id: bytes,
-                       size_hint: Optional[int], existing) -> None:
-    """Reject early when this upload would exceed the bucket's quotas
-    (ref: src/api/s3/put.rs check_quotas). Loads the bucket itself so
-    EVERY write path (put, copy, post_object, multipart complete)
-    enforces the same rule. `size_hint` is the declared payload length
-    (None = unknown: only the object-count quota can be enforced up
-    front); replacing an object frees its current size."""
+async def get_bucket_quotas(garage, bucket_id: bytes) -> dict:
     bucket = await garage.bucket_table.get(bucket_id, b"")
     params = bucket.params if bucket is not None else None
-    q = (params.quotas.value if params is not None else None) or {}
+    return (params.quotas.value if params is not None else None) or {}
+
+
+async def check_quotas(garage, bucket_id: bytes,
+                       size_hint: Optional[int], existing,
+                       quotas: Optional[dict] = None) -> None:
+    """Reject when this upload would exceed the bucket's quotas
+    (ref: src/api/s3/put.rs check_quotas). Every write path (put, copy,
+    post_object, multipart complete) enforces the same rule: once early
+    with the declared length, and again after streaming with the REAL
+    total (a spoofed or missing length header must not bypass the size
+    quota). `size_hint` None = unknown: only the object-count quota can
+    be checked; replacing an object frees its current size."""
+    q = quotas if quotas is not None \
+        else await get_bucket_quotas(garage, bucket_id)
     max_size, max_objects = q.get("max_size"), q.get("max_objects")
     if max_size is None and max_objects is None:
         return
@@ -140,13 +147,19 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
         first_block, existing = await asyncio.gather(
             chunker.next(), garage.object_table.get(bucket_id, key.encode())
         )
-    await check_quotas(garage, bucket_id, content_length, existing)
+    quotas = await get_bucket_quotas(garage, bucket_id)
+    await check_quotas(garage, bucket_id, content_length, existing,
+                       quotas=quotas)
     first_block = first_block or b""
     uuid = gen_uuid()
     ts = next_timestamp(existing)
     md5 = hashlib.md5()
 
     if len(first_block) < INLINE_THRESHOLD:
+        if content_length is None:
+            # unknown declared length: enforce size quota on the actual
+            await check_quotas(garage, bucket_id, len(first_block),
+                               existing, quotas=quotas)
         md5.update(first_block)
         etag = md5.hexdigest()
         if content_md5 is not None and not _md5_matches(content_md5, etag):
@@ -179,6 +192,12 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
         total, md5_hex, etag, first_hash = await read_and_put_blocks(
             garage, version, 1, first_block, chunker, md5,
             checksummer=checksummer, sse_key=sse_key)
+        if total != content_length:
+            # the declared length was wrong or absent (spoofed
+            # x-amz-decoded-content-length, form upload with no length):
+            # re-check the size quota with the REAL streamed total
+            await check_quotas(garage, bucket_id, total, existing,
+                               quotas=quotas)
         if content_md5 is not None \
                 and not _md5_matches(content_md5, md5_hex):
             raise bad_request("Content-MD5 mismatch")

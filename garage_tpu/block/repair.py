@@ -14,12 +14,14 @@ The scrub cursor persists so a restart resumes mid-pass
 from __future__ import annotations
 
 import asyncio
+import itertools
 import logging
+import os
 import random
 import time
 
 from ..utils import migrate
-from ..utils.background import Throttled, Worker, WState
+from ..utils.background import Throttled, Worker, WorkerInfo, WState
 from ..utils.persister import Persister
 
 log = logging.getLogger("garage_tpu.block.repair")
@@ -209,6 +211,98 @@ class ScrubWorker(Worker):
             progress=self.state.cursor[:4].hex() if self.state.cursor else "-",
             tranquility=int(self.state.tranquility),
         )
+
+
+class RebalanceWorker(Worker):
+    """One-shot: move every stored block/shard file whose primary data
+    dir changed (multi-HDD layout update) to its new primary dir
+    (ref: src/block/repair.rs:531-640 RebalanceWorker). Walks all
+    candidate dirs; a file found outside its primary location is moved
+    (tmp+rename within the target dir); duplicate copies left by an
+    interrupted earlier pass are deduped in favour of the primary."""
+
+    def __init__(self, manager):
+        self.manager = manager
+        self.name = "block rebalance"
+        self._iter = None
+        self.moved = 0
+        self.freed_bytes = 0
+
+    def _rebalance_batch(self, hashes: list[bytes]) -> None:
+        m = self.manager
+        lay = m.data_layout
+        for h in hashes:
+            primary = lay.primary_dir(h)
+            for d in lay.candidate_dirs(h):
+                if d == primary or not os.path.isdir(d):
+                    continue
+                pre = h.hex()
+                for fn in os.listdir(d):
+                    if not fn.startswith(pre) or ".tmp" in fn \
+                            or fn.endswith(".corrupted"):
+                        continue
+                    src = os.path.join(d, fn)
+                    dst = os.path.join(primary, fn)
+                    try:
+                        size = os.path.getsize(src)
+                        if os.path.exists(dst):
+                            # stray copy: only drop it if the primary
+                            # copy is intact (size match) — a crash
+                            # mid-copy can leave a truncated dst, and
+                            # deleting src then would lose the block
+                            if os.path.getsize(dst) == size:
+                                os.remove(src)
+                                self.freed_bytes += size
+                            else:
+                                self._copy_over(src, dst)
+                                os.remove(src)
+                                self.moved += 1
+                                self.freed_bytes += size
+                            continue
+                        os.makedirs(primary, exist_ok=True)
+                        # same-FS fast path; cross-FS needs copy+rename
+                        try:
+                            os.rename(src, dst)
+                        except OSError:
+                            self._copy_over(src, dst)
+                            os.remove(src)
+                        self.moved += 1
+                        self.freed_bytes += size
+                    except OSError as e:
+                        log.warning("rebalance of %s failed: %s", src, e)
+
+    def _copy_over(self, src: str, dst: str) -> None:
+        """Durable cross-FS copy: tmp + (optional) fsync + rename, the
+        same discipline as BlockManager._write_file."""
+        tmp = dst + f".tmp-rb{os.getpid()}"
+        with open(src, "rb") as fsrc, open(tmp, "wb") as fdst:
+            fdst.write(fsrc.read())
+            if self.manager.fsync:
+                fdst.flush()
+                os.fsync(fdst.fileno())
+        os.replace(tmp, dst)
+        if self.manager.fsync:
+            dirfd = os.open(os.path.dirname(dst), os.O_RDONLY)
+            try:
+                os.fsync(dirfd)
+            finally:
+                os.close(dirfd)
+
+    async def work(self):
+        m = self.manager
+        if self._iter is None:
+            self._iter = m.iter_local_blocks_sorted()
+        batch = list(itertools.islice(self._iter, 64))
+        if not batch:
+            return WState.DONE
+        await asyncio.to_thread(self._rebalance_batch, batch)
+        return WState.BUSY
+
+    def info(self):
+        inf = WorkerInfo(name=self.name)
+        inf.progress = (f"moved {self.moved}, "
+                        f"freed {self.freed_bytes // (1 << 20)} MiB")
+        return inf
 
 
 class RepairWorker(Worker):

@@ -182,6 +182,28 @@ async def _layout(cli, args) -> int:
         r = await cli.call("layout_apply", version=args.version)
         print(f"layout applied, now at version {r['version']}")
         return 0
+    if s == "revert":
+        r = await cli.call("layout_revert")
+        print(f"staged changes reverted (layout stays at "
+              f"v{r['version']})")
+        return 0
+    if s == "config":
+        r = await cli.call("layout_config",
+                           zone_redundancy=args.zone_redundancy)
+        print(f"staged parameters: {r['staged_parameters']} "
+              f"(run `layout apply` to activate)")
+        return 0
+    if s == "skip-dead-nodes":
+        r = await cli.call("layout_skip_dead_nodes", version=args.version,
+                           allow_missing_data=args.allow_missing_data)
+        if r["updated"]:
+            print(f"advanced trackers to v{r['version']} for "
+                  f"{len(r['updated'])} dead node(s):")
+            for n in r["updated"]:
+                print(f"  {n[:16]}")
+        else:
+            print("no dead nodes with stale trackers")
+        return 0
     return 1
 
 
@@ -294,6 +316,16 @@ def build_parser() -> argparse.ArgumentParser:
     pr.add_argument("node")
     pap = pls.add_parser("apply")
     pap.add_argument("--version", type=int, default=None)
+    pls.add_parser("revert")
+    pcf = pls.add_parser("config")
+    pcf.add_argument("--zone-redundancy", "-r", dest="zone_redundancy",
+                     required=True,
+                     help="int or 'maximum' (zones per partition)")
+    psd = pls.add_parser("skip-dead-nodes")
+    psd.add_argument("--version", type=int, default=None)
+    psd.add_argument("--allow-missing-data", action="store_true",
+                     help="also advance sync trackers (accepts data "
+                          "loss on the dead nodes)")
     pb = sub.add_parser("bucket")
     pbs = pb.add_subparsers(dest="subcmd", required=True)
     pbs.add_parser("list")
@@ -336,7 +368,7 @@ def build_parser() -> argparse.ArgumentParser:
     prp = sub.add_parser("repair")
     prp.add_argument("what", choices=["tables", "versions", "mpu",
                                       "block-refs", "block-rc", "blocks",
-                                      "scrub"])
+                                      "rebalance", "scrub"])
     prp.add_argument("scrub_cmd", nargs="?", default="start",
                      choices=["start", "pause", "resume", "cancel"])
     pbl = sub.add_parser("block")

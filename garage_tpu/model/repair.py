@@ -191,6 +191,10 @@ def launch_repair(garage, what: str):
         from ..block.repair import RepairWorker
 
         runner.spawn_worker(RepairWorker(garage.block_manager))
+    elif what == "rebalance":
+        from ..block.repair import RebalanceWorker
+
+        runner.spawn_worker(RebalanceWorker(garage.block_manager))
     else:
         raise ValueError(f"unknown repair procedure {what!r}")
     return f"{what} repair worker launched"

@@ -205,7 +205,9 @@ class LayoutHistory(Migratable):
         self.cleanup_old_versions()
 
     def revert_staged_changes(self) -> None:
-        zr = self.staging.parameters.value.get("zone_redundancy", "maximum")
+        # drop staged PARAMETERS too: reverting restores the current
+        # version's zone_redundancy, not whatever was staged
+        zr = self.current().zone_redundancy
         self.staging = LayoutStaging(crdt.Lww.new({"zone_redundancy": zr}), crdt.LwwMap())
 
     # ---- merge + GC ----------------------------------------------------

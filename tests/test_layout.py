@@ -181,3 +181,55 @@ def test_tracker_gc_of_old_versions():
     assert h.min_stored() == 2
     # v0 was discarded (invalid/empty); v1 is archived for block lookup
     assert [v.version for v in h.old_versions] == [1]
+
+
+def test_skip_dead_nodes_unblocks_tracker_convergence():
+    """A permanently dead node wedges tracker GC forever; the
+    layout_skip_dead_nodes admin op advances its trackers so the old
+    version can be archived (ref: cli/layout.rs
+    cmd_layout_skip_dead_nodes, cli/structs.rs:182)."""
+    import asyncio
+    import types
+
+    from garage_tpu.admin.rpc import AdminRpcHandler
+
+    h = LayoutHistory.new(2)
+    for i in (1, 2, 3):
+        h.stage_role(nid(i), NodeRole(zone="z", capacity=1 << 30))
+    h.apply_staged_changes()
+    h.stage_role(nid(4), NodeRole(zone="z", capacity=1 << 30))
+    h.apply_staged_changes()
+    assert [v.version for v in h.versions] == [1, 2]
+    # live nodes fully ack v2; node 3 died before acking anything
+    for n in (nid(1), nid(2), nid(4)):
+        for which in ("ack", "sync", "sync_ack"):
+            h.update_trackers.set_max(which, n, 2)
+    h.cleanup_old_versions()
+    assert h.min_stored() == 1  # wedged by the dead node
+
+    class FakeLm:
+        history = h
+
+        @staticmethod
+        def save():
+            pass
+
+        @staticmethod
+        async def broadcast():
+            pass
+
+    system = types.SimpleNamespace(
+        layout_manager=FakeLm,
+        is_up=lambda node: node != nid(3),
+    )
+    handler = AdminRpcHandler.__new__(AdminRpcHandler)
+    handler.garage = types.SimpleNamespace(system=system)
+
+    r = asyncio.run(handler.op_layout_skip_dead_nodes(
+        {"allow_missing_data": True}))
+    assert r["updated"] == [nid(3).hex()]
+    assert h.min_stored() == 2  # convergence unblocked
+    # idempotent: second call finds nothing stale
+    r = asyncio.run(handler.op_layout_skip_dead_nodes(
+        {"allow_missing_data": True}))
+    assert r["updated"] == []

@@ -106,3 +106,80 @@ def test_repair_mpu_tombstones_orphan(tmp_path):
             await stop_all(garages, tasks)
 
     run(main())
+
+
+def test_rebalance_worker_moves_blocks_to_new_primary(tmp_path):
+    """Multi-HDD layout change: RebalanceWorker moves stored files to
+    their new primary dir and drops strays (ref: repair.rs:531-640)."""
+    import asyncio
+    import os
+
+    from garage_tpu.block import BlockManager, DataLayout
+    from garage_tpu.block.block import DataBlock
+    from garage_tpu.block.layout import DataDir
+    from garage_tpu.block.rc import BlockRc
+    from garage_tpu.block.repair import RebalanceWorker
+    from garage_tpu.block.resync import BlockResyncManager
+    from garage_tpu.db import open_db
+    from garage_tpu.utils.background import WState
+    from garage_tpu.utils.data import blake3sum
+
+    class _Sys:
+        id = b"\x01" * 32
+        meta_dir = str(tmp_path)
+
+        class netapp:
+            @staticmethod
+            def endpoint(path):
+                class E:
+                    def set_handler(self, h):
+                        return self
+
+                return E()
+
+    d1, d2 = str(tmp_path / "hdd1"), str(tmp_path / "hdd2")
+    db = open_db(str(tmp_path / "db"), engine="memory")
+    m = BlockManager.__new__(BlockManager)
+    m.system = _Sys()
+    m.db = db
+    m.data_layout = DataLayout.initialize([DataDir(d1, 100)])
+    m.compression = False
+    m.fsync = False
+    m.rc = BlockRc(db)
+    from garage_tpu.block.codec import ReplicateCodec
+
+    m.codec = ReplicateCodec(1)
+    m.metrics = {"bytes_read": 0, "bytes_written": 0, "corruptions": 0,
+                 "resync_sent": 0, "resync_recv": 0}
+    m.resync = BlockResyncManager(m, db)
+
+    blobs = [os.urandom(5000) for _ in range(24)]
+    hashes = [blake3sum(b) for b in blobs]
+    for h, b in zip(hashes, blobs):
+        m.write_local(h, DataBlock.plain(b).pack())
+
+    # add a second drive with most of the capacity: many primaries move
+    m.data_layout = m.data_layout.update_dirs(
+        [DataDir(d1, 100), DataDir(d2, 900)])
+    moved_expected = [h for h in hashes
+                      if not m.data_layout.block_path(h).startswith(d1)]
+    assert moved_expected, "layout change should move some primaries"
+    # reads still work through the secondary dirs before rebalance
+    for h, b in zip(hashes, blobs):
+        assert DataBlock.unpack(m.read_local(h)).plain_bytes() == b
+
+    async def run_worker():
+        w = RebalanceWorker(m)
+        while await w.work() is not WState.DONE:
+            pass
+        return w
+
+    w = asyncio.run(run_worker())
+    assert w.moved == len(moved_expected)
+    for h, b in zip(hashes, blobs):
+        primary = m.data_layout.block_path(h)
+        assert os.path.exists(primary), h.hex()
+        assert DataBlock.unpack(m.read_local(h)).plain_bytes() == b
+    # second pass is a no-op
+    w2 = asyncio.run(run_worker())
+    assert w2.moved == 0

@@ -10,6 +10,7 @@ code).
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import signal
 import socket
@@ -1465,7 +1466,7 @@ def test_admin_update_bucket_quotas_and_website(server, client):
     assert info["websiteAccess"] is False
 
     # set quotas + website config in one UpdateBucket call
-    st, info = _admin(server, "POST", f"/v1/bucket?id={bid}", body={
+    st, info = _admin(server, "PUT", f"/v1/bucket?id={bid}", body={
         "quotas": {"maxSize": 150000, "maxObjects": 2},
         "websiteAccess": {"enabled": True, "indexDocument": "index.html",
                           "errorDocument": "err.html"},
@@ -1512,7 +1513,7 @@ def test_admin_update_bucket_quotas_and_website(server, client):
     assert st == 200, body
 
     # disable website + clear quotas
-    st, info = _admin(server, "POST", f"/v1/bucket?id={bid}", body={
+    st, info = _admin(server, "PUT", f"/v1/bucket?id={bid}", body={
         "quotas": {"maxSize": None, "maxObjects": None},
         "websiteAccess": {"enabled": False},
     })
@@ -1524,19 +1525,19 @@ def test_admin_update_bucket_quotas_and_website(server, client):
     assert st == 200, body
 
     # invalid quota values are a 400 (and must not half-apply)
-    st, _ = _admin(server, "POST", f"/v1/bucket?id={bid}", body={
+    st, _ = _admin(server, "PUT", f"/v1/bucket?id={bid}", body={
         "websiteAccess": {"enabled": True, "indexDocument": "i.html"},
         "quotas": {"maxSize": -5}})
     assert st == 400
     st, info = _admin(server, "GET", f"/v1/bucket?id={bid}")
     assert info["websiteAccess"] is False  # atomic: nothing applied
     # malformed shapes are 400, not 500
-    st, _ = _admin(server, "POST", f"/v1/bucket?id={bid}",
+    st, _ = _admin(server, "PUT", f"/v1/bucket?id={bid}",
                    body={"websiteAccess": True})
     assert st == 400
 
     # multipart uploads are quota-checked at completion
-    st, info = _admin(server, "POST", f"/v1/bucket?id={bid}",
+    st, info = _admin(server, "PUT", f"/v1/bucket?id={bid}",
                       body={"quotas": {"maxSize": 100000}})
     assert st == 200
     st, _, body = client.request("POST", "/quota-bucket/mpu-big",
@@ -1557,3 +1558,75 @@ def test_admin_update_bucket_quotas_and_website(server, client):
         query=[("uploadId", upload_id)], body=complete)
     assert st == 403, body
     assert xml_error_code(body) == "AccessDenied"
+
+
+# ---- operator CLI surface (ref: garage/cli/structs.rs:113-123) ----------
+
+
+def test_cli_layout_config_and_revert(server):
+    out = server.cli("layout", "config", "-r", "maximum")
+    assert "zone_redundancy" in out and "maximum" in out
+    out = server.cli("layout", "config", "-r", "1")
+    assert "'zone_redundancy': 1" in out
+    # stage a bogus assignment, then revert drops it
+    out = server.cli("status")
+    node_id = next(line.split()[-1] for line in out.splitlines()
+                   if line.startswith("node id:"))
+    server.cli("layout", "assign", node_id, "-z", "dc9", "-c", "2G")
+    out = server.cli("layout", "show")
+    assert "staged changes:" in out
+    out = server.cli("layout", "revert")
+    assert "reverted" in out
+    out = server.cli("layout", "show")
+    assert "staged changes:" not in out
+
+
+def test_cli_layout_skip_dead_nodes(server):
+    # single healthy node: nothing to skip
+    out = server.cli("layout", "skip-dead-nodes", "--allow-missing-data")
+    assert "no dead nodes" in out
+
+
+def test_cli_repair_rebalance(server):
+    out = server.cli("repair", "rebalance")
+    assert "rebalance" in out
+
+
+def test_k2v_cli_roundtrip(server):
+    """k2v-cli binary (ref: k2v-client/bin/k2v-cli.rs) against the real
+    forked server."""
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               AWS_ACCESS_KEY_ID=server.key_id,
+               AWS_SECRET_ACCESS_KEY=server.secret)
+
+    def k2vcli(*args, check=True):
+        r = subprocess.run(
+            [sys.executable, "-m", "garage_tpu.cli.k2v",
+             "--port", str(server.k2v_port), "--bucket", "k2vcli-bucket",
+             *args],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=60)
+        if check and r.returncode != 0:
+            raise AssertionError(f"k2v-cli {args}: {r.stdout}{r.stderr}")
+        return r
+
+    # bucket via S3 admin surface
+    c = S3Client("127.0.0.1", server.s3_port, server.key_id, server.secret)
+    st, _, _ = c.request("PUT", "/k2vcli-bucket")
+    assert st == 200
+
+    r = k2vcli("insert", "pk1", "sk1", "hello world")
+    assert "ok" in r.stdout
+    r = k2vcli("read", "pk1", "sk1")
+    out = json.loads(r.stdout)
+    assert out["values"] == [{"utf8": "hello world"}]
+    causality = out["causality"]
+    r = k2vcli("read-index")
+    assert any(json.loads(line)["partitionKey"] == "pk1"
+               for line in r.stdout.splitlines())
+    r = k2vcli("read-range", "pk1")
+    assert "sk1" in r.stdout
+    r = k2vcli("delete", "pk1", "sk1", "-c", causality)
+    assert "ok" in r.stdout
+    # read-after-delete surfaces the causal tombstone
+    r = k2vcli("read", "pk1", "sk1")
+    assert json.loads(r.stdout)["values"] == [{"tombstone": True}]
