@@ -69,6 +69,14 @@ class AdminHttpServer:
         if path == "/metrics":
             if not self._authorized(req, self.garage.config.metrics_token):
                 return Response(403, [], b"forbidden")
+            import asyncio
+
+            # the first table_size_bytes read scans each table for its
+            # baseline — do that off the event loop; afterwards it is a
+            # cached base + delta read
+            await asyncio.to_thread(
+                lambda: [t.data.size_bytes()
+                         for t in self.garage.all_tables()])
             return Response(200,
                             [("content-type",
                               "text/plain; version=0.0.4")],
@@ -428,6 +436,20 @@ class AdminHttpServer:
             s = t.data.stats()
             for k, v in s.items():
                 gauge(f"table_{k}", v, table=t.name)
+            gauge("table_size_bytes", t.data.size_bytes(), table=t.name)
+
+        # per-node status + ping gauges (ref: rpc/system_metrics.rs:302)
+        for peer in g.system.peering.get_peer_list():
+            nid = peer.id.hex()[:16]
+            gauge("cluster_node_up",
+                  1 if peer.state.value == "connected"
+                  or peer.id == g.system.id else 0, node=nid)
+            if peer.ping_avg is not None:
+                gauge("cluster_node_ping_avg_seconds", round(peer.ping_avg, 6),
+                      node=nid)
+            if peer.ping_max is not None:
+                gauge("cluster_node_ping_max_seconds", round(peer.ping_max, 6),
+                      node=nid)
 
         # op counters/durations from the process-wide registry
         # (rpc/table/api/block series; ref: rpc/metrics.rs etc.)

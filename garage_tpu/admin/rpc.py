@@ -121,8 +121,7 @@ class AdminRpcHandler:
         """Drop all staged role/parameter changes
         (ref: cli/layout.rs cmd_revert_layout)."""
         lm = self.garage.system.layout_manager
-        lm.revert_staged()
-        await lm.broadcast()
+        lm.revert_staged()  # _changed() persists + schedules broadcast
         return {"version": lm.history.current().version}
 
     async def op_layout_config(self, p):

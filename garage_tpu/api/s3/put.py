@@ -156,8 +156,9 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
     md5 = hashlib.md5()
 
     if len(first_block) < INLINE_THRESHOLD:
-        if content_length is None:
-            # unknown declared length: enforce size quota on the actual
+        if content_length != len(first_block):
+            # declared length absent or wrong (spoofed
+            # x-amz-decoded-content-length): enforce on the actual size
             await check_quotas(garage, bucket_id, len(first_block),
                                existing, quotas=quotas)
         md5.update(first_block)

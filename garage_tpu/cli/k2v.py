@@ -46,6 +46,9 @@ def _print_value(v) -> None:
 
 
 def main(argv=None) -> int:
+    from . import reset_sigpipe
+
+    reset_sigpipe()
     p = argparse.ArgumentParser(prog="k2v-cli")
     p.add_argument("--host", default=os.environ.get("K2V_HOST", "127.0.0.1"))
     p.add_argument("--port", type=int,

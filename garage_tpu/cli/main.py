@@ -390,6 +390,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main() -> None:
+    from . import reset_sigpipe
+
+    reset_sigpipe()
     args = build_parser().parse_args()
     if args.cmd == "worker" and getattr(args, "subcmd", None) is None:
         args.subcmd = "list"

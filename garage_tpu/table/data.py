@@ -54,6 +54,9 @@ class TableData:
         # listeners called (outside the tx) after local changes; used by
         # k2v-style subscriptions and tests
         self.changed_hooks: list[Callable[[Entry], None]] = []
+        # table_size accounting (see size_bytes)
+        self._bytes_base: Optional[int] = None
+        self._bytes_delta = 0
 
     # ---- reads ---------------------------------------------------------
 
@@ -147,6 +150,9 @@ class TableData:
             old = (self.schema.decode_entry(old_raw)
                    if old_raw is not None else None)
             tx.insert(self.store, k, new_raw)
+            delta = len(new_raw) - (len(old_raw) if old_raw is not None
+                                    else -len(k))
+            tx.on_commit(lambda: self._apply_bytes_delta(delta))
             tx.insert(self.merkle_todo, k, blake2sum(new_raw))
             self.schema.updated(tx, old, new)
             self._maybe_gc_todo(tx, new, k, new_raw)
@@ -194,6 +200,8 @@ class TableData:
                 return False
             old = self.schema.decode_entry(cur)
             tx.remove(self.store, k)
+            freed = len(cur) + len(k)
+            tx.on_commit(lambda: self._apply_bytes_delta(-freed))
             tx.insert(self.merkle_todo, k, b"")
             self.schema.updated(tx, old, None)
             return True
@@ -227,3 +235,19 @@ class TableData:
             "gc_todo": len(self.gc_todo),
             "insert_queue": len(self.insert_queue),
         }
+
+    def _apply_bytes_delta(self, delta: int) -> None:
+        # on_commit only: a rolled-back tx must not skew the metric
+        self._bytes_delta += delta
+
+    def size_bytes(self) -> int:
+        """Approximate stored bytes (keys + encoded rows) for the
+        table_size metric family (ref: table/metrics.rs:132 table_size).
+        Baseline is computed by one scan on first call; afterwards the
+        two commit paths maintain an incremental delta."""
+        if self._bytes_base is None:
+            base = 0
+            for k, v in self.iter_all():
+                base += len(k) + len(v)
+            self._bytes_base = base - self._bytes_delta
+        return self._bytes_base + self._bytes_delta
