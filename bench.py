@@ -90,22 +90,53 @@ def bench_rs_encode(jax, platform: str) -> float:
     return best
 
 
-def bench_blake3(jax, platform: str) -> float:
+def bench_blake3(jax, platform: str) -> tuple[float, float]:
+    """-> (end_to_end_gbps, device_resident_gbps).
+
+    end_to_end includes the host->device transfer each call (what a
+    host-resident data path pays); device_resident chains iterations on
+    device data with a digest fold (no overlap possible) — the kernel's
+    own rate, which is what the PUT pipeline gets when blocks are
+    already device-resident after the RS encode (DEVICE_PATH.md)."""
+    import jax.numpy as jnp
+
     from garage_tpu.ops import treehash
 
     if platform == "cpu":
         batch, iters = 4, 2
     else:
-        batch, iters = 32, 5
+        batch, iters = 32, 8
     rng = np.random.default_rng(1)
     msgs = rng.integers(0, 256, size=(batch, 1 << 20), dtype=np.uint8)
     lengths = np.full(batch, 1 << 20, dtype=np.int32)
     treehash.hash_batch_jax(msgs, lengths)  # compile + warm
     t0 = time.perf_counter()
-    for _ in range(iters):
+    for _ in range(max(iters // 2, 2)):
         treehash.hash_batch_jax(msgs, lengths)
     dt = time.perf_counter() - t0
-    return batch * (1 << 20) * iters / dt / 1e9
+    e2e = batch * (1 << 20) * max(iters // 2, 2) / dt / 1e9
+
+    n_chunks = (1 << 20) // treehash.CHUNK_LEN
+    rows = jnp.asarray(msgs)
+    lengths_d = jax.device_put(lengths)
+
+    @jax.jit
+    def step(x):
+        cv = treehash.hash_rows(x, lengths_d, n_chunks)  # (B, 8) u32
+        fold = jnp.broadcast_to(cv.astype(jnp.uint8)[:, :1], x.shape)
+        return x ^ fold
+
+    x = step(rows)
+    x.block_until_ready()
+    best = 0.0
+    for _rep in range(3):  # best-of-3 against tunnel dispatch noise
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            x = step(x)
+        x.block_until_ready()
+        dt = time.perf_counter() - t0
+        best = max(best, batch * (1 << 20) * iters / dt / 1e9)
+    return e2e, best
 
 
 async def _build_cluster(tmp: str, n: int, rm, device_mode: str,
@@ -241,11 +272,13 @@ async def _put_cluster_bench(tmp: str, platform: str, nblocks: int,
     scrubber = ScrubWorker(mgr1)
     await scrubber.scrub_batch(hashes[:4])  # warm/compile
     await _settle_feeder(mgr1.feeder)
-    t0 = time.perf_counter()
-    bad = 0
-    for i in range(0, nblocks, 32):
-        bad += await scrubber.scrub_batch(hashes[i:i + 32])
-    scrub_bps = nblocks / (time.perf_counter() - t0)
+    scrub_bps, bad = 0.0, 0
+    for _rep in range(2):  # best-of-2 against co-tenant noise
+        t0 = time.perf_counter()
+        bad = 0
+        for i in range(0, nblocks, 32):
+            bad += await scrubber.scrub_batch(hashes[i:i + 32])
+        scrub_bps = max(scrub_bps, nblocks / (time.perf_counter() - t0))
 
     feeder_stats = dict(managers[0].feeder.stats)
     feeder_perf = {**managers[0].feeder.perf_summary(),
@@ -281,7 +314,9 @@ def main() -> None:
         extra["probe_error"] = probe["error"]
 
     gbps = bench_rs_encode(jax, platform)
-    extra["blake3_gbps"] = round(bench_blake3(jax, platform), 3)
+    b3_e2e, b3_dev = bench_blake3(jax, platform)
+    extra["blake3_gbps"] = round(b3_e2e, 3)
+    extra["blake3_device_gbps"] = round(b3_dev, 3)
 
     nblocks = 16 if platform == "cpu" else 128
     base = "/dev/shm" if os.path.isdir("/dev/shm") else None

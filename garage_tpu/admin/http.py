@@ -69,14 +69,16 @@ class AdminHttpServer:
         if path == "/metrics":
             if not self._authorized(req, self.garage.config.metrics_token):
                 return Response(403, [], b"forbidden")
-            import asyncio
-
             # the first table_size_bytes read scans each table for its
-            # baseline — do that off the event loop; afterwards it is a
-            # cached base + delta read
-            await asyncio.to_thread(
-                lambda: [t.data.size_bytes()
-                         for t in self.garage.all_tables()])
+            # baseline — do that off the event loop ONCE; steady-state
+            # scrapes read the cached base + delta inline
+            if any(t.data._bytes_base is None
+                   for t in self.garage.all_tables()):
+                import asyncio
+
+                await asyncio.to_thread(
+                    lambda: [t.data.size_bytes()
+                             for t in self.garage.all_tables()])
             return Response(200,
                             [("content-type",
                               "text/plain; version=0.0.4")],

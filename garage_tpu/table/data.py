@@ -244,10 +244,16 @@ class TableData:
         """Approximate stored bytes (keys + encoded rows) for the
         table_size metric family (ref: table/metrics.rs:132 table_size).
         Baseline is computed by one scan on first call; afterwards the
-        two commit paths maintain an incremental delta."""
+        commit paths maintain an incremental delta via on_commit."""
         if self._bytes_base is None:
-            base = 0
-            for k, v in self.iter_all():
-                base += len(k) + len(v)
-            self._bytes_base = base - self._bytes_delta
+            # scan inside a transaction: commits serialize against it,
+            # so no concurrent write can land between the snapshot and
+            # the base assignment (which would skew the base forever)
+            def body(tx):
+                base = 0
+                for k, v in self.store.iter():
+                    base += len(k) + len(v)
+                self._bytes_base = base - self._bytes_delta
+
+            self.db.transaction(body)
         return self._bytes_base + self._bytes_delta

@@ -164,10 +164,15 @@ class TableSyncer(Worker):
                 )
             # delete only rows unchanged since we read them
             def body(tx):
+                freed = 0
                 for k, v in batch:
                     if tx.get(self.data.store, k) == v:
                         tx.remove(self.data.store, k)
                         tx.insert(self.data.merkle_todo, k, b"")
+                        freed += len(k) + len(v)
+                if freed:
+                    tx.on_commit(
+                        lambda: self.data._apply_bytes_delta(-freed))
 
             self.data.db.transaction(body)
             self.data.merkle_todo_notify.set()

@@ -156,7 +156,6 @@ cmp "$TMP/obj1" "$TMP/obj1.back2" || die "degraded GET mismatch"
 PIDS[2]=$!
 
 say "website: vhost serving via curl Host header"
-ADMIN="-H Authorization:Bearer\ smoke-admin-token"
 BUCKET_ID=$(curl -sf -H "Authorization: Bearer smoke-admin-token" \
     "http://127.0.0.1:$ADM1/v1/bucket?globalAlias=smoke" \
     | "$PY" -c 'import json,sys; print(json.load(sys.stdin)["id"])')
@@ -190,11 +189,15 @@ OUT=$("$PY" -m garage_tpu.cli.k2v --port "$K2V1" --bucket smoke \
     && echo "$OUT" | grep -q "hello from smoke" || die "k2v read: $OUT"
 
 say "admin: cluster healthy + metrics served"
-curl -sf -H "Authorization: Bearer smoke-admin-token" \
-    "http://127.0.0.1:$ADM1/v1/health" | grep -qE '"(healthy|degraded)"' \
+retry() { # transient-proof: the admin API shares the node's event loop
+    for _ in $(seq 1 10); do "$@" && return 0; sleep 0.5; done
+    return 1
+}
+retry bash -c 'curl -sfm 20 -H "Authorization: Bearer smoke-admin-token" \
+    "http://127.0.0.1:'"$ADM1"'/v1/health" | grep -qE "\"(healthy|degraded)\""' \
     || die "cluster not healthy"
-curl -sf -H "Authorization: Bearer smoke-admin-token" \
-    "http://127.0.0.1:$ADM1/metrics" | grep -q cluster_healthy \
+retry bash -c 'curl -sfm 20 -H "Authorization: Bearer smoke-admin-token" \
+    "http://127.0.0.1:'"$ADM1"'/metrics" | grep -q cluster_healthy' \
     || die "metrics missing"
 
 say "ALL SMOKE TESTS PASSED"
