@@ -59,6 +59,8 @@ class AdminClient:
 
 
 async def cmd(args) -> int:
+    if args.cmd in ("convert-db", "repair-offline"):
+        return await _offline(args)  # no server connection
     cfg = read_config(args.config)
     cli = AdminClient(cfg)
     try:
@@ -66,6 +68,72 @@ async def cmd(args) -> int:
         return await _dispatch(cli, args)
     finally:
         await cli.close()
+
+
+async def _offline(args) -> int:
+    """Offline maintenance: operates directly on the metadata db with
+    NO server running (ref: src/garage/cli/convert_db.rs +
+    src/garage/repair/offline.rs)."""
+    if args.cmd == "convert-db":
+        from ..db import open_db
+
+        src_db_file = (args.src if args.src.endswith(".sqlite")
+                       else os.path.join(args.src, "db.sqlite"))
+        if args.src_engine == "sqlite" and not os.path.exists(src_db_file):
+            # open_db would CREATE an empty db at a typo'd path and the
+            # "conversion" would silently produce nothing
+            print(f"source database {src_db_file} does not exist",
+                  file=sys.stderr)
+            return 1
+        if os.path.abspath(args.src) == os.path.abspath(args.dst):
+            print("--src and --dst are the same path", file=sys.stderr)
+            return 1
+        src = open_db(args.src, engine=args.src_engine)
+        dst = open_db(args.dst, engine=args.dst_engine)
+        try:
+            if dst.list_trees():
+                print("destination database is not empty; refusing to "
+                      "interleave rows", file=sys.stderr)
+                return 1
+            total = 0
+            for name in src.list_trees():
+                st = src.open_tree(name)
+                dt = dst.open_tree(name)
+
+                def copy(tx, st=st, dt=dt):
+                    n = 0
+                    for k, v in st.iter():
+                        tx.insert(dt, k, v)
+                        n += 1
+                    return n
+
+                rows = dst.transaction(copy)
+                total += rows
+                print(f"  {name}: {rows} rows")
+            print(f"converted {total} rows "
+                  f"({args.src_engine} -> {args.dst_engine})")
+        finally:
+            src.close()
+            dst.close()
+        return 0
+    if args.cmd == "repair-offline":
+        cfg = read_config(args.config)
+        from ..model.garage import Garage
+
+        garage = Garage(cfg)
+        if args.what == "object-counters":
+            n = garage.object_counter.recount(garage.object_table.data)
+            n += garage.mpu_counter.recount(garage.mpu_table.data)
+            print(f"recomputed {n} object/mpu counter rows")
+        elif args.what == "k2v-counters":
+            n = garage.k2v_counter.recount(garage.k2v_item_table.data)
+            print(f"recomputed {n} k2v counter rows")
+        else:
+            print(f"unknown offline repair {args.what!r}", file=sys.stderr)
+            return 1
+        garage.db.close()
+        return 0
+    return 1
 
 
 async def _dispatch(cli: AdminClient, args) -> int:
@@ -386,6 +454,17 @@ def build_parser() -> argparse.ArgumentParser:
     pms = pm.add_subparsers(dest="subcmd", required=True)
     pms.add_parser("snapshot")
     sub.add_parser("stats")
+    pcv = sub.add_parser("convert-db",
+                         help="offline: copy all metadata trees between "
+                              "db engines/paths (server must be stopped)")
+    pcv.add_argument("--src", required=True)
+    pcv.add_argument("--src-engine", default="sqlite")
+    pcv.add_argument("--dst", required=True)
+    pcv.add_argument("--dst-engine", default="sqlite")
+    pro = sub.add_parser("repair-offline",
+                         help="offline: recompute index counters from "
+                              "the stored tables (server must be stopped)")
+    pro.add_argument("what", choices=["object-counters", "k2v-counters"])
     return p
 
 

@@ -147,6 +147,62 @@ class IndexCounter:
         )
         self.table.queue_insert(tx, entry)
 
+    def recount(self, data) -> int:
+        """OFFLINE repair: rebuild this node's local counters from a
+        full scan of the counted table, replacing whatever incremental
+        state drifted (ref: src/garage/repair/offline.rs:11 +
+        index_counter.rs recalculation). Returns the number of counter
+        rows rewritten. MUST run with the server stopped (stated in the
+        CLI help; there is no lock-file guard — a concurrent live
+        count() landing between the scan and the rewrite would be
+        overwritten by stale totals whose fresher timestamp then wins
+        the CRDT merge cluster-wide). The rewritten counter-table
+        entries gossip out through normal anti-entropy at next boot."""
+        agg: dict[tuple[bytes, bytes], dict[str, int]] = {}
+        key_of: dict[bytes, tuple[bytes, bytes]] = {}
+        for _k, raw in data.iter_all():
+            e = data.decode_stored(raw)
+            pksk = (e.counter_partition_key(), e.counter_sort_key())
+            key_of[tree_key(*pksk)] = pksk
+            d = agg.setdefault(pksk, {})
+            for name, v in e.counts():
+                d[name] = d.get(name, 0) + v
+        # stale local-counter rows (counted rows all gone) get zeroed;
+        # tree keys are invertible, so no table row is needed
+        from ..table.schema import split_tree_key
+
+        stale: list[tuple[bytes, tuple[bytes, bytes]]] = [
+            (k, split_tree_key(k))
+            for k, _ in self.local_counter.iter() if k not in key_of
+        ]
+        now = now_msec()
+        n = 0
+        todo = [(tree_key(*pksk), pksk) for pksk in agg] + stale
+        for key, pksk in todo:
+            counts = agg.get(pksk, {})
+
+            def body(tx, key=key, counts=counts):
+                raw = tx.get(self.local_counter, key)
+                local = {}
+                if raw is not None:
+                    local = {name: (ts, v)
+                             for name, ts, v in msgpack.unpackb(raw)}
+                names = set(local) | set(counts)
+                for name in names:
+                    ts, _old = local.get(name, (0, 0))
+                    local[name] = (max(ts + 1, now), counts.get(name, 0))
+                tx.insert(self.local_counter, key, msgpack.packb(
+                    [[nm, ts, v] for nm, (ts, v) in sorted(local.items())]))
+                return local
+
+            local = self.table.data.db.transaction(body)
+            self.table.data.update_entry_decoded(CounterEntry(
+                pksk[0], pksk[1],
+                {name: {self.this_node: tv}
+                 for name, tv in local.items()}))
+            n += 1
+        return n
+
     async def read(self, pk: bytes, sk: bytes,
                    nodes: list[bytes]) -> dict[str, int]:
         e = await self.table.get(pk, sk)

@@ -53,6 +53,12 @@ def tree_key(pk: bytes, sk: bytes) -> bytes:
     return partition_hash(pk) + len(pk).to_bytes(4, "big") + pk + sk
 
 
+def split_tree_key(key: bytes) -> tuple[bytes, bytes]:
+    """Inverse of tree_key: -> (pk, sk)."""
+    plen = int.from_bytes(key[32:36], "big")
+    return key[36:36 + plen], key[36 + plen:]
+
+
 class TableSchema:
     """Binds a table name to an entry type + triggers.
     ref: table/schema.rs:71."""

@@ -50,6 +50,7 @@ class Config:
     block_hash_algo: str = "blake3"
 
     rpc_secret: Optional[str] = None
+    rpc_secret_file: Optional[str] = None
     rpc_bind_addr: str = "127.0.0.1:3901"
     rpc_public_addr: Optional[str] = None
     bootstrap_peers: list[str] = field(default_factory=list)
@@ -68,7 +69,9 @@ class Config:
     k2v_api_bind_addr: Optional[str] = None
     admin_api_bind_addr: Optional[str] = None
     admin_token: Optional[str] = None
+    admin_token_file: Optional[str] = None
     metrics_token: Optional[str] = None
+    metrics_token_file: Optional[str] = None
     web_bind_addr: Optional[str] = None
     web_root_domain: str = ".web.garage"
 
@@ -156,10 +159,53 @@ def config_from_dict(raw: dict) -> Config:
                 val = parse_capacity(val)
             setattr(cfg, key, val)
         # unknown keys ignored (forward compat)
-    if os.environ.get("GARAGE_RPC_SECRET"):
-        cfg.rpc_secret = os.environ["GARAGE_RPC_SECRET"]
-    if os.environ.get("GARAGE_ADMIN_TOKEN"):
-        cfg.admin_token = os.environ["GARAGE_ADMIN_TOKEN"]
+    fill_secrets(cfg)
     if not cfg.metadata_dir:
         raise ValueError("metadata_dir is required")
     return cfg
+
+
+def _read_secret_file(path: str) -> str:
+    """Read a one-line secret file with a permission check: refuse
+    group/world-readable files unless GARAGE_ALLOW_WORLD_READABLE_SECRETS
+    is set (ref: src/garage/secrets.rs:54-120)."""
+    if not os.environ.get("GARAGE_ALLOW_WORLD_READABLE_SECRETS"):
+        mode = os.stat(path).st_mode
+        if mode & 0o077:
+            raise ValueError(
+                f"secret file {path} is readable by other users "
+                f"(mode {mode & 0o777:03o}); chmod 600 it or set "
+                "GARAGE_ALLOW_WORLD_READABLE_SECRETS=1")
+    with open(path) as f:
+        return f.read().strip()
+
+
+def fill_secrets(cfg: "Config") -> None:
+    """Layered secret resolution, per secret: env var > env _FILE var >
+    config *_file > config inline (ref: src/garage/secrets.rs
+    fill_secrets — same precedence, CLI flags excepted). An env value
+    OVERRIDES config-file sources (that is the point of the layering —
+    rotation without editing the TOML); only the two env forms
+    conflicting is an error."""
+    for attr, env in (("rpc_secret", "GARAGE_RPC_SECRET"),
+                      ("admin_token", "GARAGE_ADMIN_TOKEN"),
+                      ("metrics_token", "GARAGE_METRICS_TOKEN")):
+        file_attr = f"{attr}_file"
+        env_val = os.environ.get(env)
+        env_file = os.environ.get(f"{env}_FILE")
+        if env_val and env_file:
+            raise ValueError(f"both {env} and {env}_FILE are set; "
+                             "pick one")
+        if env_val:
+            setattr(cfg, attr, env_val)
+            continue
+        if env_file:
+            setattr(cfg, attr, _read_secret_file(env_file))
+            continue
+        cfg_file = getattr(cfg, file_attr, None)
+        if cfg_file:
+            if getattr(cfg, attr, None):
+                raise ValueError(
+                    f"both {attr} and {file_attr} are set in the "
+                    "config; pick one")
+            setattr(cfg, attr, _read_secret_file(cfg_file))

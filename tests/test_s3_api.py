@@ -1645,3 +1645,151 @@ def test_k2v_cli_roundtrip(server):
     # read-after-delete surfaces the causal tombstone
     r = k2vcli("read", "pk1", "sk1")
     assert json.loads(r.stdout)["values"] == [{"tombstone": True}]
+
+
+def test_offline_convert_db_and_counter_repair(server, client):
+    """convert-db copies every tree; repair-offline object-counters
+    recomputes drifted counters (ref: cli/convert_db.rs,
+    repair/offline.rs). Runs against a STOPPED server's metadata."""
+    import shutil
+    import tempfile
+
+    # a fresh bucket with exactly two objects -> deterministic counters
+    st, _, _ = client.request("PUT", "/offline-bkt")
+    assert st == 200
+    st, _, _ = client.request("PUT", "/offline-bkt/offline-1",
+                              body=os.urandom(5000))
+    assert st == 200
+    st, _, _ = client.request("PUT", "/offline-bkt/offline-2",
+                              body=os.urandom(80000))
+    assert st == 200
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        st, info = _admin(server, "GET",
+                          "/v1/bucket?globalAlias=offline-bkt")
+        if st == 200 and info["objects"] == 2:
+            break
+        time.sleep(0.2)
+    assert info["objects"] == 2 and info["bytes"] == 85000
+    bid = info["id"]
+
+    work = tempfile.mkdtemp(prefix="gt_offline_")
+    try:
+        server.stop()
+        meta = os.path.join(server.dir, "meta")
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+                   GARAGE_TPU_DEVICE="off")
+
+        # convert-db round trip: sqlite -> sqlite copy has all trees
+        dst = os.path.join(work, "copy")
+        os.makedirs(dst)
+        r = subprocess.run(
+            [sys.executable, "-m", "garage_tpu.cli.main",
+             "--config", server.config_path, "convert-db",
+             "--src", os.path.join(meta, "db"), "--dst", dst],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "converted" in r.stdout
+        import sqlite3
+
+        src_c = sqlite3.connect(os.path.join(meta, "db", "db.sqlite"))
+        dst_c = sqlite3.connect(os.path.join(dst, "db.sqlite"))
+        q = ("select name from sqlite_master where type='table' "
+             "order by name")
+        assert [x[0] for x in src_c.execute(q)] == \
+            [x[0] for x in dst_c.execute(q)]
+        src_c.close(); dst_c.close()
+
+        # CORRUPT the local object counter, then offline repair must
+        # restore the true totals
+        from garage_tpu.db import open_db as _open_db
+        from garage_tpu.table.schema import tree_key as _tk
+
+        import msgpack as _mp
+
+        db = _open_db(os.path.join(meta, "db"), engine="sqlite")
+        lc = db.open_tree("local_counter:bucket_object_counter")
+        corrupted = 0
+
+        def corrupt(tx):
+            nonlocal corrupted
+            for k, v in lc.iter():
+                vals = _mp.unpackb(v)
+                vals = [[n, ts, v0 * 7 + 3] for n, ts, v0 in vals]
+                tx.insert(lc, k, _mp.packb(vals))
+                corrupted += 1
+
+        db.transaction(corrupt)
+        db.close()
+        assert corrupted > 0
+
+        r = subprocess.run(
+            [sys.executable, "-m", "garage_tpu.cli.main",
+             "--config", server.config_path, "repair-offline",
+             "object-counters"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "recomputed" in r.stdout
+
+        # the LOCAL counter tree itself must hold the true totals again
+        # (reading only the admin API after restart could be satisfied
+        # by the untouched counter table)
+        db = _open_db(os.path.join(meta, "db"), engine="sqlite")
+        lc = db.open_tree("local_counter:bucket_object_counter")
+        row = lc.get(_tk(bytes.fromhex(bid), b""))
+        db.close()
+        assert row is not None
+        vals = {n: v0 for n, _ts, v0 in _mp.unpackb(row)}
+        assert vals["objects"] == 2 and vals["bytes"] == 85000, vals
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+        server.start()  # restart for any later tests in the module
+
+    # after restart, the repaired counters are served again
+    deadline = time.monotonic() + 15
+    info = {}
+    while time.monotonic() < deadline:
+        st, info = _admin(server, "GET",
+                          "/v1/bucket?globalAlias=offline-bkt")
+        if st == 200 and info.get("objects") == 2:
+            break
+        time.sleep(0.3)
+    assert info["objects"] == 2 and info["bytes"] == 85000, info
+
+
+def test_secret_files_with_permission_checks(tmp_path):
+    """Layered secrets (ref: src/garage/secrets.rs): *_file config keys
+    read one-line files, refusing world-readable ones."""
+    from garage_tpu.utils.config import config_from_dict
+
+    sec = tmp_path / "rpc.secret"
+    sec.write_text("aa" * 32 + "\n")
+    os.chmod(sec, 0o600)
+    cfg = config_from_dict({"metadata_dir": str(tmp_path),
+                            "rpc_secret_file": str(sec)})
+    assert cfg.rpc_secret == "aa" * 32
+
+    os.chmod(sec, 0o644)
+    with pytest.raises(ValueError, match="readable by other"):
+        config_from_dict({"metadata_dir": str(tmp_path),
+                          "rpc_secret_file": str(sec)})
+    # escape hatch env
+    os.environ["GARAGE_ALLOW_WORLD_READABLE_SECRETS"] = "1"
+    try:
+        cfg = config_from_dict({"metadata_dir": str(tmp_path),
+                                "rpc_secret_file": str(sec)})
+        assert cfg.rpc_secret == "aa" * 32
+    finally:
+        del os.environ["GARAGE_ALLOW_WORLD_READABLE_SECRETS"]
+    # both inline and file -> error
+    with pytest.raises(ValueError, match="pick one"):
+        config_from_dict({"metadata_dir": str(tmp_path),
+                          "rpc_secret": "bb" * 32,
+                          "rpc_secret_file": str(sec)})
+    # env var wins over file
+    os.environ["GARAGE_ADMIN_TOKEN"] = "env-token"
+    try:
+        cfg = config_from_dict({"metadata_dir": str(tmp_path)})
+        assert cfg.admin_token == "env-token"
+    finally:
+        del os.environ["GARAGE_ADMIN_TOKEN"]
