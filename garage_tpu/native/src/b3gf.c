@@ -23,6 +23,8 @@
 #if defined(__x86_64__) || defined(__i386__)
 #include <immintrin.h>
 #define GT_X86 1
+static int cpu_sse42 = -1;
+static int cpu_avx2 = -1;
 #endif
 
 /* ================= BLAKE3 ================= */
@@ -135,8 +137,182 @@ static void parent_cv(const uint32_t l[8], const uint32_t r[8], int root,
     compress(IV, m, 0, BLOCK_LEN, PARENT | (root ? ROOT : 0), out);
 }
 
+/* ============ AVX2 8-way: vectorize ACROSS chunks/parents ============
+ * The standard BLAKE3 SIMD formulation from the public spec: eight
+ * independent compressions run in lockstep, one 32-bit word per lane.
+ * Used for full non-root chunks (identical flags across lanes) and for
+ * batches of parent nodes; everything else takes the portable path. */
+
+#ifdef GT_X86
+
+#define ROTR8(v, n) _mm256_or_si256(_mm256_srli_epi32(v, n), \
+                                    _mm256_slli_epi32(v, 32 - (n)))
+
+__attribute__((target("avx2")))
+static inline void g8(__m256i v[16], int a, int b, int c, int d,
+                      __m256i mx, __m256i my) {
+    v[a] = _mm256_add_epi32(_mm256_add_epi32(v[a], v[b]), mx);
+    v[d] = ROTR8(_mm256_xor_si256(v[d], v[a]), 16);
+    v[c] = _mm256_add_epi32(v[c], v[d]);
+    v[b] = ROTR8(_mm256_xor_si256(v[b], v[c]), 12);
+    v[a] = _mm256_add_epi32(_mm256_add_epi32(v[a], v[b]), my);
+    v[d] = ROTR8(_mm256_xor_si256(v[d], v[a]), 8);
+    v[c] = _mm256_add_epi32(v[c], v[d]);
+    v[b] = ROTR8(_mm256_xor_si256(v[b], v[c]), 7);
+}
+
+/* one compression over 8 lanes; m = 16 message-word vectors (mutated:
+ * physically permuted between rounds — an indexed schedule was tried
+ * and measured SLOWER, it forces m into memory instead of registers) */
+__attribute__((target("avx2")))
+static void compress8(__m256i cv[8], __m256i m[16], __m256i t0,
+                      uint32_t block_len, uint32_t flags,
+                      __m256i out[8]) {
+    __m256i v[16];
+    for (int i = 0; i < 8; i++)
+        v[i] = cv[i];
+    v[8] = _mm256_set1_epi32((int)IV[0]);
+    v[9] = _mm256_set1_epi32((int)IV[1]);
+    v[10] = _mm256_set1_epi32((int)IV[2]);
+    v[11] = _mm256_set1_epi32((int)IV[3]);
+    v[12] = t0;
+    v[13] = _mm256_setzero_si256(); /* chunk counters < 2^32 */
+    v[14] = _mm256_set1_epi32((int)block_len);
+    v[15] = _mm256_set1_epi32((int)flags);
+    __m256i t[16];
+    for (int r = 0;; r++) {
+        g8(v, 0, 4, 8, 12, m[0], m[1]);
+        g8(v, 1, 5, 9, 13, m[2], m[3]);
+        g8(v, 2, 6, 10, 14, m[4], m[5]);
+        g8(v, 3, 7, 11, 15, m[6], m[7]);
+        g8(v, 0, 5, 10, 15, m[8], m[9]);
+        g8(v, 1, 6, 11, 12, m[10], m[11]);
+        g8(v, 2, 7, 8, 13, m[12], m[13]);
+        g8(v, 3, 4, 9, 14, m[14], m[15]);
+        if (r == 6)
+            break;
+        for (int i = 0; i < 16; i++)
+            t[i] = m[MSG_PERM[i]];
+        for (int i = 0; i < 16; i++)
+            m[i] = t[i];
+    }
+    for (int i = 0; i < 8; i++)
+        out[i] = _mm256_xor_si256(v[i], v[i + 8]);
+}
+
+/* little-endian word load without alignment/aliasing UB (compiles to
+ * one mov on x86) */
+static inline uint32_t ldw(const uint8_t *p) {
+    uint32_t w;
+    memcpy(&w, p, 4);
+    return w;
+}
+
+/* transpose: load word j of one 64-byte block from 8 streams */
+__attribute__((target("avx2")))
+static inline void load_words8(const uint8_t *const p[8], size_t off,
+                               __m256i m[16]) {
+    for (int j = 0; j < 16; j++)
+        m[j] = _mm256_set_epi32(
+            (int)ldw(p[7] + off + 4 * j), (int)ldw(p[6] + off + 4 * j),
+            (int)ldw(p[5] + off + 4 * j), (int)ldw(p[4] + off + 4 * j),
+            (int)ldw(p[3] + off + 4 * j), (int)ldw(p[2] + off + 4 * j),
+            (int)ldw(p[1] + off + 4 * j), (int)ldw(p[0] + off + 4 * j));
+}
+
+/* 8 FULL non-root chunks -> 8 CVs (row-major: out[lane][word]) */
+__attribute__((target("avx2")))
+static void chunks8_cv(const uint8_t *const p[8], uint64_t counter0,
+                       uint32_t out[8][8]) {
+    __m256i cv[8], m[16];
+    for (int i = 0; i < 8; i++)
+        cv[i] = _mm256_set1_epi32((int)IV[i]);
+    __m256i t0 = _mm256_set_epi32(
+        (int)(uint32_t)(counter0 + 7), (int)(uint32_t)(counter0 + 6),
+        (int)(uint32_t)(counter0 + 5), (int)(uint32_t)(counter0 + 4),
+        (int)(uint32_t)(counter0 + 3), (int)(uint32_t)(counter0 + 2),
+        (int)(uint32_t)(counter0 + 1), (int)(uint32_t)(counter0));
+    for (int b = 0; b < CHUNK_LEN / BLOCK_LEN; b++) {
+        uint32_t flags = 0;
+        if (b == 0)
+            flags |= CHUNK_START;
+        if (b == CHUNK_LEN / BLOCK_LEN - 1)
+            flags |= CHUNK_END;
+        load_words8(p, (size_t)b * BLOCK_LEN, m);
+        compress8(cv, m, t0, BLOCK_LEN, flags, cv);
+    }
+    uint32_t tmp[8][8]; /* tmp[word][lane] */
+    for (int i = 0; i < 8; i++)
+        _mm256_storeu_si256((__m256i *)tmp[i], cv[i]);
+    for (int l = 0; l < 8; l++)
+        for (int i = 0; i < 8; i++)
+            out[l][i] = tmp[i][l];
+}
+
+/* 8 non-root parents: cvs[2*i], cvs[2*i+1] -> out[i] (row-major) */
+__attribute__((target("avx2")))
+static void parents8_cv(const uint32_t cvs[16][8], uint32_t out[8][8]) {
+    __m256i cv[8], m[16];
+    for (int i = 0; i < 8; i++)
+        cv[i] = _mm256_set1_epi32((int)IV[i]);
+    for (int j = 0; j < 8; j++) {
+        m[j] = _mm256_set_epi32(
+            (int)cvs[14][j], (int)cvs[12][j], (int)cvs[10][j],
+            (int)cvs[8][j], (int)cvs[6][j], (int)cvs[4][j],
+            (int)cvs[2][j], (int)cvs[0][j]);
+        m[8 + j] = _mm256_set_epi32(
+            (int)cvs[15][j], (int)cvs[13][j], (int)cvs[11][j],
+            (int)cvs[9][j], (int)cvs[7][j], (int)cvs[5][j],
+            (int)cvs[3][j], (int)cvs[1][j]);
+    }
+    __m256i o[8];
+    compress8(cv, m, _mm256_setzero_si256(), BLOCK_LEN, PARENT, o);
+    uint32_t tmp[8][8];
+    for (int i = 0; i < 8; i++)
+        _mm256_storeu_si256((__m256i *)tmp[i], o[i]);
+    for (int l = 0; l < 8; l++)
+        for (int i = 0; i < 8; i++)
+            out[l][i] = tmp[i][l];
+}
+
+#endif /* GT_X86 */
+
 /* Spec tree: left subtree = largest power of two of chunks strictly
  * less than the total. Recursion depth <= 54 for 64-bit lengths. */
+static void subtree_cv(const uint8_t *data, uint64_t len, uint64_t counter0,
+                       int root, uint32_t cv[8]);
+
+#ifdef GT_X86
+/* Whole-subtree CVs for a run of FULL chunks, 8-way where possible.
+ * `nchunks` must be a power of two >= 8 and the subtree non-root;
+ * returns the subtree's CV. */
+__attribute__((target("avx2")))
+static void subtree_cv_avx2(const uint8_t *data, uint64_t nchunks,
+                            uint64_t counter0, uint32_t cv[8]) {
+    /* hash all chunks 8 at a time */
+    uint32_t (*cvs)[8] = __builtin_alloca(
+        sizeof(uint32_t[8]) * (size_t)nchunks);
+    for (uint64_t c = 0; c < nchunks; c += 8) {
+        const uint8_t *p[8];
+        for (int l = 0; l < 8; l++)
+            p[l] = data + (size_t)(c + l) * CHUNK_LEN;
+        chunks8_cv(p, counter0 + c, &cvs[c]);
+    }
+    /* pairwise parent reduction, 8 parents at a time */
+    uint64_t n = nchunks;
+    while (n > 1) {
+        uint64_t half = n / 2;
+        uint64_t i = 0;
+        for (; i + 8 <= half; i += 8)
+            parents8_cv((const uint32_t(*)[8]) & cvs[2 * i], &cvs[i]);
+        for (; i < half; i++)
+            parent_cv(cvs[2 * i], cvs[2 * i + 1], 0, cvs[i]);
+        n = half;
+    }
+    memcpy(cv, cvs[0], 32);
+}
+#endif
+
 static void subtree_cv(const uint8_t *data, uint64_t len, uint64_t counter0,
                        int root, uint32_t cv[8]) {
     uint64_t nchunks = len == 0 ? 1 : (len + CHUNK_LEN - 1) / CHUNK_LEN;
@@ -144,6 +320,20 @@ static void subtree_cv(const uint8_t *data, uint64_t len, uint64_t counter0,
         chunk_cv(data, (size_t)len, counter0, root, cv);
         return;
     }
+#ifdef GT_X86
+    if (cpu_avx2 < 0)
+        cpu_avx2 = __builtin_cpu_supports("avx2") ? 1 : 0;
+    /* power-of-two run of full chunks, non-root: whole subtree 8-way.
+     * alloca bound: cap at 2^12 chunks (4 MiB data, 128 KiB of CVs —
+     * safe on worker-thread stacks); bigger subtrees recurse first. */
+    if (cpu_avx2 && !root && nchunks >= 8 && nchunks <= (1u << 12) &&
+        (nchunks & (nchunks - 1)) == 0 &&
+        len == nchunks * (uint64_t)CHUNK_LEN &&
+        counter0 + nchunks <= 0xFFFFFFFFu /* compress8 pins t1=0 */) {
+        subtree_cv_avx2(data, nchunks, counter0, cv);
+        return;
+    }
+#endif
     uint64_t left = 1;
     while (left * 2 < nchunks)
         left *= 2;
@@ -252,8 +442,6 @@ static uint32_t crc32c_hw(uint32_t crc, const uint8_t *p, uint64_t len) {
     return ~c32;
 }
 
-static int cpu_sse42 = -1;
-static int cpu_avx2 = -1;
 #endif
 
 uint32_t crc32c_update(uint32_t crc, const uint8_t *p, uint64_t len) {
