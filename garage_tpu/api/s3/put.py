@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import os
 from typing import Optional
 
 from ...block.manager import INLINE_THRESHOLD
@@ -27,6 +28,7 @@ from ..http import Request, Response
 from .xml import S3Error, bad_request
 
 PUT_BLOCKS_MAX_PARALLEL = 3  # ref: put.rs:42
+_MULTICORE = (os.cpu_count() or 1) > 1
 
 
 class Chunker:
@@ -280,7 +282,13 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
 
     try:
         while block is not None:
-            md5.update(block)
+            if _MULTICORE and len(block) >= 65536:
+                # hashlib releases the GIL: on multicore hosts, running
+                # the ETag MD5 in a worker thread lets OTHER concurrent
+                # requests' handlers run during this ~1.7 ms/MiB chain
+                await asyncio.to_thread(md5.update, block)
+            else:
+                md5.update(block)
             if checksummer is not None:
                 # pure-python CRCs are slow; keep them off the event loop
                 await asyncio.to_thread(checksummer.update, block)
