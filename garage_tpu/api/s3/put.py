@@ -270,28 +270,36 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
                         version.blocks.put((part_number, off),
                                            (h, plain_len)),
                         version.backlink)
-            await asyncio.gather(
-                garage.block_manager.rpc_put_block(
-                    h, blk, compress=False if sse_key is not None
-                    else None),
-                garage.version_table.insert(v),
-                garage.block_ref_table.insert(BlockRef.new(h, version.uuid)),
-            )
+            # version/block_ref rows ride the LOCAL insert queue (two
+            # tiny db txs) instead of two quorum RPCs per block — the
+            # reference's structure (put.rs:545); read_and_put_blocks
+            # flushes the queues through the quorum path before the
+            # caller commits the Complete row, so read-your-writes is
+            # preserved
+            garage.version_table.queue_insert_local(v)
+            garage.block_ref_table.queue_insert_local(
+                BlockRef.new(h, version.uuid))
+            await garage.block_manager.rpc_put_block(
+                h, blk, compress=False if sse_key is not None else None)
 
     from ...utils.tracing import span
 
     try:
         while block is not None:
+            # md5 (ETag) and the declared checksum are independent
+            # digests of the same block: run them concurrently in
+            # worker threads (both release the GIL) so the cost is
+            # max(), not sum(); on multicore the loop keeps serving
+            # other requests meanwhile
+            jobs = []
             if _MULTICORE and len(block) >= 65536:
-                # hashlib releases the GIL: on multicore hosts, running
-                # the ETag MD5 in a worker thread lets OTHER concurrent
-                # requests' handlers run during this ~1.7 ms/MiB chain
-                await asyncio.to_thread(md5.update, block)
+                jobs.append(asyncio.to_thread(md5.update, block))
             else:
                 md5.update(block)
             if checksummer is not None:
-                # pure-python CRCs are slow; keep them off the event loop
-                await asyncio.to_thread(checksummer.update, block)
+                jobs.append(asyncio.to_thread(checksummer.update, block))
+            if jobs:
+                await asyncio.gather(*jobs)
             plain_len = len(block)
             stored = (await asyncio.to_thread(sse_key.encrypt_block, block)
                       if sse_key is not None else block)
@@ -314,6 +322,11 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
                 block = await chunker.next()
         if tasks:
             await asyncio.gather(*tasks)
+        # make every queued version/block_ref row quorum-visible before
+        # the caller's Complete insert (read-your-writes)
+        async with span("s3.put.flush_meta"):
+            await garage.version_table.flush_insert_queue()
+            await garage.block_ref_table.flush_insert_queue()
     except BaseException:
         for t in tasks:
             t.cancel()

@@ -29,15 +29,7 @@ class InsertQueueWorker(Worker):
         batch = list(self.data.insert_queue.iter())[:BATCH_SIZE]
         if not batch:
             return WState.IDLE
-        entries = [self.data.schema.decode_entry(v) for _, v in batch]
-        await self.table.insert_many(entries)
-
-        def body(tx):
-            for k, v in batch:
-                if tx.get(self.data.insert_queue, k) == v:
-                    tx.remove(self.data.insert_queue, k)
-
-        self.data.db.transaction(body)
+        await self.table.propagate_queue_batch(batch)
         return WState.BUSY
 
     async def wait_for_work(self):

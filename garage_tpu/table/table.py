@@ -202,6 +202,43 @@ class Table:
     def queue_insert(self, tx, entry: Entry) -> None:
         self.data.queue_insert(tx, entry)
 
+    def queue_insert_local(self, entry: Entry) -> None:
+        """Durable local enqueue outside any caller transaction: one
+        tiny local tx instead of a quorum RPC (the reference's hot PUT
+        path queues version/block_ref rows this way, put.rs:545; the
+        InsertQueueWorker batch-propagates with quorum)."""
+        self.data.db.transaction(
+            lambda tx: self.data.queue_insert(tx, entry))
+
+    async def propagate_queue_batch(self, batch: list) -> None:
+        """One drain step shared by InsertQueueWorker and
+        flush_insert_queue: insert_many through the quorum path, then
+        remove each queue row only if unchanged (a concurrent enqueue
+        CRDT-merges into the pending row; the merged value stays queued
+        for the next pass)."""
+        entries = [self.schema.decode_entry(v) for _, v in batch]
+        await self.insert_many(entries)
+
+        def body(tx):
+            for k, v in batch:
+                if tx.get(self.data.insert_queue, k) == v:
+                    tx.remove(self.data.insert_queue, k)
+
+        self.data.db.transaction(body)
+
+    async def flush_insert_queue(self) -> None:
+        """Quorum-propagate everything queued AS OF NOW. Called before
+        inserting an object's final Complete row so read-your-writes
+        holds: this request's queued version/block_ref rows are
+        quorum-visible before the 200. A single snapshot — entries
+        other requests enqueue afterwards are their flush's (or the
+        worker's) problem, so sustained load cannot starve this one."""
+        from .queue import BATCH_SIZE
+
+        snapshot = list(self.data.insert_queue.iter())
+        for i in range(0, len(snapshot), BATCH_SIZE):
+            await self.propagate_queue_batch(snapshot[i:i + BATCH_SIZE])
+
     async def get_local(self, pk: bytes, sk: bytes) -> Optional[Entry]:
         raw = self.data.read_entry(pk, sk)
         return self.schema.decode_entry(raw) if raw is not None else None
