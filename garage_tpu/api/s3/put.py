@@ -261,6 +261,7 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
     offset = 0
     first_hash = None
     block = first_block
+    queued_keys: set[bytes] = set()  # rows THIS request enqueued
 
     async def put_one(blk: bytes, off: int, plain_len: int, h: bytes):
         from ...utils.tracing import span
@@ -276,9 +277,9 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
             # flushes the queues through the quorum path before the
             # caller commits the Complete row, so read-your-writes is
             # preserved
-            garage.version_table.queue_insert_local(v)
-            garage.block_ref_table.queue_insert_local(
-                BlockRef.new(h, version.uuid))
+            queued_keys.add(garage.version_table.queue_insert_local(v))
+            queued_keys.add(garage.block_ref_table.queue_insert_local(
+                BlockRef.new(h, version.uuid)))
             await garage.block_manager.rpc_put_block(
                 h, blk, compress=False if sse_key is not None else None)
 
@@ -322,11 +323,12 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
                 block = await chunker.next()
         if tasks:
             await asyncio.gather(*tasks)
-        # make every queued version/block_ref row quorum-visible before
-        # the caller's Complete insert (read-your-writes)
+        # make THIS request's queued version/block_ref rows
+        # quorum-visible before the caller's Complete insert
+        # (read-your-writes); other requests' backlog is theirs to flush
         async with span("s3.put.flush_meta"):
-            await garage.version_table.flush_insert_queue()
-            await garage.block_ref_table.flush_insert_queue()
+            await garage.version_table.flush_insert_queue(queued_keys)
+            await garage.block_ref_table.flush_insert_queue(queued_keys)
     except BaseException:
         for t in tasks:
             t.cancel()
@@ -334,6 +336,17 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
         # tombstone, or a late block_ref insert could race past it
         if tasks:
             await asyncio.gather(*tasks, return_exceptions=True)
+        # flush queued rows BEFORE the caller's aborted-object tombstone:
+        # the tombstone's trigger queue-inserts Version(deleted=True),
+        # which would CRDT-merge into a still-queued per-block row and
+        # wipe its block map before replicas ever saw it — then no
+        # BlockRef tombstones fire while the queued live BlockRefs still
+        # propagate, leaking the blocks' refcounts permanently
+        try:
+            await garage.version_table.flush_insert_queue(queued_keys)
+            await garage.block_ref_table.flush_insert_queue(queued_keys)
+        except Exception:
+            pass  # rows stay queued; repair procedures cover the rest
         raise
     md5_hex = md5.hexdigest()
     etag = ssec_etag() if sse_key is not None else md5_hex

@@ -388,6 +388,12 @@ class Conn:
         """Send a request, await (payload, reply_stream)."""
         from ..utils.tracing import current_trace_id
 
+        if self.closed.done():
+            # a dead conn stays in netapp.conns until the done-callback
+            # runs (next loop tick); a call landing in that window would
+            # enqueue into a conn whose loops are gone and wait out its
+            # full timeout instead of failing fast
+            raise RpcError("connection closed")
         req_id = self._alloc_id()
         rest, blob_key, blob = split_blob(payload)
         body = pack_body([path, prio, stream is not None, order, rest,

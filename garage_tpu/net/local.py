@@ -92,8 +92,15 @@ class LocalNetwork:
             raise RpcError("network partition")
         if expected_id is not None and dst.id != expected_id:
             raise RpcError("peer identity mismatch")
-        if dst.id in src.conns:
+        if dst.id in src.conns and src.id in dst.conns:
             return dst.id
+        # one-sided remnant (e.g. a partition or a register-tiebreak
+        # closed only one end): messages into it hang until timeout —
+        # drop both ends before wiring a fresh pair
+        for x, y in ((src, dst), (dst, src)):
+            c = x.conns.get(y.id)
+            if c is not None:
+                await c.close()
         q_ab: asyncio.Queue = asyncio.Queue()
         q_ba: asyncio.Queue = asyncio.Queue()
         chan_a = LocalChannel(q_ab, q_ba)

@@ -182,6 +182,11 @@ class NetApp:
 
     def _register(self, peer_id: bytes, chan, initiator: bool) -> None:
         old = self.conns.get(peer_id)
+        if old is not None and old.closed.done():
+            # a dead conn lingers in the map until its done-callback
+            # tick; it must never win a tiebreak against a fresh channel
+            del self.conns[peer_id]
+            old = None
         if old is not None:
             # simultaneous-connect tiebreak: keep the connection whose
             # initiator is the lexicographically smaller node id

@@ -202,13 +202,17 @@ class Table:
     def queue_insert(self, tx, entry: Entry) -> None:
         self.data.queue_insert(tx, entry)
 
-    def queue_insert_local(self, entry: Entry) -> None:
+    def queue_insert_local(self, entry: Entry) -> bytes:
         """Durable local enqueue outside any caller transaction: one
         tiny local tx instead of a quorum RPC (the reference's hot PUT
         path queues version/block_ref rows this way, put.rs:545; the
-        InsertQueueWorker batch-propagates with quorum)."""
+        InsertQueueWorker batch-propagates with quorum). Returns the
+        queue row key so the caller can target its flush."""
+        from .schema import tree_key
+
         self.data.db.transaction(
             lambda tx: self.data.queue_insert(tx, entry))
+        return tree_key(entry.partition_key(), entry.sort_key())
 
     async def propagate_queue_batch(self, batch: list) -> None:
         """One drain step shared by InsertQueueWorker and
@@ -226,16 +230,16 @@ class Table:
 
         self.data.db.transaction(body)
 
-    async def flush_insert_queue(self) -> None:
-        """Quorum-propagate everything queued AS OF NOW. Called before
-        inserting an object's final Complete row so read-your-writes
-        holds: this request's queued version/block_ref rows are
-        quorum-visible before the 200. A single snapshot — entries
-        other requests enqueue afterwards are their flush's (or the
-        worker's) problem, so sustained load cannot starve this one."""
+    async def flush_insert_queue(self, keys=None) -> None:
+        """Quorum-propagate queued rows AS OF NOW — only those whose
+        queue key is in `keys` when given (a request flushes ITS rows
+        before its final Complete insert, not the whole shared backlog).
+        A single snapshot — later enqueues are the next flush's (or the
+        worker's) problem, so sustained load cannot starve a caller."""
         from .queue import BATCH_SIZE
 
-        snapshot = list(self.data.insert_queue.iter())
+        snapshot = [(k, v) for k, v in self.data.insert_queue.iter()
+                    if keys is None or k in keys]
         for i in range(0, len(snapshot), BATCH_SIZE):
             await self.propagate_queue_batch(snapshot[i:i + BATCH_SIZE])
 

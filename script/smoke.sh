@@ -95,7 +95,12 @@ for i in 1 2 3; do
     cli layout assign "$NID" -z "dc$i" -c 1G >/dev/null
 done
 cli layout apply >/dev/null
-cli status | grep -q "layout:   v1" || die "layout not applied"
+# capture-then-grep: with pipefail, `cli | grep -q` is flaky — grep -q
+# exits at the first match and the resulting SIGPIPE (141) fails the
+# pipeline even though the match succeeded
+STATUS=$(cli status)
+echo "$STATUS" | grep -q "layout:   v1" \
+    || { echo "$STATUS"; die "layout not applied"; }
 
 say "creating key + bucket"
 KEYOUT=$(cli key new --name smoke)
@@ -140,9 +145,10 @@ cat > "$TMP/complete.xml" <<EOF
 <Part><PartNumber>2</PartNumber><ETag>"$ETAG2"</ETag></Part>
 </CompleteMultipartUpload>
 EOF
-curl -sf -X POST --data-binary "@$TMP/complete.xml" \
-    "$(presign POST /smoke/mpobj "uploadId=$UPLOAD_ID")" | grep -q ETag \
-    || die "complete-multipart failed"
+COMPLETE=$(curl -sf -X POST --data-binary "@$TMP/complete.xml" \
+    "$(presign POST /smoke/mpobj "uploadId=$UPLOAD_ID")") \
+    && echo "$COMPLETE" | grep -q ETag \
+    || die "complete-multipart failed: ${COMPLETE:-curl error}"
 cat "$TMP/part1" "$TMP/part2" > "$TMP/mp.expect"
 curl -sf "$(presign GET /smoke/mpobj)" -o "$TMP/mp.back"
 cmp "$TMP/mp.expect" "$TMP/mp.back" || die "multipart GET mismatch"
@@ -166,8 +172,10 @@ curl -sf -X PUT --data-binary "@$TMP/index.html" \
 curl -sf -X PUT -H "Authorization: Bearer smoke-admin-token" \
     -d '{"websiteAccess":{"enabled":true,"indexDocument":"index.html"}}' \
     "http://127.0.0.1:$ADM1/v1/bucket?id=$BUCKET_ID" >/dev/null
-curl -sf -H "Host: smoke.web.garage.test" "http://127.0.0.1:$WEB1/" \
-    | grep -q smoke-index || die "website index not served"
+WEBPAGE=$(curl -sf -H "Host: smoke.web.garage.test" \
+    "http://127.0.0.1:$WEB1/") \
+    && echo "$WEBPAGE" | grep -q smoke-index \
+    || die "website index not served: ${WEBPAGE:-curl error}"
 
 say "k2v: insert/read via k2v-cli"
 # wait for the restarted node 3 to rejoin (k2v reads need quorum 2/3

@@ -307,6 +307,19 @@ def test_erasure_cluster_partition_heal_degraded_reads(tmp_path):
             await asyncio.gather(*[writer(w) for w in range(3)], nemesis())
             assert blocks, "no write achieved quorum"
 
+            # a connect attempted DURING a partition backs off ~60 s
+            # (peering retry policy, tested elsewhere); reconnect
+            # directly so this test measures repair, not backoff
+            for g in garages:
+                for o in garages:
+                    if o.system.id != g.system.id \
+                            and o.system.id not in g.netapp.conns:
+                        try:
+                            await g.netapp.try_connect(
+                                o.netapp.public_addr, o.system.id)
+                        except Exception:
+                            pass
+
             # resync until FULL health: every node holds its assigned
             # shard (reads succeeding is weaker — any 4 shards satisfy
             # a read while a quorum-5 write's missing 6th shard would
@@ -315,9 +328,22 @@ def test_erasure_cluster_partition_heal_degraded_reads(tmp_path):
             for _ in range(40):
                 # block_ref rows ack at write-quorum 2 of the 6-wide
                 # placement; anti-entropy must spread them before rc
-                # marks the remaining shard holders as "needed"
+                # marks the remaining shard holders as "needed".
+                # Targeted: sync only the partitions our blocks live in
+                # (a full 256-partition round is ~8k RPCs on this box)
+                from garage_tpu.rpc.layout.version import partition_of
+
+                parts = {partition_of(h) for h in blocks}
                 for g in garages:
-                    await g.block_ref_table.syncer.sync_all_partitions()
+                    for p in parts:
+                        for other in garages:
+                            if other.system.id == g.system.id:
+                                continue
+                            try:
+                                await g.block_ref_table.syncer \
+                                    .sync_partition_with(p, other.system.id)
+                            except Exception:
+                                pass
                 for g in garages:
                     for h in blocks:
                         try:
