@@ -405,8 +405,10 @@ def main() -> None:
         finally:
             shutil.rmtree(tmp, ignore_errors=True)
 
-    # main segment: erasure(4,2), feeder auto-calibrated
-    seg = run_segment("main", "auto", True, nblocks)
+    # main segment: erasure(4,2), feeder auto-calibrated (pointless to
+    # re-probe a tunnel the startup probe already found dead)
+    seg = run_segment("main", "auto" if platform != "cpu" else "off",
+                      True, nblocks)
     extra.update({k: v for k, v in seg.items() if k != "error"})
     if "error" in seg:
         extra["put_error"] = seg["error"]

@@ -92,7 +92,9 @@ class LocalNetwork:
             raise RpcError("network partition")
         if expected_id is not None and dst.id != expected_id:
             raise RpcError("peer identity mismatch")
-        if dst.id in src.conns and src.id in dst.conns:
+        a, b = src.conns.get(dst.id), dst.conns.get(src.id)
+        if a is not None and b is not None \
+                and not a.closed.done() and not b.closed.done():
             return dst.id
         # one-sided remnant (e.g. a partition or a register-tiebreak
         # closed only one end): messages into it hang until timeout —

@@ -238,8 +238,11 @@ class Table:
         worker's) problem, so sustained load cannot starve a caller."""
         from .queue import BATCH_SIZE
 
-        snapshot = [(k, v) for k, v in self.data.insert_queue.iter()
-                    if keys is None or k in keys]
+        if keys is None:
+            snapshot = list(self.data.insert_queue.iter())
+        else:  # O(|keys|) lookups, not an O(backlog) scan per request
+            snapshot = [(k, v) for k in keys
+                        if (v := self.data.insert_queue.get(k)) is not None]
         for i in range(0, len(snapshot), BATCH_SIZE):
             await self.propagate_queue_batch(snapshot[i:i + BATCH_SIZE])
 
