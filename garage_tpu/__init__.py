@@ -28,4 +28,4 @@ Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
   cli/       operator CLI + server entrypoint          (ref: src/garage)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 
+from .. import __version__
 from ..api.http import HttpServer, Request, Response, parse_query
 from ..utils.error import BadRequest, GarageError, NoSuchBucket, NoSuchKey
 
@@ -144,7 +145,7 @@ class AdminHttpServer:
             r = await self.rpc.op_status({})
             return _json({
                 "node": r["node_id"].hex(),
-                "garageVersion": "garage-tpu-0.3",
+                "garageVersion": f"garage-tpu-{__version__}",
                 "clusterHealth": r["health"],
                 "layoutVersion": r["layout_version"],
                 "nodes": [{
