@@ -14,7 +14,7 @@ from __future__ import annotations
 import json
 
 from .. import __version__
-from ..api.http import HttpServer, Request, Response, parse_query
+from ..api.http import HttpServer, Request, Response
 from ..utils.error import BadRequest, GarageError, NoSuchBucket, NoSuchKey
 
 
@@ -97,9 +97,8 @@ class AdminHttpServer:
                 return Response(403, [], b"forbidden")
             from ..utils.tracing import tracer
 
-            q, _ = parse_query(req.raw_query)
             try:
-                limit = int(q.get("limit", "200"))
+                limit = int(req.query.get("limit", "200"))
             except ValueError:
                 return _json({"code": "InvalidRequest",
                               "message": "limit must be an integer"}, 400)

@@ -119,7 +119,8 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
                       body, content_md5: Optional[str] = None,
                       expected_checksum: Optional[tuple[str, str]] = None,
                       sse_key=None,
-                      content_length: Optional[int] = None):
+                      content_length: Optional[int] = None,
+                      quotas: Optional[dict] = None):
     """-> (version_uuid, version_timestamp, etag, total_size).
     ref: put.rs:122-330 save_stream. `expected_checksum` is a declared
     (algo, base64-value) x-amz-checksum-* header to enforce; `sse_key`
@@ -149,7 +150,8 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
         first_block, existing = await asyncio.gather(
             chunker.next(), garage.object_table.get(bucket_id, key.encode())
         )
-    quotas = await get_bucket_quotas(garage, bucket_id)
+    if quotas is None:  # callers with a loaded ReqCtx pass them in
+        quotas = await get_bucket_quotas(garage, bucket_id)
     await check_quotas(garage, bucket_id, content_length, existing,
                        quotas=quotas)
     first_block = first_block or b""
@@ -341,12 +343,20 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
         # which would CRDT-merge into a still-queued per-block row and
         # wipe its block map before replicas ever saw it — then no
         # BlockRef tombstones fire while the queued live BlockRefs still
-        # propagate, leaking the blocks' refcounts permanently
-        try:
+        # propagate, leaking the blocks' refcounts permanently. Shielded:
+        # a task cancellation mid-flush (CancelledError is NOT an
+        # Exception) must not reopen that ordering hazard — the flush
+        # finishes in the background while we proceed to the tombstone.
+        async def _flush_both():
             await garage.version_table.flush_insert_queue(queued_keys)
             await garage.block_ref_table.flush_insert_queue(queued_keys)
-        except Exception:
-            pass  # rows stay queued; repair procedures cover the rest
+
+        flush = asyncio.ensure_future(_flush_both())
+        try:
+            await asyncio.shield(flush)
+        except BaseException:
+            flush.add_done_callback(
+                lambda t: t.cancelled() or t.exception())
         raise
     md5_hex = md5.hexdigest()
     etag = ssec_etag() if sse_key is not None else md5_hex
@@ -374,6 +384,9 @@ async def handle_put(ctx, req: Request) -> Response:
         expected_checksum=expected_checksum,
         sse_key=sse_key,
         content_length=int(cl) if cl and cl.isdigit() else None,
+        quotas=(ctx.bucket.params.quotas.value or {})
+        if ctx.bucket is not None and ctx.bucket.params is not None
+        else None,
     )
     extra = []
     if sse_key is not None:
@@ -438,7 +451,10 @@ async def handle_copy(ctx, req: Request) -> Response:
                    if not k.startswith("x-garage-ssec-")}
         uuid, ts, etag, _ = await save_stream(
             helper_g, ctx.bucket_id, ctx.key, headers, source,
-            sse_key=dst_sse, content_length=src_meta.size)
+            sse_key=dst_sse, content_length=src_meta.size,
+            quotas=(ctx.bucket.params.quotas.value or {})
+            if ctx.bucket is not None and ctx.bucket.params is not None
+            else None)
         from .xml import xml, xml_response
 
         return xml_response(xml("CopyObjectResult",

@@ -125,6 +125,16 @@ def probe_device(timeout: float = PROBE_TIMEOUT, force: bool = False) -> dict:
         return res
 
 
+def _verify_matches(digs: list, items: list) -> list[bool]:
+    """Per-item content-hash verdicts; one copy of the match rule
+    (digest equality, legacy-algo fallback) for the inline fast path
+    and the batch-queue path alike."""
+    from ..utils.data import content_hash_matches
+
+    return [dg == h or content_hash_matches(d, h)
+            for dg, (h, d) in zip(digs, items)]
+
+
 class _Item:
     __slots__ = ("op", "data", "future", "extra")
 
@@ -387,7 +397,6 @@ class DeviceFeeder:
 
             if _data._content_algo == "blake3":
                 from .. import native
-                from ..utils.data import content_hash_matches
 
                 self.stats["inline_items"] += len(items)
                 t0 = time.perf_counter()
@@ -398,8 +407,7 @@ class DeviceFeeder:
                     native.blake3_many, [d for _, d in items])
                 self._record("hash", "host", sum(len(d) for _, d in items),
                              time.perf_counter() - t0)
-                return [dg == h or content_hash_matches(d, h)
-                        for dg, (h, d) in zip(digs, items)]
+                return _verify_matches(digs, items)
         futs = [self._submit("verify", (h, d)) for h, d in items]
         return list(await asyncio.gather(*futs))
 
@@ -537,11 +545,8 @@ class DeviceFeeder:
         if op == "hash":
             return self._do_hash(blobs, backend)
         if op == "verify":
-            from ..utils.data import content_hash_matches
-
             digs = self._do_hash([b for _, b in blobs], backend)
-            return [d == h or content_hash_matches(b, h)
-                    for d, (h, b) in zip(digs, blobs)]
+            return _verify_matches(digs, blobs)
         if op == "encode":
             return self._do_encode(blobs, backend)
         if op == "encode_put":

@@ -99,15 +99,21 @@ async def _offline(args) -> int:
             for name in src.list_trees():
                 st = src.open_tree(name)
                 dt = dst.open_tree(name)
+                rows, cursor = 0, None
+                while True:  # batched: never materialize a whole tree
+                    batch = list(st.iter(start=cursor, limit=10000))
+                    if not batch:
+                        break
 
-                def copy(tx, st=st, dt=dt):
-                    n = 0
-                    for k, v in st.iter():
-                        tx.insert(dt, k, v)
-                        n += 1
-                    return n
+                    def copy(tx, batch=batch, dt=dt):
+                        for k, v in batch:
+                            tx.insert(dt, k, v)
 
-                rows = dst.transaction(copy)
+                    dst.transaction(copy)
+                    rows += len(batch)
+                    if len(batch) < 10000:
+                        break
+                    cursor = batch[-1][0] + b"\x00"
                 total += rows
                 print(f"  {name}: {rows} rows")
             print(f"converted {total} rows "

@@ -246,14 +246,23 @@ class TableData:
         Baseline is computed by one scan on first call; afterwards the
         commit paths maintain an incremental delta via on_commit."""
         if self._bytes_base is None:
-            # scan inside a transaction: commits serialize against it,
-            # so no concurrent write can land between the snapshot and
-            # the base assignment (which would skew the base forever)
-            def body(tx):
+            # batched cursor walk — a single full scan would hold the
+            # db lock (and materialize the whole table) for its whole
+            # duration. Consistency: if any commit lands mid-scan (the
+            # delta moved), retry once; a second dirty pass settles for
+            # the approximation (the metric is approximate by design).
+            for _attempt in range(2):
+                d0 = self._bytes_delta
                 base = 0
-                for k, v in self.store.iter():
-                    base += len(k) + len(v)
-                self._bytes_base = base - self._bytes_delta
-
-            self.db.transaction(body)
+                cursor = None
+                while True:
+                    batch = list(self.store.iter(start=cursor, limit=4096))
+                    for k, v in batch:
+                        base += len(k) + len(v)
+                    if len(batch) < 4096:
+                        break
+                    cursor = batch[-1][0] + b"\x00"
+                if self._bytes_delta == d0:
+                    break
+            self._bytes_base = base - d0
         return self._bytes_base + self._bytes_delta
