@@ -352,11 +352,19 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
             await garage.block_ref_table.flush_insert_queue(queued_keys)
 
         flush = asyncio.ensure_future(_flush_both())
-        try:
-            await asyncio.shield(flush)
-        except BaseException:
-            flush.add_done_callback(
-                lambda t: t.cancelled() or t.exception())
+        # keep re-awaiting until the flush actually lands: returning
+        # early (even to re-raise) would let the caller's tombstone
+        # insert race the still-in-flight flush — the exact ordering
+        # hazard documented above. Repeated cancellations only re-arm
+        # the wait; the shielded task itself is never cancelled.
+        while not flush.done():
+            try:
+                await asyncio.shield(flush)
+            except asyncio.CancelledError:
+                continue
+            except Exception:
+                break  # flush failed; retrieved below, original re-raised
+        flush.cancelled() or flush.exception()  # retrieve, don't mask
         raise
     md5_hex = md5.hexdigest()
     etag = ssec_etag() if sse_key is not None else md5_hex

@@ -12,7 +12,7 @@ stripes" whose GF(2^8) Reed-Solomon math runs as batched XLA/Pallas ops
 (ops/rs.py) — encode on put, decode-any-k on get, parity-check on scrub.
 """
 
-from .block import DataBlock, COMPRESSION_ZLIB  # noqa: F401
+from .block import DataBlock, COMPRESSION_ZLIB, COMPRESSION_ZSTD  # noqa: F401
 from .codec import BlockCodec, ReplicateCodec, ErasureCodec  # noqa: F401
 from .layout import DataLayout  # noqa: F401
 from .rc import BlockRc  # noqa: F401

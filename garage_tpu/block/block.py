@@ -2,9 +2,10 @@
 
 Ref parity: src/block/block.rs:12-106. A block travels either plain or
 compressed; the content hash always refers to the PLAIN bytes, and a
-compressed block is checked by decompressing and hashing. The reference
-uses zstd level 1; this build uses zlib level 1 (no zstd in the runtime
-— the header byte records the scheme so formats can coexist).
+compressed block is checked by decompressing and hashing. Default
+scheme is zstd level 1 like the reference (util/config.rs:280); zlib
+blocks written by earlier builds still decode — the header byte records
+the scheme so formats coexist on disk and on the wire.
 """
 
 from __future__ import annotations
@@ -12,13 +13,30 @@ from __future__ import annotations
 import zlib
 from dataclasses import dataclass
 
+import zstandard
+
 from ..utils.data import content_hash_matches
 from ..utils.error import CorruptData
 
 COMPRESSION_NONE = 0
 COMPRESSION_ZLIB = 1
+COMPRESSION_ZSTD = 2
 
 COMPRESSION_LEVEL = 1  # ref: util/config.rs:280 (zstd level 1 default)
+
+# scheme -> block-file suffix; every reader probes all of these
+SUFFIX_OF = {COMPRESSION_NONE: "", COMPRESSION_ZLIB: ".zlib",
+             COMPRESSION_ZSTD: ".zst"}
+COMP_OF_SUFFIX = {v: k for k, v in SUFFIX_OF.items()}
+BLOCK_SUFFIXES = list(SUFFIX_OF.values())
+
+
+def comp_of_path(p: str) -> int:
+    """Compression scheme from a block-file path's suffix."""
+    for sfx, comp in COMP_OF_SUFFIX.items():
+        if sfx and p.endswith(sfx):
+            return comp
+    return COMPRESSION_NONE
 
 
 @dataclass
@@ -38,21 +56,28 @@ class DataBlock:
 
     @classmethod
     def compress(cls, data: bytes, level: int = COMPRESSION_LEVEL) -> "DataBlock":
-        """Compress if it helps; otherwise keep plain
-        (ref: block.rs:85-99 from_buffer). Incompressible payloads are
-        detected from a leading sample before paying for the full pass."""
+        """Compress (zstd, ref default scheme) if it helps; otherwise
+        keep plain (ref: block.rs:85-99 from_buffer). Incompressible
+        payloads are detected from a leading sample before paying for
+        the full pass."""
+        cctx = zstandard.ZstdCompressor(level=level)
         if len(data) > 2 * cls._SAMPLE:
-            probe = zlib.compress(data[: cls._SAMPLE], level)
+            probe = cctx.compress(data[: cls._SAMPLE])
             if len(probe) > cls._SAMPLE * cls._SAMPLE_RATIO:
                 return cls(COMPRESSION_NONE, data)
-        c = zlib.compress(data, level)
+        c = cctx.compress(data)
         if len(c) < len(data):
-            return cls(COMPRESSION_ZLIB, c)
+            return cls(COMPRESSION_ZSTD, c)
         return cls(COMPRESSION_NONE, data)
 
     def plain_bytes(self) -> bytes:
         if self.compression == COMPRESSION_NONE:
             return self.bytes
+        if self.compression == COMPRESSION_ZSTD:
+            # a fresh decompressor per call: ZstdDecompressor instances
+            # are not safe for concurrent use, and GET (to_thread) can
+            # race a ScrubWorker read on another worker thread
+            return zstandard.ZstdDecompressor().decompress(self.bytes)
         return zlib.decompress(self.bytes)
 
     def verify(self, hash32: bytes) -> None:
@@ -62,7 +87,7 @@ class DataBlock:
         blake2 accepted for stores migrated from the legacy algo."""
         try:
             plain = self.plain_bytes()
-        except zlib.error as e:
+        except (zlib.error, zstandard.ZstdError) as e:
             raise CorruptData(hash32) from e
         if not content_hash_matches(plain, hash32):
             raise CorruptData(hash32)
@@ -76,4 +101,4 @@ class DataBlock:
         return cls(raw[0], raw[1:])
 
     def file_suffix(self) -> str:
-        return ".zlib" if self.compression == COMPRESSION_ZLIB else ""
+        return SUFFIX_OF[self.compression]

@@ -8,7 +8,7 @@ erasure(k, m) RS-encodes the packed block into k+m shards (TPU math)
 placed on k+m distinct ring nodes, and reads gather any k.
 
 Local files (under the DataLayout path scheme):
-  whole blocks:  {hex}[.zlib]      content = DataBlock payload
+  whole blocks:  {hex}[.zst|.zlib] content = DataBlock payload
   shards:        {hex}.s{i}        content = shard file (len+checksum hdr)
 
 RPC ops on endpoint "garage_tpu/block":
@@ -30,7 +30,7 @@ from ..net.message import PRIO_BACKGROUND, PRIO_NORMAL
 from ..rpc.rpc_helper import RequestStrategy, RpcHelper
 from ..utils.data import blake2sum
 from ..utils.error import CorruptData, MissingBlock, QuorumError, RpcError
-from .block import DataBlock
+from .block import BLOCK_SUFFIXES, DataBlock, comp_of_path
 from .codec import BlockCodec, ErasureCodec, ReplicateCodec, shard_nodes_of
 from .layout import DataLayout
 from .rc import BlockRc
@@ -465,25 +465,25 @@ class BlockManager:
         blk = DataBlock.unpack(packed)
         path = self.data_layout.block_path(hash32, blk.file_suffix())
         self._write_file(path, blk.bytes)
-        # drop the other-compression variant if present (ref: manager.rs
+        # drop other-compression variants if present (ref: manager.rs
         # write_block replaces regardless of compression state)
-        other = self.data_layout.block_path(
-            hash32, "" if blk.file_suffix() else ".zlib"
-        )
-        if os.path.exists(other):
-            os.remove(other)
+        for sfx in BLOCK_SUFFIXES:
+            if sfx == blk.file_suffix():
+                continue
+            other = self.data_layout.block_path(hash32, sfx)
+            if os.path.exists(other):
+                os.remove(other)
 
     def read_local(self, hash32: bytes) -> Optional[bytes]:
         """-> packed DataBlock bytes, verifying content hash
         (ref: manager.rs:554-609)."""
-        p = self._find(hash32, ["", ".zlib"])
+        p = self._find(hash32, BLOCK_SUFFIXES)
         if p is None:
             return None
         with open(p, "rb") as f:
             raw = f.read()
         self.metrics["bytes_read"] += len(raw)
-        comp = 1 if p.endswith(".zlib") else 0
-        blk = DataBlock(comp, raw)
+        blk = DataBlock(comp_of_path(p), raw)
         try:
             blk.verify(hash32)
         except CorruptData:
@@ -528,7 +528,7 @@ class BlockManager:
     def has_local(self, hash32: bytes) -> bool:
         if self.erasure:
             return bool(self.local_parts(hash32))
-        return self._find(hash32, ["", ".zlib"]) is not None
+        return self._find(hash32, BLOCK_SUFFIXES) is not None
 
     def is_shard_needed(self, hash32: bytes) -> bool:
         """Answer to the 'need' RPC: does this node still want data for

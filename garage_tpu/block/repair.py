@@ -21,6 +21,7 @@ import random
 import time
 
 from ..utils import migrate
+from .block import BLOCK_SUFFIXES, comp_of_path
 from ..utils.background import Throttled, Worker, WorkerInfo, WState
 from ..utils.persister import Persister
 
@@ -161,7 +162,7 @@ class ScrubWorker(Worker):
         def read_all():
             out = []
             for h in batch:
-                p = m._find(h, ["", ".zlib"])
+                p = m._find(h, BLOCK_SUFFIXES)
                 if p is None:
                     out.append((h, None, None))
                     continue
@@ -170,7 +171,7 @@ class ScrubWorker(Worker):
                         raw = f.read()
                     from .block import DataBlock
 
-                    blk = DataBlock(1 if p.endswith(".zlib") else 0, raw)
+                    blk = DataBlock(comp_of_path(p), raw)
                     out.append((h, p, blk.plain_bytes()))
                 except Exception:
                     out.append((h, p, None))  # unreadable = corrupt

@@ -88,6 +88,23 @@ async def _offline(args) -> int:
         if os.path.abspath(args.src) == os.path.abspath(args.dst):
             print("--src and --dst are the same path", file=sys.stderr)
             return 1
+        # a live server on this metadata dir would mutate trees while we
+        # snapshot them — take the same lock the server holds. The db
+        # usually lives at {metadata_dir}/db, so the server's lock sits
+        # in the PARENT of src; guard both.
+        from ..utils import lockfile
+
+        src_dir = os.path.abspath(args.src) if os.path.isdir(args.src) \
+            else os.path.dirname(os.path.abspath(args.src))
+        lock_fds = []
+        try:
+            for d in dict.fromkeys([src_dir, os.path.dirname(src_dir)]):
+                lock_fds.append(lockfile.acquire(d, "convert-db"))
+        except lockfile.AlreadyLocked as e:
+            for fd in lock_fds:
+                lockfile.release(fd)
+            print(str(e), file=sys.stderr)
+            return 1
         src = open_db(args.src, engine=args.src_engine)
         dst = open_db(args.dst, engine=args.dst_engine)
         try:
@@ -121,23 +138,37 @@ async def _offline(args) -> int:
         finally:
             src.close()
             dst.close()
+            for fd in lock_fds:
+                lockfile.release(fd)
         return 0
     if args.cmd == "repair-offline":
         cfg = read_config(args.config)
         from ..model.garage import Garage
+        from ..utils import lockfile
 
-        garage = Garage(cfg)
-        if args.what == "object-counters":
-            n = garage.object_counter.recount(garage.object_table.data)
-            n += garage.mpu_counter.recount(garage.mpu_table.data)
-            print(f"recomputed {n} object/mpu counter rows")
-        elif args.what == "k2v-counters":
-            n = garage.k2v_counter.recount(garage.k2v_item_table.data)
-            print(f"recomputed {n} k2v counter rows")
-        else:
-            print(f"unknown offline repair {args.what!r}", file=sys.stderr)
+        # a live server holds this lock: a recount racing a live
+        # count() would win the CRDT merge with stale totals
+        try:
+            lock_fd = lockfile.acquire(cfg.metadata_dir, "repair-offline")
+        except lockfile.AlreadyLocked as e:
+            print(str(e), file=sys.stderr)
             return 1
-        garage.db.close()
+        try:
+            garage = Garage(cfg)
+            if args.what == "object-counters":
+                n = garage.object_counter.recount(garage.object_table.data)
+                n += garage.mpu_counter.recount(garage.mpu_table.data)
+                print(f"recomputed {n} object/mpu counter rows")
+            elif args.what == "k2v-counters":
+                n = garage.k2v_counter.recount(garage.k2v_item_table.data)
+                print(f"recomputed {n} k2v counter rows")
+            else:
+                print(f"unknown offline repair {args.what!r}",
+                      file=sys.stderr)
+                return 1
+            garage.db.close()
+        finally:
+            lockfile.release(lock_fd)
         return 0
     return 1
 

@@ -27,6 +27,11 @@ async def run_server(cfg_path: str) -> None:
 
     tune()
     cfg = read_config(cfg_path)
+    from ..utils import lockfile
+
+    # held for the server's lifetime; repair-offline/convert-db take the
+    # same lock, so offline maintenance can't race a live node
+    lock_fd = lockfile.acquire(cfg.metadata_dir, "server")
     garage = Garage(cfg)
     admin = AdminRpcHandler(garage)
     stop = asyncio.Event()
@@ -77,6 +82,7 @@ async def run_server(cfg_path: str) -> None:
         await s.stop()
     await garage.stop()
     system_task.cancel()
+    lockfile.release(lock_fd)
 
 
 def main() -> None:
@@ -90,7 +96,15 @@ def main() -> None:
         level=getattr(logging, args.log_level.upper(), logging.INFO),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
-    asyncio.run(run_server(args.config))
+    from ..utils.lockfile import AlreadyLocked
+
+    try:
+        asyncio.run(run_server(args.config))
+    except AlreadyLocked as e:
+        import sys
+
+        print(str(e), file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

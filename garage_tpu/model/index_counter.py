@@ -152,11 +152,12 @@ class IndexCounter:
         full scan of the counted table, replacing whatever incremental
         state drifted (ref: src/garage/repair/offline.rs:11 +
         index_counter.rs recalculation). Returns the number of counter
-        rows rewritten. MUST run with the server stopped (stated in the
-        CLI help; there is no lock-file guard — a concurrent live
-        count() landing between the scan and the rewrite would be
+        rows rewritten. MUST run with the server stopped — a concurrent
+        live count() landing between the scan and the rewrite would be
         overwritten by stale totals whose fresher timestamp then wins
-        the CRDT merge cluster-wide). The rewritten counter-table
+        the CRDT merge cluster-wide. The repair-offline CLI enforces
+        this with the meta-dir flock (utils/lockfile.py) that a running
+        server holds for its lifetime. The rewritten counter-table
         entries gossip out through normal anti-entropy at next boot."""
         agg: dict[tuple[bytes, bytes], dict[str, int]] = {}
         key_of: dict[bytes, tuple[bytes, bytes]] = {}

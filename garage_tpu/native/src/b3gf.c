@@ -18,6 +18,7 @@
 
 #include <stdint.h>
 #include <stddef.h>
+#include <stdlib.h>
 #include <string.h>
 
 #if defined(__x86_64__) || defined(__i386__)
@@ -289,9 +290,18 @@ static void subtree_cv(const uint8_t *data, uint64_t len, uint64_t counter0,
 __attribute__((target("avx2")))
 static void subtree_cv_avx2(const uint8_t *data, uint64_t nchunks,
                             uint64_t counter0, uint32_t cv[8]) {
-    /* hash all chunks 8 at a time */
-    uint32_t (*cvs)[8] = __builtin_alloca(
-        sizeof(uint32_t[8]) * (size_t)nchunks);
+    /* hash all chunks 8 at a time. CV scratch is up to 128 KiB — heap,
+     * not alloca: worker threads on some libcs get ~128 KiB stacks. */
+    uint32_t (*cvs)[8] = malloc(sizeof(uint32_t[8]) * (size_t)nchunks);
+    if (!cvs) { /* fallback: caller's scalar path via recursion */
+        uint64_t half = nchunks / 2;
+        uint32_t l[8], r[8];
+        subtree_cv(data, half * CHUNK_LEN, counter0, 0, l);
+        subtree_cv(data + half * CHUNK_LEN, half * CHUNK_LEN,
+                   counter0 + half, 0, r);
+        parent_cv(l, r, 0, cv);
+        return;
+    }
     for (uint64_t c = 0; c < nchunks; c += 8) {
         const uint8_t *p[8];
         for (int l = 0; l < 8; l++)
@@ -310,6 +320,7 @@ static void subtree_cv_avx2(const uint8_t *data, uint64_t nchunks,
         n = half;
     }
     memcpy(cv, cvs[0], 32);
+    free(cvs);
 }
 #endif
 
@@ -324,8 +335,8 @@ static void subtree_cv(const uint8_t *data, uint64_t len, uint64_t counter0,
     if (cpu_avx2 < 0)
         cpu_avx2 = __builtin_cpu_supports("avx2") ? 1 : 0;
     /* power-of-two run of full chunks, non-root: whole subtree 8-way.
-     * alloca bound: cap at 2^12 chunks (4 MiB data, 128 KiB of CVs —
-     * safe on worker-thread stacks); bigger subtrees recurse first. */
+     * cap at 2^12 chunks (4 MiB data, 128 KiB heap CV scratch);
+     * bigger subtrees recurse first. */
     if (cpu_avx2 && !root && nchunks >= 8 && nchunks <= (1u << 12) &&
         (nchunks & (nchunks - 1)) == 0 &&
         len == nchunks * (uint64_t)CHUNK_LEN &&

@@ -23,7 +23,7 @@ Frame flags (in the u32 len field):
   field == 0xFFFFFFFF  — CANCEL marker for this request id
   field == 0xFFFFFFFE  — CREDIT grant; payload = u32 additional window
 
-Body section layout (v3): [u16 hlen][msgpack header][raw blob bytes].
+Body section layout (v3): [u32 hlen][msgpack header][raw blob bytes].
 The header's last element is a blob key: when a request/reply payload
 is a dict with one large bytes value (a block/shard), that value rides
 OUTSIDE msgpack as the raw tail of the body and is re-attached on

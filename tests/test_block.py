@@ -75,7 +75,7 @@ def test_datablock_roundtrip():
     data = b"hello world " * 100
     h = blake2sum(data)
     blk = DataBlock.compress(data)
-    assert blk.compression == 1  # compressible
+    assert blk.compression == 2  # compressible -> zstd (ref default)
     blk.verify(h)
     assert blk.plain_bytes() == data
     rt = DataBlock.unpack(blk.pack())
@@ -83,6 +83,20 @@ def test_datablock_roundtrip():
     rnd = os.urandom(4096)
     blk2 = DataBlock.compress(rnd)
     assert blk2.compression == 0  # incompressible stays plain
+
+
+def test_datablock_legacy_zlib_decodes():
+    """Blocks written by pre-zstd builds (scheme byte 1) still decode."""
+    import zlib
+
+    data = b"legacy block payload " * 64
+    h = blake2sum(data)
+    legacy = DataBlock(1, zlib.compress(data, 1))
+    legacy.verify(h)
+    assert legacy.plain_bytes() == data
+    assert legacy.file_suffix() == ".zlib"
+    rt = DataBlock.unpack(legacy.pack())
+    assert rt.plain_bytes() == data
 
 
 def test_shard_file_roundtrip():
@@ -155,8 +169,23 @@ def test_local_store_and_corruption(tmp_path):
     out = DataBlock.unpack(m.read_local(h))
     assert out.plain_bytes() == data
 
+    # a pre-zstd .zlib file on disk still reads; a fresh write_local
+    # replaces it with the zstd variant
+    import zlib as _zlib
+    from garage_tpu.block.block import BLOCK_SUFFIXES
+
+    old = b"older zlib-era block" * 40
+    h_old = blake2sum(old)
+    os.makedirs(os.path.dirname(lay.block_path(h_old, ".zlib")), exist_ok=True)
+    with open(lay.block_path(h_old, ".zlib"), "wb") as f:
+        f.write(_zlib.compress(old, 1))
+    assert DataBlock.unpack(m.read_local(h_old)).plain_bytes() == old
+    m.write_local(h_old, DataBlock.compress(old).pack())
+    assert m._find(h_old, [".zlib"]) is None  # old variant dropped
+    assert m._find(h_old, [".zst"]) is not None
+
     # corrupt the file on disk: read detects, quarantines, queues resync
-    path = m._find(h, ["", ".zlib"])
+    path = m._find(h, BLOCK_SUFFIXES)
     with open(path, "r+b") as f:
         f.seek(5)
         f.write(b"\xff\xff\xff\xff")

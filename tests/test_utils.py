@@ -136,3 +136,31 @@ def test_background_worker_error_backoff():
         await runner.shutdown()
 
     asyncio.run(main())
+
+
+def test_lockfile_exclusive(tmp_path):
+    """Server-vs-offline-maintenance exclusion: second acquire fails
+    while held (in a child process: flock is per-open-file, so a
+    same-process re-acquire through a fresh fd would succeed), then
+    succeeds after release."""
+    import subprocess
+    import sys
+
+    from garage_tpu.utils import lockfile
+
+    d = str(tmp_path / "meta")
+    fd = lockfile.acquire(d, "server")
+    child = (
+        "import sys; from garage_tpu.utils import lockfile\n"
+        f"d = {d!r}\n"
+        "try:\n"
+        "    lockfile.acquire(d, 'repair-offline')\n"
+        "except lockfile.AlreadyLocked as e:\n"
+        "    assert 'server' in str(e); sys.exit(42)\n"
+        "sys.exit(0)\n"
+    )
+    r = subprocess.run([sys.executable, "-c", child])
+    assert r.returncode == 42  # refused while the 'server' holds it
+    lockfile.release(fd)
+    r2 = subprocess.run([sys.executable, "-c", child])
+    assert r2.returncode == 0  # free after release
