@@ -21,6 +21,64 @@ def http_date(ts_msec: int) -> str:
     ).strftime("%a, %d %b %Y %H:%M:%S GMT")
 
 
+def _parse_http_date(s: str, which: str) -> float:
+    try:
+        return datetime.datetime.strptime(
+            s, "%a, %d %b %Y %H:%M:%S GMT"
+        ).replace(tzinfo=datetime.timezone.utc).timestamp()
+    except ValueError:
+        # ref: get.rs PreconditionHeaders::parse ok_or_bad_request
+        raise S3Error("InvalidArgument", 400, f"invalid date in {which}")
+
+
+def _etag_matches(header_val: str, etag: str) -> bool:
+    """Client tokens may come quoted or bare (the reference strips
+    quotes: get.rs trim_matches('\"'))."""
+    cands = [e.strip() for e in header_val.split(",")]
+    return "*" in cands or any(c.strip('"') == etag for c in cands)
+
+
+def check_preconditions(get_header, version, etag: str) -> Optional[str]:
+    """RFC 7232 §6 evaluation order, shared by GET/HEAD and the copy
+    family (ref: get.rs:819-855 PreconditionHeaders::check). Returns
+    None (pass), "fail" (412 always) or "not_modified" (304 on GET,
+    412 on copy). `get_header` maps a bare condition name ("if-match")
+    to the header value, letting copy prefix x-amz-copy-source-."""
+    im = get_header("if-match")
+    if im is not None:
+        if not _etag_matches(im, etag):
+            return "fail"
+    else:
+        ius = get_header("if-unmodified-since")
+        if ius is not None:
+            t = _parse_http_date(ius, "if-unmodified-since")
+            # floor to whole seconds: Last-Modified has 1 s resolution
+            if version.timestamp // 1000 > t:
+                return "fail"
+    inm = get_header("if-none-match")
+    if inm is not None:
+        if _etag_matches(inm, etag):
+            return "not_modified"
+    else:
+        ims = get_header("if-modified-since")
+        if ims is not None:
+            t = _parse_http_date(ims, "if-modified-since")
+            if version.timestamp // 1000 <= t:
+                return "not_modified"
+    return None
+
+
+def check_copy_source_preconditions(req: Request, version, etag: str) -> None:
+    """`x-amz-copy-source-if-*` for CopyObject / UploadPartCopy. On a
+    copy, EVERY failed condition — including the ones a GET would
+    answer 304 to — is a 412 (ref: get.rs check_copy_source)."""
+    pfx = "x-amz-copy-source-"
+    if check_preconditions(
+            lambda name: req.header(pfx + name), version, etag) is not None:
+        raise S3Error("PreconditionFailed", 412,
+                      "copy source precondition failed")
+
+
 def _object_headers(version, meta) -> list[tuple[str, str]]:
     """ref: get.rs object_headers."""
     out = [("etag", f'"{meta.etag}"'),
@@ -72,38 +130,11 @@ async def handle_get(ctx, req: Request, head: bool = False) -> Response:
     sse_key = check_key_for_meta(meta, request_sse_key(req))
 
     # conditionals (ref: get.rs try_answer_cached)
-    im = req.header("if-match")
-    if im is not None:
-        etags = [e.strip() for e in im.split(",")]
-        if "*" not in etags and f'"{meta.etag}"' not in etags:
-            raise S3Error("PreconditionFailed", 412, "If-Match failed")
-    ius = req.header("if-unmodified-since")
-    if ius is not None and im is None:
-        try:
-            t = datetime.datetime.strptime(
-                ius, "%a, %d %b %Y %H:%M:%S GMT"
-            ).replace(tzinfo=datetime.timezone.utc)
-            # floor to whole seconds: Last-Modified has 1 s resolution
-            if v.timestamp // 1000 > t.timestamp():
-                raise S3Error("PreconditionFailed", 412,
-                              "If-Unmodified-Since failed")
-        except ValueError:
-            pass
-    inm = req.header("if-none-match")
-    if inm is not None:
-        etags = [e.strip() for e in inm.split(",")]
-        if "*" in etags or f'"{meta.etag}"' in etags:
-            return Response(304, _object_headers(v, meta))
-    ims = req.header("if-modified-since")
-    if ims is not None and inm is None:
-        try:
-            t = datetime.datetime.strptime(
-                ims, "%a, %d %b %Y %H:%M:%S GMT"
-            ).replace(tzinfo=datetime.timezone.utc)
-            if v.timestamp / 1000 <= t.timestamp():
-                return Response(304, _object_headers(v, meta))
-        except ValueError:
-            pass
+    cond = check_preconditions(req.header, v, meta.etag)
+    if cond == "fail":
+        raise S3Error("PreconditionFailed", 412, "precondition failed")
+    if cond == "not_modified":
+        return Response(304, _object_headers(v, meta))
 
     headers = _object_headers(v, meta)
     if (req.header("x-amz-checksum-mode") or "").upper() == "ENABLED":

@@ -28,6 +28,22 @@ def _dec_token(s: str) -> str:
         raise S3Error("InvalidArgument", 400, "bad continuation token")
 
 
+def _page_size(q, name: str, lo: int = 1) -> int:
+    """Validated page-size query param, clamped to <=1000. Values < lo
+    are a 400: a 0-size page with IsTruncated=true and a non-advancing
+    marker would loop paginating clients forever."""
+    raw = q.get(name)
+    if raw in (None, ""):
+        return 1000
+    try:
+        v = int(raw)
+    except ValueError:
+        raise S3Error("InvalidArgument", 400, f"bad {name}")
+    if v < lo:
+        raise S3Error("InvalidArgument", 400, f"{name} must be >= {lo}")
+    return min(v, 1000)
+
+
 async def handle_list_buckets(helper, api_key) -> Response:
     """ref: api/s3/bucket.rs handle_list_buckets — buckets this key may
     read, with their global aliases."""
@@ -132,7 +148,7 @@ async def handle_list_objects_v2(ctx, req: Request) -> Response:
     q = req.query
     prefix = q.get("prefix", "")
     delimiter = q.get("delimiter", "")
-    max_keys = min(int(q.get("max-keys", "1000") or 1000), 1000)
+    max_keys = _page_size(q, "max-keys", lo=0)
     token = q.get("continuation-token")
     start_after = q.get("start-after", "")
     if token:
@@ -142,8 +158,11 @@ async def handle_list_objects_v2(ctx, req: Request) -> Response:
         resume = ("k", start_after)
     else:
         resume = None
-    contents, prefixes, next_token, truncated = await _collect_objects(
-        ctx, prefix, resume, delimiter, max_keys)
+    if max_keys == 0:  # AWS: empty page, never truncated
+        contents, prefixes, next_token, truncated = [], [], None, False
+    else:
+        contents, prefixes, next_token, truncated = await _collect_objects(
+            ctx, prefix, resume, delimiter, max_keys)
 
     nodes = [xml("Name", ctx.bucket_name), xml("Prefix", prefix),
              xml("KeyCount", str(len(contents) + len(prefixes))),
@@ -170,7 +189,7 @@ async def handle_list_objects_v1(ctx, req: Request) -> Response:
     q = req.query
     prefix = q.get("prefix", "")
     delimiter = q.get("delimiter", "")
-    max_keys = min(int(q.get("max-keys", "1000") or 1000), 1000)
+    max_keys = _page_size(q, "max-keys", lo=0)
     marker = q.get("marker", "")
     if marker and delimiter and marker.endswith(delimiter):
         resume = ("p", marker)  # marker was a folded common prefix
@@ -178,8 +197,11 @@ async def handle_list_objects_v1(ctx, req: Request) -> Response:
         resume = ("k", marker)
     else:
         resume = None
-    contents, prefixes, next_token, truncated = await _collect_objects(
-        ctx, prefix, resume, delimiter, max_keys)
+    if max_keys == 0:  # AWS: empty page, never truncated
+        contents, prefixes, next_token, truncated = [], [], None, False
+    else:
+        contents, prefixes, next_token, truncated = await _collect_objects(
+            ctx, prefix, resume, delimiter, max_keys)
     nodes = [xml("Name", ctx.bucket_name), xml("Prefix", prefix),
              xml("Marker", marker), xml("MaxKeys", str(max_keys)),
              xml("IsTruncated", "true" if truncated else "false")]
@@ -199,54 +221,166 @@ async def handle_list_objects_v1(ctx, req: Request) -> Response:
     return xml_response(xml("ListBucketResult", *nodes))
 
 
+async def _collect_uploads(ctx, prefix: str, resume, delimiter: str,
+                           max_uploads: int):
+    """Upload lister with full marker pagination (ref: list.rs:628-650
+    ListMultipartUploadsQuery::begin + UploadAccumulator).
+
+    `resume` is None or a cursor:
+      ("k", key)        — start strictly after `key`
+      ("i", key)        — start AT `key`, all of its uploads
+      ("u", key, uuid)  — start AT `key`, uploads with id > `uuid`
+    An object may hold several concurrent uploads (one uploading
+    version each); same-key uploads are returned in lexicographic
+    upload-id order, so ("u", ...) resumes mid-key losslessly.
+    Returns (uploads, common_prefixes, next_cursor, truncated) where
+    uploads is [(key, version)] and next_cursor follows the same
+    cursor grammar (its key becomes NextKeyMarker; a ("u",...) or
+    ("i",...) cursor additionally yields NextUploadIdMarker)."""
+    garage = ctx.garage
+    ups = []
+    prefixes: set[str] = set()
+    last_cursor = resume  # scan position after the last consumed item
+
+    after_uuid = None
+    marker_key = None
+    if resume is None:
+        sk = prefix.encode() if prefix else None
+    elif resume[0] == "k":
+        sk = resume[1].encode() + b"\x00"
+    else:  # "i" / "u": re-read the marker key itself
+        sk = resume[1].encode()
+        if resume[0] == "u":
+            marker_key = resume[1]
+            try:
+                after_uuid = bytes.fromhex(resume[2])
+            except ValueError:
+                raise S3Error("InvalidArgument", 400, "bad upload-id-marker")
+
+    def full() -> bool:
+        return len(ups) + len(prefixes) >= max_uploads
+
+    while True:
+        entries = await garage.object_table.get_range(
+            ctx.bucket_id, start_sk=sk,
+            flt={"type": "uploading", "multipart": True}, limit=PAGE,
+            prefix_sk=prefix.encode() if prefix else None,
+        )
+        if not entries:
+            return ups, sorted(prefixes), None, False
+        for o in entries:
+            key = o.key
+            sk = key.encode() + b"\x00"
+            if not key.startswith(prefix):
+                if key > prefix:  # past the prefix window: done
+                    return ups, sorted(prefixes), None, False
+                continue
+            if delimiter:
+                rest = key[len(prefix):]
+                if delimiter in rest:
+                    cp = prefix + rest.split(delimiter)[0] + delimiter
+                    if cp not in prefixes:
+                        if full():
+                            return ups, sorted(prefixes), last_cursor, True
+                        prefixes.add(cp)
+                    # each key under the folded prefix is consumed
+                    # individually; the cursor trails along so a fill
+                    # right after resumes past everything consumed
+                    last_cursor = ("k", key)
+                    continue
+            vs = sorted((v for v in o.versions if v.is_uploading(True)),
+                        key=lambda v: v.uuid)
+            if after_uuid is not None and key == marker_key:
+                vs = [v for v in vs if v.uuid > after_uuid]
+            placed_any = False
+            for v in vs:
+                if full():
+                    return ups, sorted(prefixes), last_cursor, True
+                ups.append((key, v))
+                last_cursor = ("u", key, v.uuid.hex())
+                placed_any = True
+            if not placed_any:
+                last_cursor = ("k", key)
+        if len(entries) < PAGE:
+            return ups, sorted(prefixes), None, False
+
+
 async def handle_list_multipart_uploads(ctx, req: Request) -> Response:
-    """ref: list.rs handle_list_multipart_upload (simplified paging)."""
+    """ref: list.rs:169-265 handle_list_multipart_upload. Markers:
+    key-marker alone starts after that key; with upload-id-marker it
+    starts at that key after that upload id; the reference's "include"
+    sentinel (an impossible hex id) means "at the key, first upload"
+    and is emitted when a page fills right at a key boundary."""
     q = req.query
     prefix = q.get("prefix", "")
-    max_uploads = min(int(q.get("max-uploads", "1000") or 1000), 1000)
-    entries = await ctx.garage.object_table.get_range(
-        ctx.bucket_id, flt={"type": "uploading", "multipart": True},
-        limit=PAGE,
-    )
-    ups = []
-    for o in entries:
-        if not o.key.startswith(prefix):
-            continue
-        for v in o.versions:
-            if v.is_uploading(True):
-                ups.append((o.key, v))
-    ups = ups[:max_uploads]
+    delimiter = q.get("delimiter", "")
+    max_uploads = _page_size(q, "max-uploads")
+    key_marker = q.get("key-marker")
+    upload_id_marker = q.get("upload-id-marker")
+    if key_marker is not None and upload_id_marker:
+        if upload_id_marker == "include":
+            resume = ("i", key_marker)
+        else:
+            resume = ("u", key_marker, upload_id_marker)
+    elif key_marker is not None:
+        resume = ("k", key_marker)
+    else:
+        resume = None
+    ups, prefixes, next_cursor, truncated = await _collect_uploads(
+        ctx, prefix, resume, delimiter, max_uploads)
+
     nodes = [xml("Bucket", ctx.bucket_name), xml("Prefix", prefix),
              xml("MaxUploads", str(max_uploads)),
-             xml("IsTruncated", "false")]
+             xml("IsTruncated", "true" if truncated else "false")]
+    if delimiter:
+        nodes.append(xml("Delimiter", delimiter))
+    if key_marker is not None:
+        nodes.append(xml("KeyMarker", key_marker))
+    if upload_id_marker:
+        nodes.append(xml("UploadIdMarker", upload_id_marker))
+    if truncated and next_cursor is not None:
+        nodes.append(xml("NextKeyMarker", next_cursor[1]))
+        if next_cursor[0] == "u":
+            nodes.append(xml("NextUploadIdMarker", next_cursor[2]))
+        elif next_cursor[0] == "i":
+            nodes.append(xml("NextUploadIdMarker", "include"))
     for key, v in ups:
         nodes.append(xml("Upload",
                          xml("Key", key),
                          xml("UploadId", v.uuid.hex()),
                          xml("Initiated", _iso(v.timestamp))))
+    for cp in prefixes:
+        nodes.append(xml("CommonPrefixes", xml("Prefix", cp)))
     return xml_response(xml("ListMultipartUploadsResult", *nodes))
 
 
 async def handle_list_parts(ctx, req: Request) -> Response:
-    """ref: list.rs handle_list_parts."""
+    """ref: list.rs:274-311 handle_list_parts + fetch_part_info
+    (list.rs:512-558): newest record per part number, cut below the
+    marker, NextPartNumberMarker when the page fills."""
     upload_id = req.query.get("uploadId", "")
     from .multipart import _get_upload
 
     # 404s aborted/completed uploads too, not just unknown ids
     mpu, _ov = await _get_upload(ctx, upload_id)
     marker = int(req.query.get("part-number-marker", "0") or 0)
-    max_parts = min(int(req.query.get("max-parts", "1000") or 1000), 1000)
+    max_parts = _page_size(req.query, "max-parts")
     # newest record per part number with a finished etag
     best = {}
     for (pn, ts), part in mpu.parts.items():
         if part.etag is not None and pn > marker:
             if pn not in best or ts > best[pn][0]:
                 best[pn] = (ts, part)
-    parts = sorted(best.items())[:max_parts]
+    all_parts = sorted(best.items())
+    truncated = len(all_parts) > max_parts
+    parts = all_parts[:max_parts]
     nodes = [xml("Bucket", ctx.bucket_name), xml("Key", ctx.key),
              xml("UploadId", upload_id),
+             xml("PartNumberMarker", str(marker)),
              xml("MaxParts", str(max_parts)),
-             xml("IsTruncated", "false")]
+             xml("IsTruncated", "true" if truncated else "false")]
+    if truncated:
+        nodes.append(xml("NextPartNumberMarker", str(parts[-1][0])))
     for pn, (_ts, part) in parts:
         nodes.append(xml("Part",
                          xml("PartNumber", str(pn)),

@@ -206,6 +206,9 @@ async def handle_upload_part_copy(ctx, req: Request) -> Response:
     if src_v is None:
         raise S3Error("NoSuchKey", 404, src_key)
     src_meta = src_v.state.data.meta
+    from .get import check_copy_source_preconditions
+
+    check_copy_source_preconditions(req, src_v, src_meta.etag)
     src_sse = check_key_for_meta(src_meta, copy_source_sse_key(req))
 
     size = src_meta.size

@@ -430,6 +430,9 @@ async def handle_copy(ctx, req: Request) -> Response:
     src_v = src_obj.last_data() if src_obj is not None else None
     if src_v is None:
         raise S3Error("NoSuchKey", 404, src_key)
+    from .get import check_copy_source_preconditions
+
+    check_copy_source_preconditions(req, src_v, src_v.state.data.meta.etag)
 
     from .encryption import (check_key_for_meta, copy_source_sse_key,
                              meta_is_encrypted, request_sse_key)

@@ -377,3 +377,114 @@ def test_erasure_cluster_partition_heal_degraded_reads(tmp_path):
             await stop_all(garages, tasks)
 
     run(main())
+
+
+def test_layout_transition_write_storm(tmp_path):
+    """Layout-transition chaos (the subtlest machinery in the system —
+    multi-write-sets + ack lock + tracker GC, semantics of
+    ref src/rpc/layout/manager.rs:338-381): four writers storm the
+    object table while node 3 is ADDED to and node 1 REMOVED from the
+    layout, applied mid-storm. Invariants after quiescence:
+      * no acked write lost — every key's winner on every v2 storage
+        node is the maximal acked (timestamp, uuid) for that key;
+      * the three v2 storage nodes' stores are byte-identical;
+      * the superseded layout v1 is GC'd out of `versions` (archived
+        to old_versions) once every current node sync-acks v2."""
+    async def main():
+        from test_model import wait_until
+
+        rng = random.Random(90210)
+        net, garages, tasks = await make_garage_cluster(
+            tmp_path, n=4, rf=3, storage=[0, 1, 2])
+        try:
+            bucket_id = gen_uuid()
+            keys = [f"obj-{i}" for i in range(10)]
+            acked = []
+            stop = asyncio.Event()
+
+            async def writer(wid):
+                while not stop.is_set():
+                    g = garages[rng.randrange(4)]
+                    key = keys[rng.randrange(len(keys))]
+                    uuid = gen_uuid()
+                    ts = rng.randrange(1, 1 << 40)
+                    meta = ObjectVersionMeta({}, 3, f"w{wid}")
+                    ov = ObjectVersion(
+                        uuid, ts, ObjectVersionState.complete(
+                            ObjectVersionData.inline(meta, b"xyz")))
+                    await g.object_table.insert(
+                        Object(bucket_id, key, [ov]))
+                    acked.append((key, uuid, ts))
+                    await asyncio.sleep(rng.random() * 0.01)
+
+            wtasks = [asyncio.create_task(writer(i)) for i in range(4)]
+            await asyncio.sleep(0.5)  # storm against layout v1 first
+
+            # mid-storm transition: + node3, - node1, applied on node 0
+            from garage_tpu.rpc.layout import NodeRole
+
+            lm = garages[0].system.layout_manager
+            lm.history.stage_role(garages[3].system.id,
+                                  NodeRole(zone="z1", capacity=1 << 30))
+            lm.history.stage_role(garages[1].system.id, None)
+            lm.apply_staged(None)
+            # keep storming THROUGH the transition while gossip spreads
+            await asyncio.sleep(1.0)
+            stop.set()
+            await asyncio.gather(*wtasks)
+            assert len(acked) > 50
+
+            assert await wait_until(lambda: all(
+                g.system.layout_manager.history.current().version == 2
+                for g in garages))
+
+            # quiesce: sync rounds everywhere (removed node 1 offloads
+            # its partitions) until the v2 storage nodes are identical
+            cur = [garages[i] for i in (0, 2, 3)]
+            for _ in range(30):
+                await asyncio.sleep(0.2)
+                for g in garages:
+                    await g.object_table.syncer.sync_all_partitions()
+                dumps = [_store_dump(g.object_table) for g in cur]
+                if dumps[0] == dumps[1] == dumps[2]:
+                    break
+            assert dumps[0] == dumps[1] == dumps[2]
+
+            expect = {}
+            for key, uuid, ts in acked:
+                if key not in expect or (ts, uuid) > expect[key]:
+                    expect[key] = (ts, uuid)
+            for g in cur:
+                for key, (ts, uuid) in expect.items():
+                    obj = await g.object_table.get(bucket_id, key.encode())
+                    assert obj is not None, key
+                    win = max((v.timestamp, v.uuid) for v in obj.versions)
+                    assert win == (ts, uuid), (key, win, ts)
+
+            # tracker GC: keep running sync rounds (they advance
+            # sync/sync_ack; gossip merges spread them) until v1 is out
+            # of every node's live `versions`
+            async def gc_done():
+                for g in garages:
+                    await g.object_table.syncer.sync_all_partitions()
+                return all(
+                    [v.version
+                     for v in g.system.layout_manager.history.versions]
+                    == [2] for g in garages)
+
+            ok = False
+            for _ in range(40):
+                if await gc_done():
+                    ok = True
+                    break
+                await asyncio.sleep(0.3)
+            assert ok, [
+                [v.version for v in g.system.layout_manager.history.versions]
+                for g in garages]
+            assert any(
+                v.version == 1
+                for v in garages[0].system.layout_manager.history.old_versions)
+        finally:
+            await stop_all(garages, tasks)
+
+    run(main())

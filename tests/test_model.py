@@ -46,7 +46,11 @@ async def wait_until(cond, timeout=20.0, interval=0.05):
     return cond()
 
 
-async def make_garage_cluster(tmp_path, n=3, rf=3, erasure=None):
+async def make_garage_cluster(tmp_path, n=3, rf=3, erasure=None,
+                              storage=None):
+    """`storage`: node indices that get a storage role in layout v1
+    (default all) — the rest join as gateways, so tests can stage
+    add/remove transitions later."""
     net = LocalNetwork()
     garages = []
     for i in range(n):
@@ -71,9 +75,10 @@ async def make_garage_cluster(tmp_path, n=3, rf=3, erasure=None):
     lm = garages[0].system.layout_manager
     from garage_tpu.rpc.layout import NodeRole
 
-    for g in garages:
-        lm.history.stage_role(g.system.id,
-                              NodeRole(zone="z1", capacity=1 << 30))
+    for i, g in enumerate(garages):
+        if storage is None or i in storage:
+            lm.history.stage_role(g.system.id,
+                                  NodeRole(zone="z1", capacity=1 << 30))
     lm.apply_staged(None)
     assert await wait_until(
         lambda: all(

@@ -452,6 +452,129 @@ def test_copy_object(client):
     assert got == body
 
 
+def test_copy_source_preconditions(client):
+    """x-amz-copy-source-if-* on CopyObject: every failing condition is
+    a 412 (ref: copy.rs:50-60 + get.rs check_copy_source)."""
+    body = os.urandom(20_000)
+    client.request("PUT", "/conformance/precond-src", body=body)
+    st, hdrs, _ = client.request("HEAD", "/conformance/precond-src")
+    etag = hdrs["etag"]  # quoted
+    lastmod = hdrs["last-modified"]
+    past = "Mon, 01 Jan 2001 00:00:00 GMT"
+    future = "Fri, 01 Jan 2100 00:00:00 GMT"
+    src = {"x-amz-copy-source": "/conformance/precond-src"}
+
+    def copy(extra):
+        st, _, b = client.request("PUT", "/conformance/precond-dst",
+                                  headers={**src, **extra})
+        return st, b
+
+    # if-match
+    assert copy({"x-amz-copy-source-if-match": etag})[0] == 200
+    assert copy({"x-amz-copy-source-if-match": "*"})[0] == 200
+    st, b = copy({"x-amz-copy-source-if-match": '"beef"'})
+    assert st == 412 and xml_error_code(b) == "PreconditionFailed"
+    # if-none-match
+    assert copy({"x-amz-copy-source-if-none-match": '"beef"'})[0] == 200
+    assert copy({"x-amz-copy-source-if-none-match": etag})[0] == 412
+    assert copy({"x-amz-copy-source-if-none-match": "*"})[0] == 412
+    # if-modified-since (412 when NOT modified since — no 304 on copy)
+    assert copy({"x-amz-copy-source-if-modified-since": past})[0] == 200
+    assert copy({"x-amz-copy-source-if-modified-since": future})[0] == 412
+    # if-unmodified-since
+    assert copy({"x-amz-copy-source-if-unmodified-since": future})[0] == 200
+    assert copy({"x-amz-copy-source-if-unmodified-since": lastmod})[0] == 200
+    assert copy({"x-amz-copy-source-if-unmodified-since": past})[0] == 412
+    # RFC 7232 order: a passing if-match shadows if-unmodified-since
+    assert copy({"x-amz-copy-source-if-match": etag,
+                 "x-amz-copy-source-if-unmodified-since": past})[0] == 200
+    # a fresh dst write really happened on the 200s
+    _, _, got = client.request("GET", "/conformance/precond-dst")
+    assert got == body
+
+
+def test_precondition_edge_cases(client):
+    """Unquoted client ETags match (the reference strips quotes);
+    malformed dates are a 400; page-size params validate."""
+    client.request("PUT", "/conformance/precond-edge", body=b"edge")
+    st, hdrs, _ = client.request("HEAD", "/conformance/precond-edge")
+    bare_etag = hdrs["etag"].strip('"')
+    src = {"x-amz-copy-source": "/conformance/precond-edge"}
+    # unquoted if-match accepted
+    st, _, _ = client.request(
+        "PUT", "/conformance/precond-edge-dst",
+        headers={**src, "x-amz-copy-source-if-match": bare_etag})
+    assert st == 200
+    # unquoted if-none-match still 412s on a match
+    st, _, _ = client.request(
+        "PUT", "/conformance/precond-edge-dst",
+        headers={**src, "x-amz-copy-source-if-none-match": bare_etag})
+    assert st == 412
+    # malformed date -> 400 (ref get.rs PreconditionHeaders::parse)
+    st, _, b = client.request(
+        "PUT", "/conformance/precond-edge-dst",
+        headers={**src, "x-amz-copy-source-if-modified-since": "nonsense"})
+    assert st == 400 and xml_error_code(b) == "InvalidArgument"
+    st, _, _ = client.request("GET", "/conformance/precond-edge",
+                              headers={"if-modified-since": "nonsense"})
+    assert st == 400
+    # unquoted GET if-none-match
+    st, _, _ = client.request("GET", "/conformance/precond-edge",
+                              headers={"if-none-match": bare_etag})
+    assert st == 304
+
+
+def test_page_size_param_validation(client):
+    # max-keys=0: legal, empty page, not truncated
+    st, _, body = client.request("GET", "/conformance",
+                                 query=[("list-type", "2"),
+                                        ("max-keys", "0")])
+    assert st == 200
+    assert xml_find(body, "IsTruncated") == ["false"]
+    assert not xml_find(body, "Contents")
+    # max-uploads / max-parts < 1: 400, not an infinite-pagination trap
+    st, _, b = client.request("GET", "/conformance",
+                              query=[("uploads", ""), ("max-uploads", "0")])
+    assert st == 400 and xml_error_code(b) == "InvalidArgument"
+    _, _, b = client.request("POST", "/conformance/pgzero",
+                             query=[("uploads", "")])
+    upload_id = xml_find(b, "UploadId")[0]
+    st, _, b = client.request(
+        "GET", "/conformance/pgzero",
+        query=[("uploadId", upload_id), ("max-parts", "0")])
+    assert st == 400 and xml_error_code(b) == "InvalidArgument"
+    st, _, b = client.request(
+        "GET", "/conformance", query=[("uploads", ""),
+                                      ("max-uploads", "junk")])
+    assert st == 400
+    client.request("DELETE", "/conformance/pgzero",
+                   query=[("uploadId", upload_id)])
+
+
+def test_upload_part_copy_preconditions(client):
+    """Same headers gate UploadPartCopy (ref: copy.rs:347-363)."""
+    body = os.urandom(12_000)
+    client.request("PUT", "/conformance/precond-src2", body=body)
+    st, hdrs, _ = client.request("HEAD", "/conformance/precond-src2")
+    etag = hdrs["etag"]
+    _, _, b = client.request("POST", "/conformance/precond-mp",
+                             query=[("uploads", "")])
+    upload_id = xml_find(b, "UploadId")[0]
+    q = [("partNumber", "1"), ("uploadId", upload_id)]
+    st, _, b = client.request(
+        "PUT", "/conformance/precond-mp", query=q,
+        headers={"x-amz-copy-source": "/conformance/precond-src2",
+                 "x-amz-copy-source-if-match": '"beef"'})
+    assert st == 412 and xml_error_code(b) == "PreconditionFailed"
+    st, _, b = client.request(
+        "PUT", "/conformance/precond-mp", query=q,
+        headers={"x-amz-copy-source": "/conformance/precond-src2",
+                 "x-amz-copy-source-if-match": etag})
+    assert st == 200 and xml_find(b, "ETag")
+    client.request("DELETE", "/conformance/precond-mp",
+                   query=[("uploadId", upload_id)])
+
+
 # ---- multipart ----------------------------------------------------------
 
 
@@ -505,6 +628,131 @@ def test_multipart_list_parts_and_uploads(client):
     status, _, body = client.request(
         "GET", "/conformance/mp2", query=[("uploadId", upload_id)])
     assert status == 404
+
+
+def test_list_uploads_pagination_over_1000(client):
+    """>1000 concurrent uploads page correctly through
+    NextKeyMarker/NextUploadIdMarker (ref: list.rs:169-265)."""
+    made = set()
+    for i in range(1001):
+        _, _, body = client.request("POST", f"/conformance/pgu/k{i:04d}",
+                                    query=[("uploads", "")])
+        made.add((f"pgu/k{i:04d}", xml_find(body, "UploadId")[0]))
+    seen = set()
+    q = [("uploads", ""), ("prefix", "pgu/")]
+    pages = 0
+    while True:
+        status, _, body = client.request("GET", "/conformance", query=q)
+        assert status == 200
+        keys = xml_find(body, "Key")
+        uids = xml_find(body, "UploadId")
+        assert len(keys) == len(uids)
+        for k, u in zip(keys, uids):
+            assert (k, u) not in seen, "duplicate across pages"
+            seen.add((k, u))
+        pages += 1
+        if xml_find(body, "IsTruncated")[0] != "true":
+            break
+        nk = xml_find(body, "NextKeyMarker")[0]
+        q = [("uploads", ""), ("prefix", "pgu/"), ("key-marker", nk)]
+        nu = xml_find(body, "NextUploadIdMarker")
+        if nu:
+            q.append(("upload-id-marker", nu[0]))
+        assert pages < 10
+    assert pages == 2  # 1000 + 1
+    assert seen == made
+    # cleanup so later listing tests aren't polluted
+    for k, u in made:
+        client.request("DELETE", f"/conformance/{k}",
+                       query=[("uploadId", u)])
+
+
+def test_list_uploads_same_key_marker_resume(client):
+    """Several uploads on ONE key: a small page size forces the
+    mid-key upload-id-marker cursor; delimiter folding pages too."""
+    uids = set()
+    for _ in range(5):
+        _, _, body = client.request("POST", "/conformance/pgm/dup",
+                                    query=[("uploads", "")])
+        uids.add(xml_find(body, "UploadId")[0])
+    got = []
+    q = [("uploads", ""), ("prefix", "pgm/"), ("max-uploads", "2")]
+    while True:
+        status, _, body = client.request("GET", "/conformance", query=q)
+        assert status == 200
+        assert len(xml_find(body, "UploadId")) <= 2
+        got += [u for u in xml_find(body, "UploadId")
+                if u not in ("include",)]
+        if xml_find(body, "IsTruncated")[0] != "true":
+            break
+        q = [("uploads", ""), ("prefix", "pgm/"), ("max-uploads", "2"),
+             ("key-marker", xml_find(body, "NextKeyMarker")[0])]
+        nu = xml_find(body, "NextUploadIdMarker")
+        if nu:
+            q.append(("upload-id-marker", nu[0]))
+    assert len(got) == 5 and set(got) == uids
+    assert got == sorted(got)  # same-key uploads in upload-id order
+
+    # delimiter folding with paging: two folded prefixes + one upload
+    for k in ("pgd/a/1", "pgd/a/2", "pgd/b/3"):
+        client.request("POST", f"/conformance/{k}", query=[("uploads", "")])
+    _, _, body = client.request("POST", "/conformance/pgd/c",
+                                query=[("uploads", "")])
+    c_uid = xml_find(body, "UploadId")[0]
+    status, _, body = client.request(
+        "GET", "/conformance",
+        query=[("uploads", ""), ("prefix", "pgd/"), ("delimiter", "/"),
+               ("max-uploads", "2")])
+    assert xml_find(body, "Prefix") == ["pgd/", "pgd/a/", "pgd/b/"]
+    assert xml_find(body, "IsTruncated")[0] == "true"
+    status, _, body = client.request(
+        "GET", "/conformance",
+        query=[("uploads", ""), ("prefix", "pgd/"), ("delimiter", "/"),
+               ("max-uploads", "2"),
+               ("key-marker", xml_find(body, "NextKeyMarker")[0])])
+    assert xml_find(body, "UploadId") == [c_uid]
+    assert xml_find(body, "IsTruncated")[0] == "false"
+
+
+def test_list_parts_pagination_over_1000(client):
+    """1002 parts: default page returns 1000 + NextPartNumberMarker;
+    the second page returns the rest (ref: list.rs fetch_part_info)."""
+    _, _, body = client.request("POST", "/conformance/pgparts",
+                                query=[("uploads", "")])
+    upload_id = xml_find(body, "UploadId")[0]
+    for pn in range(1, 1003):
+        status, _, _ = client.request(
+            "PUT", "/conformance/pgparts",
+            query=[("partNumber", str(pn)), ("uploadId", upload_id)],
+            body=b"x")
+        assert status == 200
+    status, _, body = client.request(
+        "GET", "/conformance/pgparts", query=[("uploadId", upload_id)])
+    assert status == 200
+    pns = [int(p) for p in xml_find(body, "PartNumber")]
+    assert pns == list(range(1, 1001))
+    assert xml_find(body, "IsTruncated")[0] == "true"
+    assert xml_find(body, "NextPartNumberMarker") == ["1000"]
+    status, _, body = client.request(
+        "GET", "/conformance/pgparts",
+        query=[("uploadId", upload_id), ("part-number-marker", "1000")])
+    pns2 = [int(p) for p in xml_find(body, "PartNumber")]
+    assert pns2 == [1001, 1002]
+    assert xml_find(body, "IsTruncated")[0] == "false"
+    # small-page walk collects exactly the full set
+    marker, walked = 0, []
+    while True:
+        status, _, body = client.request(
+            "GET", "/conformance/pgparts",
+            query=[("uploadId", upload_id), ("max-parts", "300"),
+                   ("part-number-marker", str(marker))])
+        walked += [int(p) for p in xml_find(body, "PartNumber")]
+        if xml_find(body, "IsTruncated")[0] != "true":
+            break
+        marker = int(xml_find(body, "NextPartNumberMarker")[0])
+    assert walked == list(range(1, 1003))
+    client.request("DELETE", "/conformance/pgparts",
+                   query=[("uploadId", upload_id)])
 
 
 def test_multipart_complete_wrong_etag(client):
