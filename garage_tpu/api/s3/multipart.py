@@ -116,7 +116,9 @@ async def handle_put_part(ctx, req: Request) -> Response:
     first = await chunker.next()
     if first is None:
         raise S3Error("EntityTooSmall", 400, "empty part")
-    md5 = hashlib.md5()
+    from ... import native
+
+    md5 = native.Md5()  # fuses with the content hash on the host route
     try:
         total, _md5_hex, etag, _first_hash = await read_and_put_blocks(
             ctx.garage, version, part_number, first, chunker, md5,
@@ -237,7 +239,9 @@ async def handle_upload_part_copy(ctx, req: Request) -> Response:
     version = Version.new(version_uuid, (BACKLINK_MPU, mpu.upload_id))
     await ctx.garage.version_table.insert(version)
 
-    md5 = hashlib.md5()
+    from ... import native
+
+    md5 = native.Md5()
     try:
         chunker = Chunker(source, ctx.garage.config.block_size)
         first = await chunker.next()

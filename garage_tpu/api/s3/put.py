@@ -33,26 +33,40 @@ _MULTICORE = (os.cpu_count() or 1) > 1
 
 class Chunker:
     """Re-chunk a body reader into block_size blocks
-    (ref: put.rs StreamChunker)."""
+    (ref: put.rs StreamChunker). Asking the reader for exactly the
+    missing byte count (read() never over-returns) means blocks
+    assemble with ONE join copy — zero when a read yields the whole
+    block — instead of the old bytearray extend+slice+memmove trio,
+    which was a measurable share of the one-core PUT path."""
 
     def __init__(self, body, block_size: int):
         self.body = body
         self.block_size = block_size
-        self.buf = bytearray()
         self.eof = False
+        self._rest = b""  # overshoot carry (AwsChunkedReader returns
+        # whole decoded client chunks, ignoring the requested size)
 
     async def next(self) -> Optional[bytes]:
-        while not self.eof and len(self.buf) < self.block_size:
-            chunk = await self.body.read(self.block_size)
+        chunks: list[bytes] = []
+        have = 0
+        if self._rest:
+            chunks.append(self._rest)
+            have = len(self._rest)
+            self._rest = b""
+        while not self.eof and have < self.block_size:
+            chunk = await self.body.read(self.block_size - have)
             if not chunk:
                 self.eof = True
                 break
-            self.buf.extend(chunk)
-        if not self.buf:
+            chunks.append(chunk)
+            have += len(chunk)
+        if not have:
             return None
-        out = bytes(self.buf[: self.block_size])
-        del self.buf[: self.block_size]
-        return out
+        whole = chunks[0] if len(chunks) == 1 else b"".join(chunks)
+        if have > self.block_size:
+            self._rest = whole[self.block_size:]
+            whole = whole[:self.block_size]
+        return whole
 
 
 def extract_metadata_headers(req: Request) -> dict:
@@ -157,7 +171,9 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
     first_block = first_block or b""
     uuid = gen_uuid()
     ts = next_timestamp(existing)
-    md5 = hashlib.md5()
+    from ... import native
+
+    md5 = native.Md5()  # hashlib fallback inside when no native lib
 
     if len(first_block) < INLINE_THRESHOLD:
         if content_length != len(first_block):
@@ -287,6 +303,10 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
 
     from ...utils.tracing import span
 
+    # plaintext MD5 (ETag chain) fuses with the content hash in one
+    # native pass when there is no SSE boundary (md5 covers plaintext,
+    # the content hash ciphertext, so encryption forces two walks)
+    fused = sse_key is None and getattr(md5, "fused", False)
     try:
         while block is not None:
             # md5 (ETag) and the declared checksum are independent
@@ -295,10 +315,11 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
             # max(), not sum(); on multicore the loop keeps serving
             # other requests meanwhile
             jobs = []
-            if _MULTICORE and len(block) >= 65536:
-                jobs.append(asyncio.to_thread(md5.update, block))
-            else:
-                md5.update(block)
+            if not fused:
+                if _MULTICORE and len(block) >= 65536:
+                    jobs.append(asyncio.to_thread(md5.update, block))
+                else:
+                    md5.update(block)
             if checksummer is not None:
                 jobs.append(asyncio.to_thread(checksummer.update, block))
             if jobs:
@@ -307,7 +328,10 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
             stored = (await asyncio.to_thread(sse_key.encrypt_block, block)
                       if sse_key is not None else block)
             async with span("s3.put.hash", size=len(stored)):
-                h = await garage.block_manager.hash_block(stored)
+                if fused:
+                    h = await garage.block_manager.hash_block_md5(block, md5)
+                else:
+                    h = await garage.block_manager.hash_block(stored)
             if first_hash is None:
                 first_hash = h
             tasks.append(asyncio.create_task(

@@ -152,11 +152,17 @@ class DeviceFeeder:
 
     def __init__(self, codec=None, mode: str = "auto"):
         self.codec = codec
-        if mode == "auto" and os.environ.get("GARAGE_TPU_DEVICE") == "off":
+        env_mode = os.environ.get("GARAGE_TPU_DEVICE")
+        if mode == "auto" and env_mode == "off":
             # test/CI kill-switch: never probe, never spawn calibration
             # threads (a probed tunnel leaves C++ threads that abort on
             # interpreter teardown — the r3 rc=134)
             mode = "off"
+        elif mode == "auto" and env_mode == "require":
+            # bench override: force every batch through the device even
+            # where auto-calibration would route to the host (the live
+            # S3-path device proof, bench.py bench_s3_put(device=True))
+            mode = "require"
         self.mode = mode
         self._q: Optional[asyncio.Queue] = None
         self._task: Optional[asyncio.Task] = None
@@ -316,6 +322,33 @@ class DeviceFeeder:
                              time.perf_counter() - t0)
                 return out
         return await self._submit("hash", data)
+
+    async def hash_with_md5(self, data: bytes, md5acc) -> bytes:
+        """Content hash + S3-ETag MD5 advance for one block. On the
+        host route both digests run in ONE GIL-released native pass
+        over the buffer (native.Md5.update_with_blake3 — the separate
+        walks were the top CPU cost of the S3 PUT path on a small
+        node); on the device route the content hash batches to the
+        accelerator while MD5 — a serial chain that cannot ride the
+        tree-structured device path — advances host-side."""
+        if getattr(md5acc, "fused", False) and self._host_inline_ok("hash"):
+            from ..utils import data as _data
+
+            if _data._content_algo == "blake3":
+                self.stats["inline_items"] += 1
+                t0 = time.perf_counter()
+                out = md5acc.update_with_blake3(data)
+                self._record("hash", "host", len(data),
+                             time.perf_counter() - t0)
+                return out
+        if (os.cpu_count() or 1) > 1 and len(data) >= 65536:
+            # device route on multicore: overlap the serial host MD5
+            # with the device hash instead of stalling the event loop
+            out, _ = await asyncio.gather(
+                self.hash(data), asyncio.to_thread(md5acc.update, data))
+            return out
+        md5acc.update(data)
+        return await self.hash(data)
 
     async def encode(self, packed: bytes) -> list[bytes]:
         """Erasure parts for one packed block (batched)."""

@@ -239,6 +239,11 @@ class BlockManager:
         callers through the device feeder (API PUT path entry point)."""
         return await self.feeder.hash(data)
 
+    async def hash_block_md5(self, data: bytes, md5acc) -> bytes:
+        """Content hash + ETag-MD5 advance in one feeder call (fused
+        single native pass on the host route; see feeder.hash_with_md5)."""
+        return await self.feeder.hash_with_md5(data, md5acc)
+
     async def rpc_put_block(self, hash32: bytes, data: bytes,
                             compress: Optional[bool] = None) -> None:
         from ..utils.tracing import span
@@ -461,14 +466,20 @@ class BlockManager:
         self.metrics["bytes_written"] += len(content)
 
     def write_local(self, hash32: bytes, packed: bytes) -> None:
-        """Store a whole packed DataBlock."""
-        blk = DataBlock.unpack(packed)
-        path = self.data_layout.block_path(hash32, blk.file_suffix())
-        self._write_file(path, blk.bytes)
+        """Store a whole packed DataBlock. The payload is written as a
+        memoryview slice past the 1-byte scheme header — no copy of the
+        megabyte body (DataBlock.unpack would make one)."""
+        from .block import SUFFIX_OF
+
+        suffix = SUFFIX_OF.get(packed[0])
+        if suffix is None:
+            raise CorruptData(hash32)
+        path = self.data_layout.block_path(hash32, suffix)
+        self._write_file(path, memoryview(packed)[1:])
         # drop other-compression variants if present (ref: manager.rs
         # write_block replaces regardless of compression state)
         for sfx in BLOCK_SUFFIXES:
-            if sfx == blk.file_suffix():
+            if sfx == suffix:
                 continue
             other = self.data_layout.block_path(hash32, sfx)
             if os.path.exists(other):

@@ -84,6 +84,13 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
     ]
+    lib.gt_md5_state_size.restype = ctypes.c_int
+    lib.gt_md5_init.argtypes = [ctypes.c_void_p]
+    lib.gt_md5_update.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_uint64]
+    lib.gt_md5_final_copy.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.gt_b3_md5_block.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                    ctypes.c_void_p, ctypes.c_char_p]
     return lib
 
 
@@ -145,6 +152,52 @@ def blake3_many(blobs: list[bytes]) -> list[bytes]:
         out.ctypes.data,
     )
     return [out[i].tobytes() for i in range(n)]
+
+
+class Md5:
+    """Streaming MD5 (S3 ETag chain) that can FUSE with the BLAKE3
+    content hash: update_with_blake3() advances the MD5 state and
+    returns the block's blake3 digest from ONE interleaved native pass
+    (the PUT path otherwise walks every block twice). Falls back to
+    hashlib when the native library is absent; duck-types the hashlib
+    surface the PUT path uses (update/hexdigest)."""
+
+    __slots__ = ("_st", "_h")
+
+    def __init__(self):
+        lib = _get()
+        if lib is not None:
+            self._st = ctypes.create_string_buffer(lib.gt_md5_state_size())
+            lib.gt_md5_init(self._st)
+            self._h = None
+        else:
+            self._st = None
+            self._h = hashlib.md5()
+
+    @property
+    def fused(self) -> bool:
+        return self._st is not None
+
+    def update(self, data) -> None:
+        if self._h is not None:
+            self._h.update(data)
+        else:
+            _lib.gt_md5_update(self._st, bytes(data) if not
+                               isinstance(data, bytes) else data, len(data))
+
+    def update_with_blake3(self, data: bytes) -> bytes:
+        """MD5-advance by `data` AND return blake3(data), single pass.
+        Only valid when `fused` is True."""
+        out = ctypes.create_string_buffer(32)
+        _lib.gt_b3_md5_block(data, len(data), self._st, out)
+        return out.raw
+
+    def hexdigest(self) -> str:
+        if self._h is not None:
+            return self._h.hexdigest()
+        out = ctypes.create_string_buffer(16)
+        _lib.gt_md5_final_copy(self._st, out)
+        return out.raw.hex()
 
 
 def _make_crc_table(poly: int, width: int) -> list:

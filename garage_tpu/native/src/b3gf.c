@@ -373,6 +373,242 @@ void b3_hash_many(const uint8_t *data, int64_t n, const int64_t *offs,
         b3_hash(data + offs[i], (uint64_t)lens[i], out + 32 * i);
 }
 
+/* ================= MD5 (RFC 1321) ================= */
+/* S3 ETags are MD5, so the PUT path pays a serial MD5 over every byte
+ * on top of the BLAKE3 content hash. gt_b3_md5_block below runs both
+ * digests in ONE interleaved pass (r5: the two separate walks over a
+ * 1 MiB block were the single largest CPU cost on the S3 PUT path of
+ * a one-core node). Streaming state lives in a caller-owned struct so
+ * the chain threads across blocks of the object. */
+
+typedef struct {
+    uint32_t h[4];
+    uint64_t nbytes;
+    uint32_t buflen;
+    uint8_t buf[64];
+} gt_md5;
+
+static const uint32_t MD5K[64] = {
+    0xd76aa478u, 0xe8c7b756u, 0x242070dbu, 0xc1bdceeeu,
+    0xf57c0fafu, 0x4787c62au, 0xa8304613u, 0xfd469501u,
+    0x698098d8u, 0x8b44f7afu, 0xffff5bb1u, 0x895cd7beu,
+    0x6b901122u, 0xfd987193u, 0xa679438eu, 0x49b40821u,
+    0xf61e2562u, 0xc040b340u, 0x265e5a51u, 0xe9b6c7aau,
+    0xd62f105du, 0x02441453u, 0xd8a1e681u, 0xe7d3fbc8u,
+    0x21e1cde6u, 0xc33707d6u, 0xf4d50d87u, 0x455a14edu,
+    0xa9e3e905u, 0xfcefa3f8u, 0x676f02d9u, 0x8d2a4c8au,
+    0xfffa3942u, 0x8771f681u, 0x6d9d6122u, 0xfde5380cu,
+    0xa4beea44u, 0x4bdecfa9u, 0xf6bb4b60u, 0xbebfbc70u,
+    0x289b7ec6u, 0xeaa127fau, 0xd4ef3085u, 0x04881d05u,
+    0xd9d4d039u, 0xe6db99e5u, 0x1fa27cf8u, 0xc4ac5665u,
+    0xf4292244u, 0x432aff97u, 0xab9423a7u, 0xfc93a039u,
+    0x655b59c3u, 0x8f0ccc92u, 0xffeff47du, 0x85845dd1u,
+    0x6fa87e4fu, 0xfe2ce6e0u, 0xa3014314u, 0x4e0811a1u,
+    0xf7537e82u, 0xbd3af235u, 0x2ad7d2bbu, 0xeb86d391u};
+
+static const uint8_t MD5R[64] = {
+    7, 12, 17, 22, 7, 12, 17, 22, 7, 12, 17, 22, 7, 12, 17, 22,
+    5, 9, 14, 20, 5, 9, 14, 20, 5, 9, 14, 20, 5, 9, 14, 20,
+    4, 11, 16, 23, 4, 11, 16, 23, 4, 11, 16, 23, 4, 11, 16, 23,
+    6, 10, 15, 21, 6, 10, 15, 21, 6, 10, 15, 21, 6, 10, 15, 21};
+
+static inline uint32_t rotl32(uint32_t x, int n) {
+    return (x << n) | (x >> (32 - n));
+}
+
+static void md5_compress(uint32_t h[4], const uint8_t p[64]) {
+    uint32_t M[16];
+    for (int i = 0; i < 16; i++)
+        M[i] = (uint32_t)p[4 * i] | ((uint32_t)p[4 * i + 1] << 8) |
+               ((uint32_t)p[4 * i + 2] << 16) |
+               ((uint32_t)p[4 * i + 3] << 24);
+    uint32_t A = h[0], B = h[1], C = h[2], D = h[3];
+    /* four unrolled 16-step rounds (the i/16 branch per step costs
+     * ~15% when left to the compiler) */
+    int i = 0;
+    for (; i < 16; i++) {
+        uint32_t F = (B & C) | (~B & D);
+        F += A + MD5K[i] + M[i];
+        A = D; D = C; C = B;
+        B += rotl32(F, MD5R[i]);
+    }
+    for (; i < 32; i++) {
+        uint32_t F = (D & B) | (~D & C);
+        F += A + MD5K[i] + M[(5 * i + 1) & 15];
+        A = D; D = C; C = B;
+        B += rotl32(F, MD5R[i]);
+    }
+    for (; i < 48; i++) {
+        uint32_t F = B ^ C ^ D;
+        F += A + MD5K[i] + M[(3 * i + 5) & 15];
+        A = D; D = C; C = B;
+        B += rotl32(F, MD5R[i]);
+    }
+    for (; i < 64; i++) {
+        uint32_t F = C ^ (B | ~D);
+        F += A + MD5K[i] + M[(7 * i) & 15];
+        A = D; D = C; C = B;
+        B += rotl32(F, MD5R[i]);
+    }
+    h[0] += A; h[1] += B; h[2] += C; h[3] += D;
+}
+
+int gt_md5_state_size(void) { return (int)sizeof(gt_md5); }
+
+void gt_md5_init(gt_md5 *m) {
+    m->h[0] = 0x67452301u; m->h[1] = 0xefcdab89u;
+    m->h[2] = 0x98badcfeu; m->h[3] = 0x10325476u;
+    m->nbytes = 0;
+    m->buflen = 0;
+}
+
+void gt_md5_update(gt_md5 *m, const uint8_t *p, uint64_t n) {
+    m->nbytes += n;
+    if (m->buflen) {
+        uint32_t take = 64 - m->buflen;
+        if (take > n) take = (uint32_t)n;
+        memcpy(m->buf + m->buflen, p, take);
+        m->buflen += take;
+        p += take; n -= take;
+        if (m->buflen == 64) {
+            md5_compress(m->h, m->buf);
+            m->buflen = 0;
+        }
+    }
+    while (n >= 64) {
+        md5_compress(m->h, p);
+        p += 64; n -= 64;
+    }
+    if (n) {
+        memcpy(m->buf, p, n);
+        m->buflen = (uint32_t)n;
+    }
+}
+
+/* Finalize WITHOUT mutating the stream state (hexdigest() mid-stream,
+ * like hashlib's). */
+void gt_md5_final_copy(const gt_md5 *src, uint8_t out[16]) {
+    gt_md5 m = *src;
+    uint64_t bits = m.nbytes * 8;
+    uint8_t pad = 0x80;
+    gt_md5_update(&m, &pad, 1);
+    static const uint8_t zeros[64] = {0};
+    while (m.buflen != 56)
+        gt_md5_update(&m, zeros, m.buflen < 56 ? 56 - m.buflen
+                                               : 64 - m.buflen + 56);
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; i++)
+        lenb[i] = (uint8_t)(bits >> (8 * i));
+    gt_md5_update(&m, lenb, 8);
+    for (int i = 0; i < 4; i++) {
+        out[4 * i] = (uint8_t)m.h[i];
+        out[4 * i + 1] = (uint8_t)(m.h[i] >> 8);
+        out[4 * i + 2] = (uint8_t)(m.h[i] >> 16);
+        out[4 * i + 3] = (uint8_t)(m.h[i] >> 24);
+    }
+}
+
+/* ---- fused BLAKE3 + MD5, one pass over the block ---- */
+
+/* Spec-tree reduction over an array of chunk CVs (left subtree = the
+ * largest power of two strictly below n). Segments are disjoint, so
+ * the 8-way level loop may reduce power-of-two runs in place. */
+static void cv_tree_reduce(uint32_t (*cvs)[8], uint64_t n, int root,
+                           uint32_t out[8]) {
+    if (n == 1) {
+        memcpy(out, cvs[0], 32);
+        return;
+    }
+#ifdef GT_X86
+    if (cpu_avx2 > 0 && !root && n >= 16 && (n & (n - 1)) == 0) {
+        uint64_t w = n;
+        while (w > 1) {
+            uint64_t half = w / 2, i = 0;
+            for (; i + 8 <= half; i += 8)
+                parents8_cv((const uint32_t(*)[8]) & cvs[2 * i], &cvs[i]);
+            for (; i < half; i++)
+                parent_cv(cvs[2 * i], cvs[2 * i + 1], 0, cvs[i]);
+            w = half;
+        }
+        memcpy(out, cvs[0], 32);
+        return;
+    }
+#endif
+    uint64_t left = 1;
+    while (left * 2 < n)
+        left *= 2;
+    uint32_t l[8], r[8];
+    cv_tree_reduce(cvs, left, 0, l);
+    cv_tree_reduce(cvs + left, n - left, 0, r);
+    parent_cv(l, r, root, out);
+}
+
+/* BLAKE3 digest of data[0..len) AND md5-advance `st` by the same
+ * bytes, interleaved in 16 KiB windows so both digests read each
+ * window while it is cache-resident: one RAM traversal instead of
+ * two. Returns the blake3 digest in out32. */
+void gt_b3_md5_block(const uint8_t *data, uint64_t len, gt_md5 *st,
+                     uint8_t out32[32]) {
+    uint64_t nchunks = len == 0 ? 1 : (len + CHUNK_LEN - 1) / CHUNK_LEN;
+    if (nchunks == 1) {
+        gt_md5_update(st, data, len);
+        uint32_t cv[8];
+        chunk_cv(data, (size_t)len, 0, 1, cv);
+        for (int i = 0; i < 8; i++) {
+            out32[4 * i] = (uint8_t)cv[i];
+            out32[4 * i + 1] = (uint8_t)(cv[i] >> 8);
+            out32[4 * i + 2] = (uint8_t)(cv[i] >> 16);
+            out32[4 * i + 3] = (uint8_t)(cv[i] >> 24);
+        }
+        return;
+    }
+    uint32_t (*cvs)[8] = malloc(sizeof(uint32_t[8]) * (size_t)nchunks);
+    if (!cvs) { /* degraded two-pass path */
+        gt_md5_update(st, data, len);
+        b3_hash(data, len, out32);
+        return;
+    }
+#ifdef GT_X86
+    if (cpu_avx2 < 0)
+        cpu_avx2 = __builtin_cpu_supports("avx2") ? 1 : 0;
+#endif
+    uint64_t full = len / CHUNK_LEN;       /* # full 1 KiB chunks */
+    uint64_t c = 0;
+    const uint64_t WIN = 16;               /* chunks per window, 16 KiB */
+    while (c < full) {
+        uint64_t end = c + WIN < full ? c + WIN : full;
+        gt_md5_update(st, data + c * CHUNK_LEN, (end - c) * CHUNK_LEN);
+        uint64_t i = c;
+#ifdef GT_X86
+        if (cpu_avx2 > 0)
+            for (; i + 8 <= end && i + 8 <= 0xFFFFFFFFu; i += 8) {
+                const uint8_t *p[8];
+                for (int l8 = 0; l8 < 8; l8++)
+                    p[l8] = data + (size_t)(i + l8) * CHUNK_LEN;
+                chunks8_cv(p, i, &cvs[i]);
+            }
+#endif
+        for (; i < end; i++)
+            chunk_cv(data + (size_t)i * CHUNK_LEN, CHUNK_LEN, i, 0,
+                     cvs[i]);
+        c = end;
+    }
+    if (nchunks > full) {                  /* partial tail chunk */
+        gt_md5_update(st, data + full * CHUNK_LEN, len - full * CHUNK_LEN);
+        chunk_cv(data + (size_t)full * CHUNK_LEN,
+                 (size_t)(len - full * CHUNK_LEN), full, 0, cvs[full]);
+    }
+    uint32_t cv[8];
+    cv_tree_reduce(cvs, nchunks, 1, cv);
+    free(cvs);
+    for (int i = 0; i < 8; i++) {
+        out32[4 * i] = (uint8_t)cv[i];
+        out32[4 * i + 1] = (uint8_t)(cv[i] >> 8);
+        out32[4 * i + 2] = (uint8_t)(cv[i] >> 16);
+        out32[4 * i + 3] = (uint8_t)(cv[i] >> 24);
+    }
+}
+
 /* ================= GF(2^8), poly 0x11D ================= */
 
 static uint8_t GFMUL[256][256];

@@ -305,3 +305,32 @@ def test_put_get_roundtrip_native_erasure():
             shutil.rmtree(tmp, ignore_errors=True)
 
     run(go())
+
+
+def test_native_md5_fused():
+    """Md5 accumulator: hashlib parity across chained fused/plain
+    updates, and the fused call returns the block's blake3."""
+    import hashlib
+
+    import numpy as np
+
+    from garage_tpu import native
+    from garage_tpu.utils.data import blake3sum
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("no native toolchain")
+    rng = np.random.default_rng(5)
+    m = native.Md5()
+    ref = hashlib.md5()
+    assert m.fused
+    for i, n in enumerate((0, 1, 63, 64, 65, 1024, 1025, 70_000,
+                           (1 << 20) + 3)):
+        data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+        if i % 2:
+            assert m.update_with_blake3(data) == blake3sum(data)
+        else:
+            m.update(data)
+        ref.update(data)
+        assert m.hexdigest() == ref.hexdigest(), n  # mid-stream digests

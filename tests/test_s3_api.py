@@ -812,6 +812,25 @@ def test_chunked_signed_put(client):
     assert got == b"".join(chunks)
 
 
+def test_chunked_put_respects_block_size(server, client):
+    """Client aws-chunks BIGGER than the server block size must still be
+    re-chunked to block_size blocks (AwsChunkedReader returns whole
+    decoded client chunks; the Chunker carries the overshoot)."""
+    chunks = [os.urandom(200_000), os.urandom(150_000)]
+    status, _, body = client.put_chunked("/conformance/bigchunk", chunks)
+    assert status == 200, body
+    status, _, got = client.request("GET", "/conformance/bigchunk")
+    assert got == b"".join(chunks)
+    # every stored block file obeys the configured 64 KiB block size
+    too_big = []
+    for root, _dirs, files in os.walk(os.path.join(server.dir, "data")):
+        for fn in files:
+            sz = os.path.getsize(os.path.join(root, fn))
+            if sz > 65536 + 1024:  # header/compression slack
+                too_big.append((fn, sz))
+    assert not too_big, too_big
+
+
 def test_chunked_bad_signature_rejected(client):
     status, _, _ = client.put_chunked(
         "/conformance/chunked-bad", [b"data" * 1000],
