@@ -289,15 +289,18 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
                         version.blocks.put((part_number, off),
                                            (h, plain_len)),
                         version.backlink)
-            # version/block_ref rows ride the LOCAL insert queue (two
-            # tiny db txs) instead of two quorum RPCs per block — the
-            # reference's structure (put.rs:545); read_and_put_blocks
-            # flushes the queues through the quorum path before the
-            # caller commits the Complete row, so read-your-writes is
-            # preserved
-            queued_keys.add(garage.version_table.queue_insert_local(v))
-            queued_keys.add(garage.block_ref_table.queue_insert_local(
-                BlockRef.new(h, version.uuid)))
+            # version/block_ref rows ride the LOCAL insert queue (ONE
+            # tiny db tx for both rows) instead of two quorum RPCs per
+            # block — the reference's structure (put.rs:545);
+            # read_and_put_blocks flushes the queues through the quorum
+            # path before the caller commits the Complete row, so
+            # read-your-writes is preserved
+            from ...table.table import queue_insert_local_many
+
+            queued_keys.update(queue_insert_local_many([
+                (garage.version_table, v),
+                (garage.block_ref_table, BlockRef.new(h, version.uuid)),
+            ]))
             await garage.block_manager.rpc_put_block(
                 h, blk, compress=False if sse_key is not None else None)
 
@@ -307,6 +310,13 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
     # native pass when there is no SSE boundary (md5 covers plaintext,
     # the content hash ciphertext, so encryption forces two walks)
     fused = sse_key is None and getattr(md5, "fused", False)
+    feeder = garage.block_manager.feeder
+    # active-stream mark (fused streams only: SSE/non-native streams
+    # never submit hash_md5, so counting them would make the dispatcher
+    # wait for lanes that cannot arrive): sizes the feeder's gather
+    # window for the 8-way cross-request MD5
+    if fused:
+        feeder.active_streams += 1
     try:
         while block is not None:
             # md5 (ETag) and the declared checksum are independent
@@ -390,6 +400,9 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
                 break  # flush failed; retrieved below, original re-raised
         flush.cancelled() or flush.exception()  # retrieve, don't mask
         raise
+    finally:
+        if fused:
+            feeder.active_streams -= 1
     md5_hex = md5.hexdigest()
     etag = ssec_etag() if sse_key is not None else md5_hex
     return offset, md5_hex, etag, first_hash

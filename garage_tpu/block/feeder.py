@@ -171,6 +171,9 @@ class DeviceFeeder:
         self._calibrating = False
         self.stats = {"batches": 0, "items": 0, "device_batches": 0,
                       "device_items": 0, "inline_items": 0, "max_batch": 0}
+        # PUT streams currently inside read_and_put_blocks: sizes the
+        # hash_md5 gather window (one block hash in flight per stream)
+        self.active_streams = 0
         # calibration: (op, backend) -> [bytes, seconds]; routing picks
         # the backend with the best observed bytes/s, exploring the
         # other every _EXPLORE_EVERY batches
@@ -324,31 +327,39 @@ class DeviceFeeder:
         return await self._submit("hash", data)
 
     async def hash_with_md5(self, data: bytes, md5acc) -> bytes:
-        """Content hash + S3-ETag MD5 advance for one block. On the
-        host route both digests run in ONE GIL-released native pass
-        over the buffer (native.Md5.update_with_blake3 — the separate
-        walks were the top CPU cost of the S3 PUT path on a small
-        node); on the device route the content hash batches to the
-        accelerator while MD5 — a serial chain that cannot ride the
-        tree-structured device path — advances host-side."""
-        if getattr(md5acc, "fused", False) and self._host_inline_ok("hash"):
+        """Content hash + S3-ETag MD5 advance for one block. Rides the
+        feeder queue so blocks from CONCURRENT requests form one batch:
+        MD5 is a strict serial chain within an object but independent
+        across objects, and the native kernel runs up to 8 chains in
+        AVX2 lockstep (measured: 0.48 GB/s single -> 2.4 GB/s at 8
+        lanes). Host route fuses blake3 into the same call; device
+        route batch-advances the MD5s host-side while the content hash
+        batches to the accelerator (a serial chain can't ride the
+        tree-structured device path)."""
+        if getattr(md5acc, "fused", False):
             from ..utils import data as _data
 
             if _data._content_algo == "blake3":
-                self.stats["inline_items"] += 1
-                t0 = time.perf_counter()
-                out = md5acc.update_with_blake3(data)
-                self._record("hash", "host", len(data),
-                             time.perf_counter() - t0)
-                return out
+                if self.active_streams <= 1 \
+                        and self._host_inline_ok("hash"):
+                    # lone stream: no lanes to gather — the inline
+                    # one-pass interleaved kernel beats the queue hop
+                    # plus a 1-lane batch
+                    self.stats["inline_items"] += 1
+                    t0 = time.perf_counter()
+                    out = md5acc.update_with_blake3(data)
+                    self._record("hash", "host", len(data),
+                                 time.perf_counter() - t0)
+                    return out
+                return await self._submit("hash_md5", (md5acc, data))
+        # non-native fallback: hashlib md5 + separate content hash
         if (os.cpu_count() or 1) > 1 and len(data) >= 65536:
-            # device route on multicore: overlap the serial host MD5
-            # with the device hash instead of stalling the event loop
             out, _ = await asyncio.gather(
                 self.hash(data), asyncio.to_thread(md5acc.update, data))
             return out
         md5acc.update(data)
         return await self.hash(data)
+
 
     async def encode(self, packed: bytes) -> list[bytes]:
         """Erasure parts for one packed block (batched)."""
@@ -450,12 +461,37 @@ class DeviceFeeder:
         while True:
             first = await self._q.get()
             batch = [first]
-            # greedy non-waiting drain: whatever queued while the last
-            # batch was on the device becomes the next batch
-            while not self._q.empty() and len(batch) < 256:
-                batch.append(self._q.get_nowait())
-            self._maybe_start_probe()
             try:
+                # greedy non-waiting drain: whatever queued while the
+                # last batch was on the device becomes the next batch
+                while not self._q.empty() and len(batch) < 256:
+                    batch.append(self._q.get_nowait())
+                n_md5 = sum(1 for it in batch if it.op == "hash_md5")
+                want = min(self.active_streams, 8)
+                if first.op == "hash_md5" and self.active_streams > 1 \
+                        and n_md5 < want:
+                    # several fused PUT streams are mid-block-loop: a
+                    # short async gather window lets their next hash
+                    # submissions line up, multiplying the MD5 lane
+                    # count. The wait burns no CPU — the event loop
+                    # spends it reading the OTHER streams' sockets,
+                    # which is exactly what gets them here. Only
+                    # hash_md5 items count toward the lane target.
+                    loop = asyncio.get_running_loop()
+                    deadline = loop.time() + 0.006
+                    while n_md5 < want:
+                        left = deadline - loop.time()
+                        if left <= 0:
+                            break
+                        try:
+                            item = await asyncio.wait_for(
+                                self._q.get(), left)
+                        except asyncio.TimeoutError:
+                            break
+                        batch.append(item)
+                        if item.op == "hash_md5":
+                            n_md5 += 1
+                self._maybe_start_probe()
                 try:
                     results = await asyncio.wait_for(
                         asyncio.to_thread(self._run_batch, batch),
@@ -530,12 +566,12 @@ class DeviceFeeder:
             by_op.setdefault(item.op, []).append(i)
         for op, idxs in by_op.items():
             blobs = [batch[i].data for i in idxs]
-            if op in ("verify", "encode_put"):  # items are 2-tuples
+            if op in ("verify", "encode_put", "hash_md5"):  # 2-tuples
                 total = sum(len(b) for _, b in blobs)
             else:
                 total = sum(len(b) for b in blobs
                             if isinstance(b, (bytes, bytearray)))
-            perf_op = ("hash" if op == "verify" else
+            perf_op = ("hash" if op in ("verify", "hash_md5") else
                        "encode" if op == "encode_put" else op)
             host_only = force_host
             if perf_op == "hash":
@@ -577,6 +613,15 @@ class DeviceFeeder:
     def _do_op(self, op: str, blobs: list, backend: str) -> list:
         if op == "hash":
             return self._do_hash(blobs, backend)
+        if op == "hash_md5":
+            from .. import native
+
+            if backend == "device":
+                # MD5 chains batch-advance host-side (8-way across
+                # items); the content hash batches to the device
+                native.md5_update_many(blobs)
+                return self._do_hash([d for _, d in blobs], backend)
+            return native.b3_md5_many(list(blobs))
         if op == "verify":
             digs = self._do_hash([b for _, b in blobs], backend)
             return _verify_matches(digs, blobs)

@@ -508,6 +508,180 @@ void gt_md5_final_copy(const gt_md5 *src, uint8_t out[16]) {
     }
 }
 
+/* ---- 8-way multi-buffer MD5 (AVX2) ----
+ * MD5 is a strict serial chain WITHIN one object, but concurrent PUT
+ * requests are independent chains: running 8 of them in lockstep, one
+ * 32-bit word per lane (same formulation as compress8 above), turns
+ * the ETag MD5 from ~0.55 GB/s into a batched multi-GB/s op whenever
+ * the feeder queue holds blocks from several requests. */
+
+#ifdef GT_X86
+
+#define ROTL8V(v, n) _mm256_or_si256(_mm256_slli_epi32(v, n), \
+                                     _mm256_srli_epi32(v, 32 - (n)))
+
+__attribute__((target("avx2")))
+static void md5_compress8(__m256i h[4], const uint8_t *const p[8],
+                          size_t off) {
+    __m256i M[16];
+    for (int j = 0; j < 16; j++)
+        M[j] = _mm256_set_epi32(
+            (int)ldw(p[7] + off + 4 * j), (int)ldw(p[6] + off + 4 * j),
+            (int)ldw(p[5] + off + 4 * j), (int)ldw(p[4] + off + 4 * j),
+            (int)ldw(p[3] + off + 4 * j), (int)ldw(p[2] + off + 4 * j),
+            (int)ldw(p[1] + off + 4 * j), (int)ldw(p[0] + off + 4 * j));
+    __m256i A = h[0], B = h[1], C = h[2], D = h[3];
+    int i = 0;
+#define MD5STEP8(Fexpr, g, r)                                         \
+    do {                                                              \
+        __m256i F = Fexpr;                                            \
+        F = _mm256_add_epi32(F, _mm256_add_epi32(A,                   \
+                _mm256_add_epi32(_mm256_set1_epi32((int)MD5K[i]),     \
+                                 M[g])));                             \
+        A = D; D = C; C = B;                                          \
+        B = _mm256_add_epi32(B, ROTL8V(F, r));                        \
+        i++;                                                          \
+    } while (0)
+    for (int q = 0; q < 4; q++) {
+        MD5STEP8(_mm256_or_si256(_mm256_and_si256(B, C),
+                                 _mm256_andnot_si256(B, D)), i, 7);
+        MD5STEP8(_mm256_or_si256(_mm256_and_si256(B, C),
+                                 _mm256_andnot_si256(B, D)), i, 12);
+        MD5STEP8(_mm256_or_si256(_mm256_and_si256(B, C),
+                                 _mm256_andnot_si256(B, D)), i, 17);
+        MD5STEP8(_mm256_or_si256(_mm256_and_si256(B, C),
+                                 _mm256_andnot_si256(B, D)), i, 22);
+    }
+    for (int q = 0; q < 4; q++) {
+        MD5STEP8(_mm256_or_si256(_mm256_and_si256(D, B),
+                                 _mm256_andnot_si256(D, C)),
+                 (5 * i + 1) & 15, 5);
+        MD5STEP8(_mm256_or_si256(_mm256_and_si256(D, B),
+                                 _mm256_andnot_si256(D, C)),
+                 (5 * i + 1) & 15, 9);
+        MD5STEP8(_mm256_or_si256(_mm256_and_si256(D, B),
+                                 _mm256_andnot_si256(D, C)),
+                 (5 * i + 1) & 15, 14);
+        MD5STEP8(_mm256_or_si256(_mm256_and_si256(D, B),
+                                 _mm256_andnot_si256(D, C)),
+                 (5 * i + 1) & 15, 20);
+    }
+    for (int q = 0; q < 4; q++) {
+        MD5STEP8(_mm256_xor_si256(_mm256_xor_si256(B, C), D),
+                 (3 * i + 5) & 15, 4);
+        MD5STEP8(_mm256_xor_si256(_mm256_xor_si256(B, C), D),
+                 (3 * i + 5) & 15, 11);
+        MD5STEP8(_mm256_xor_si256(_mm256_xor_si256(B, C), D),
+                 (3 * i + 5) & 15, 16);
+        MD5STEP8(_mm256_xor_si256(_mm256_xor_si256(B, C), D),
+                 (3 * i + 5) & 15, 23);
+    }
+    __m256i ones = _mm256_set1_epi32(-1);
+    for (int q = 0; q < 4; q++) {
+        MD5STEP8(_mm256_xor_si256(C, _mm256_or_si256(B,
+                     _mm256_xor_si256(D, ones))), (7 * i) & 15, 6);
+        MD5STEP8(_mm256_xor_si256(C, _mm256_or_si256(B,
+                     _mm256_xor_si256(D, ones))), (7 * i) & 15, 10);
+        MD5STEP8(_mm256_xor_si256(C, _mm256_or_si256(B,
+                     _mm256_xor_si256(D, ones))), (7 * i) & 15, 15);
+        MD5STEP8(_mm256_xor_si256(C, _mm256_or_si256(B,
+                     _mm256_xor_si256(D, ones))), (7 * i) & 15, 21);
+    }
+#undef MD5STEP8
+    h[0] = _mm256_add_epi32(h[0], A);
+    h[1] = _mm256_add_epi32(h[1], B);
+    h[2] = _mm256_add_epi32(h[2], C);
+    h[3] = _mm256_add_epi32(h[3], D);
+}
+
+/* advance 8 lane states by `nblocks` sequential 64-byte blocks each
+ * (lane l reads p[l] + 64*k). Does NOT touch nbytes/buf — callers
+ * account for consumed bytes. */
+__attribute__((target("avx2")))
+static void md5_blocks8(gt_md5 *const st[8], const uint8_t *const p[8],
+                        uint64_t nblocks) {
+    __m256i h[4];
+    for (int w = 0; w < 4; w++)
+        h[w] = _mm256_set_epi32(
+            (int)st[7]->h[w], (int)st[6]->h[w], (int)st[5]->h[w],
+            (int)st[4]->h[w], (int)st[3]->h[w], (int)st[2]->h[w],
+            (int)st[1]->h[w], (int)st[0]->h[w]);
+    for (uint64_t b = 0; b < nblocks; b++)
+        md5_compress8(h, p, (size_t)(64 * b));
+    uint32_t tmp[4][8];
+    for (int w = 0; w < 4; w++)
+        _mm256_storeu_si256((__m256i *)tmp[w], h[w]);
+    for (int l = 0; l < 8; l++)
+        for (int w = 0; w < 4; w++)
+            st[l]->h[w] = tmp[w][l];
+}
+
+#endif /* GT_X86 */
+
+/* Advance n independent MD5 states, 8 lanes in lockstep where
+ * possible. Items with a partial buffered block or <64 bytes take the
+ * scalar path; padding lanes replay lane 0 into a scratch state. */
+void gt_md5_update_many(int64_t n, const uint8_t **ps,
+                        const int64_t *lens, gt_md5 **sts) {
+#ifdef GT_X86
+    if (cpu_avx2 < 0)
+        cpu_avx2 = __builtin_cpu_supports("avx2") ? 1 : 0;
+    if (cpu_avx2 > 0) {
+        int64_t i = 0;
+        while (i < n) {
+            int g = 0;
+            int64_t gi[8];
+            while (i < n && g < 8) {
+                if (sts[i]->buflen == 0 && lens[i] >= 64)
+                    gi[g++] = i;
+                else
+                    gt_md5_update(sts[i], ps[i], (uint64_t)lens[i]);
+                i++;
+            }
+            if (g >= 2) {
+                uint64_t minblocks = (uint64_t)lens[gi[0]] / 64;
+                for (int j = 1; j < g; j++) {
+                    uint64_t nb = (uint64_t)lens[gi[j]] / 64;
+                    if (nb < minblocks)
+                        minblocks = nb;
+                }
+                gt_md5 dummy;
+                gt_md5_init(&dummy);
+                gt_md5 *s8[8];
+                const uint8_t *p8[8];
+                for (int j = 0; j < 8; j++) {
+                    s8[j] = j < g ? sts[gi[j]] : &dummy;
+                    p8[j] = ps[gi[j < g ? j : 0]];
+                }
+                md5_blocks8(s8, p8, minblocks);
+                for (int j = 0; j < g; j++) {
+                    gt_md5 *st = sts[gi[j]];
+                    st->nbytes += 64 * minblocks;
+                    uint64_t rem = (uint64_t)lens[gi[j]] - 64 * minblocks;
+                    if (rem)
+                        gt_md5_update(st, ps[gi[j]] + 64 * minblocks, rem);
+                }
+            } else if (g == 1) {
+                gt_md5_update(sts[gi[0]], ps[gi[0]],
+                              (uint64_t)lens[gi[0]]);
+            }
+        }
+        return;
+    }
+#endif
+    for (int64_t i = 0; i < n; i++)
+        gt_md5_update(sts[i], ps[i], (uint64_t)lens[i]);
+}
+
+/* Batched fused op for the feeder: advance each md5 state (8-way
+ * across items) and write blake3(item) to outs + 32*i. */
+void gt_b3_md5_many(int64_t n, const uint8_t **ps, const int64_t *lens,
+                    gt_md5 **sts, uint8_t *outs) {
+    gt_md5_update_many(n, ps, lens, sts);
+    for (int64_t i = 0; i < n; i++)
+        b3_hash(ps[i], (uint64_t)lens[i], outs + 32 * i);
+}
+
 /* ---- fused BLAKE3 + MD5, one pass over the block ---- */
 
 /* Spec-tree reduction over an array of chunk CVs (left subtree = the

@@ -148,19 +148,19 @@ class MerkleUpdater(Worker):
     def update_item(self, row_key: bytes, new_hash: bytes) -> None:
         """Apply one todo entry (new_hash empty = row deleted), folding
         hashes up the trie inside one db transaction."""
+        self.data.db.transaction(
+            lambda tx: self._apply_one(tx, row_key, new_hash))
+
+    def _apply_one(self, tx, row_key: bytes, new_hash: bytes) -> None:
         partition = self._partition_of_row(row_key)
         khash = blake2sum(row_key)
-
-        def body(tx):
-            self._update_rec(tx, partition, b"", row_key, khash,
-                             new_hash if new_hash else None)
-            # only clear the todo entry if it hasn't changed since we
-            # read it (a concurrent write may have requeued the row)
-            cur = tx.get(self.data.merkle_todo, row_key)
-            if cur == (new_hash if new_hash else b""):
-                tx.remove(self.data.merkle_todo, row_key)
-
-        self.data.db.transaction(body)
+        self._update_rec(tx, partition, b"", row_key, khash,
+                         new_hash if new_hash else None)
+        # only clear the todo entry if it hasn't changed since we
+        # read it (a concurrent write may have requeued the row)
+        cur = tx.get(self.data.merkle_todo, row_key)
+        if cur == (new_hash if new_hash else b""):
+            tx.remove(self.data.merkle_todo, row_key)
 
     def _update_rec(self, tx, partition: int, prefix: bytes, row_key: bytes,
                     khash: bytes, new_vhash: Optional[bytes]) -> Optional[bytes]:
@@ -230,14 +230,28 @@ class MerkleUpdater(Worker):
 
     # ---- worker loop ---------------------------------------------------
 
+    # rows per db transaction: each trie update is ~4 tiny statements,
+    # so per-row transactions were BEGIN/COMMIT-dominated under PUT
+    # load; 32 rows amortize that while bounding db-lock hold time
+    # (the PUT path shares the lock)
+    TX_STEP = 32
+
     async def work(self):
         import asyncio
 
         todo = list(self.data.merkle_todo.iter())[: self.BATCH]
         if not todo:
             return WState.IDLE
-        for k, v in todo:
-            await asyncio.to_thread(self.update_item, k, v)
+
+        def apply(rows):
+            def body(tx):
+                for k, v in rows:
+                    self._apply_one(tx, k, v)
+
+            self.data.db.transaction(body)
+
+        for i in range(0, len(todo), self.TX_STEP):
+            await asyncio.to_thread(apply, todo[i:i + self.TX_STEP])
         return WState.BUSY
 
     async def wait_for_work(self):

@@ -269,3 +269,21 @@ class Table:
             )
             return {"entries": entries}
         raise ValueError(f"unknown table op {op!r}")
+
+
+def queue_insert_local_many(items: list) -> list[bytes]:
+    """queue_insert_local for rows spanning TABLES that share one db,
+    in a single transaction — the PUT path enqueues a version and a
+    block_ref row per block, and one tx instead of two halves the
+    BEGIN/COMMIT cost on its hottest metadata step. `items` is
+    [(table, entry)]; returns the queue row keys."""
+    from .schema import tree_key
+
+    db = items[0][0].data.db
+
+    def body(tx):
+        for t, e in items:
+            t.data.queue_insert(tx, e)
+
+    db.transaction(body)
+    return [tree_key(e.partition_key(), e.sort_key()) for _, e in items]
