@@ -33,8 +33,13 @@ class AdminHttpServer:
             admin_rpc = AdminRpcHandler(garage)
         self.rpc = admin_rpc
 
-    async def start(self, host: str, port: int) -> None:
-        await self.http.start(host, port)
+    async def start(self, host: str, port=None) -> None:
+        # a path (port None) binds a Unix-domain socket, like the
+        # reference's UnixOrTCPSocketAddress bind addresses
+        if port is None:
+            await self.http.start_unix(host)
+        else:
+            await self.http.start(host, port)
 
     async def stop(self) -> None:
         await self.http.stop()

@@ -278,6 +278,28 @@ class HttpServer:
         self.bound_port = self._server.sockets[0].getsockname()[1]
         log.info("%s server listening on %s:%d", self.name, host, self.bound_port)
 
+    async def start_unix(self, path: str, mode: int = 0o222) -> None:
+        """Listen on a Unix-domain socket instead of TCP (ref:
+        api/common/generic_server.rs:120-131 — same 0o222 default mode
+        as the reference: reachable by anyone who may traverse the
+        directory, not readable as a file)."""
+        import os as _os
+        import stat as _stat
+
+        try:
+            st = _os.stat(path)
+            if not _stat.S_ISSOCK(st.st_mode):
+                # never delete a real file someone pointed the bind at
+                raise OSError(f"{path} exists and is not a socket")
+            _os.remove(path)  # stale socket from a previous run
+        except FileNotFoundError:
+            pass
+        self._server = await asyncio.start_unix_server(self._conn, path,
+                                                       limit=1 << 20)
+        _os.chmod(path, mode)
+        self.bound_port = None
+        log.info("%s server listening on unix:%s", self.name, path)
+
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()

@@ -47,8 +47,13 @@ class K2VApiServer:
         self.region = region or garage.config.s3_region
         self.http = HttpServer(self.handle, name="k2v")
 
-    async def start(self, host: str, port: int) -> None:
-        await self.http.start(host, port)
+    async def start(self, host: str, port=None) -> None:
+        # a path (port None) binds a Unix-domain socket, like the
+        # reference's UnixOrTCPSocketAddress bind addresses
+        if port is None:
+            await self.http.start_unix(host)
+        else:
+            await self.http.start(host, port)
 
     async def stop(self) -> None:
         await self.http.stop()

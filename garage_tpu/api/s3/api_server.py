@@ -54,8 +54,13 @@ class S3ApiServer:
         self.root_domain = root_domain or garage.config.root_domain
         self.http = HttpServer(self.handle, name="s3")
 
-    async def start(self, host: str, port: int) -> None:
-        await self.http.start(host, port)
+    async def start(self, host: str, port=None) -> None:
+        # a path (port None) binds a Unix-domain socket, like the
+        # reference's UnixOrTCPSocketAddress bind addresses
+        if port is None:
+            await self.http.start_unix(host)
+        else:
+            await self.http.start(host, port)
 
     async def stop(self) -> None:
         await self.http.stop()

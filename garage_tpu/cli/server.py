@@ -43,34 +43,39 @@ async def run_server(cfg_path: str) -> None:
         except NotImplementedError:
             pass
 
+    async def start_frontend(srv, bind: str) -> None:
+        # bind addr is "host:port" or an absolute path -> Unix socket
+        # (ref: util/socket_address.rs UnixOrTCPSocketAddress)
+        if bind.startswith("/"):
+            await srv.start(bind)
+        else:
+            host, port = parse_addr(bind)
+            await srv.start(host, port)
+
     system_task = asyncio.create_task(garage.run())
     servers = []
     s3 = None
     if cfg.s3_api_bind_addr:
         s3 = S3ApiServer(garage)
-        host, port = parse_addr(cfg.s3_api_bind_addr)
-        await s3.start(host, port)
+        await start_frontend(s3, cfg.s3_api_bind_addr)
         servers.append(s3)
     if cfg.admin_api_bind_addr:
         from ..admin.http import AdminHttpServer
 
         ad = AdminHttpServer(garage, admin_rpc=admin)
-        host, port = parse_addr(cfg.admin_api_bind_addr)
-        await ad.start(host, port)
+        await start_frontend(ad, cfg.admin_api_bind_addr)
         servers.append(ad)
     if cfg.k2v_api_bind_addr:
         from ..api.k2v.api_server import K2VApiServer
 
         k2v = K2VApiServer(garage)
-        host, port = parse_addr(cfg.k2v_api_bind_addr)
-        await k2v.start(host, port)
+        await start_frontend(k2v, cfg.k2v_api_bind_addr)
         servers.append(k2v)
     if cfg.web_bind_addr:
         from ..web.server import WebServer
 
         web = WebServer(garage, s3)
-        host, port = parse_addr(cfg.web_bind_addr)
-        await web.start(host, port)
+        await start_frontend(web, cfg.web_bind_addr)
         servers.append(web)
 
     log.info("node %s up (rpc %s)", garage.system.id.hex()[:16],

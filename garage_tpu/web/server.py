@@ -50,8 +50,13 @@ class WebServer:
         self.http = HttpServer(self.handle, name="web")
         self.metrics = {"requests": 0, "errors": 0}
 
-    async def start(self, host: str, port: int) -> None:
-        await self.http.start(host, port)
+    async def start(self, host: str, port=None) -> None:
+        # a path (port None) binds a Unix-domain socket, like the
+        # reference's UnixOrTCPSocketAddress bind addresses
+        if port is None:
+            await self.http.start_unix(host)
+        else:
+            await self.http.start(host, port)
 
     async def stop(self) -> None:
         await self.http.stop()

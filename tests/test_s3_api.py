@@ -2060,3 +2060,43 @@ def test_secret_files_with_permission_checks(tmp_path):
         assert cfg.admin_token == "env-token"
     finally:
         del os.environ["GARAGE_ADMIN_TOKEN"]
+
+
+def test_unix_socket_admin_bind(tmp_path_factory):
+    """A path-valued bind addr makes the API server listen on a
+    Unix-domain socket with the reference's 0o222 socket mode
+    (ref: api/common/generic_server.rs:120-131,
+    util/socket_address.rs)."""
+    import http.client
+    import socket
+    import stat
+
+    tmp = str(tmp_path_factory.mktemp("udssrv"))
+    srv = Server(tmp)
+    sock_path = os.path.join(tmp, "admin.sock")
+    with open(srv.config_path) as f:
+        cfg = f.read()
+    cfg = cfg.replace(f'api_bind_addr = "127.0.0.1:{srv.admin_port}"',
+                      f'api_bind_addr = "{sock_path}"', 1)
+    # the [s3_api] section also matches api_bind_addr; replace only the
+    # admin one (it appears after admin_token's section header)
+    assert f'api_bind_addr = "{sock_path}"' in cfg
+    with open(srv.config_path, "w") as f:
+        f.write(cfg)
+    srv.start()
+    try:
+        assert stat.S_IMODE(os.stat(sock_path).st_mode) == 0o222
+
+        class UConn(http.client.HTTPConnection):
+            def connect(self):
+                self.sock = socket.socket(socket.AF_UNIX,
+                                          socket.SOCK_STREAM)
+                self.sock.connect(sock_path)
+
+        c = UConn("localhost")
+        c.request("GET", "/health")
+        r = c.getresponse()
+        assert r.status in (200, 503)
+        assert r.read()  # health text body over the UDS transport
+    finally:
+        srv.stop()
