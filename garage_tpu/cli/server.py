@@ -34,6 +34,11 @@ async def run_server(cfg_path: str) -> None:
     lock_fd = lockfile.acquire(cfg.metadata_dir, "server")
     garage = Garage(cfg)
     admin = AdminRpcHandler(garage)
+    otlp = None
+    if cfg.admin_trace_sink:
+        from ..utils.otlp import setup_otlp
+
+        otlp = setup_otlp(cfg.admin_trace_sink, garage.system.id)
     stop = asyncio.Event()
 
     loop = asyncio.get_event_loop()
@@ -87,6 +92,8 @@ async def run_server(cfg_path: str) -> None:
         await s.stop()
     await garage.stop()
     system_task.cancel()
+    if otlp is not None:
+        otlp.stop()
     lockfile.release(lock_fd)
 
 

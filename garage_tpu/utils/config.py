@@ -72,6 +72,9 @@ class Config:
     admin_token_file: Optional[str] = None
     metrics_token: Optional[str] = None
     metrics_token_file: Optional[str] = None
+    # [admin] trace_sink: OTLP/HTTP collector base URL (ref:
+    # config.rs admin.trace_sink + garage/tracing_setup.rs)
+    admin_trace_sink: Optional[str] = None
     web_bind_addr: Optional[str] = None
     web_root_domain: str = ".web.garage"
 

@@ -49,6 +49,9 @@ class Tracer:
         self._buf: list[str] = []
         self._lock = threading.Lock()
         self.ring: deque = deque(maxlen=RING_MAX)
+        # extra span consumers (e.g. the OTLP exporter, utils/otlp.py);
+        # each gets every finished span record and must not block
+        self.sinks: list = []
 
     def enable(self, path: Optional[str] = None) -> None:
         self.enabled = True
@@ -93,6 +96,8 @@ class Tracer:
 
     def emit(self, rec: dict) -> None:
         self.ring.append(rec)
+        for sink in self.sinks:
+            sink(rec)
         if self._path is None:
             return
         # buffer; one write() per _FLUSH_EVERY spans keeps the export

@@ -115,3 +115,85 @@ def test_jsonl_export(tmp_path, ring_tracer):
 
     lines = [json.loads(line) for line in open(path)]
     assert any(r["name"] == "exported" for r in lines)
+
+
+def test_otlp_export_to_local_collector(tmp_path):
+    """Spans ship to an OTLP/HTTP collector as valid OTLP JSON with
+    wire-width ids (ref: garage/tracing_setup.rs init_tracing)."""
+    import http.server
+    import json
+    import threading
+
+    from garage_tpu.utils import otlp as otlp_mod
+    from garage_tpu.utils.otlp import OtlpExporter
+    from garage_tpu.utils.tracing import span, tracer
+
+    received = []
+
+    class Sink(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            received.append((self.path, json.loads(body)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Sink)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        exp = OtlpExporter(f"http://127.0.0.1:{srv.server_port}",
+                           "0011223344556677").start()
+        was_enabled = tracer.enabled
+        tracer.sinks.append(exp.sink)
+        tracer.enabled = True
+        try:
+            with span("otlp.parent", table="objtest"):
+                with span("otlp.child", size=123):
+                    pass
+                with span("otlp.bad"):
+                    try:
+                        raise ValueError("boom")
+                    except ValueError:
+                        pass
+        finally:
+            tracer.enabled = was_enabled
+            tracer.sinks.remove(exp.sink)
+        exp.stop()
+        assert exp.sent_spans == 3 and exp.failed_posts == 0
+        path, payload = received[0]
+        assert path == "/v1/traces"
+        rs = payload["resourceSpans"][0]
+        res_attrs = {a["key"]: a["value"] for a in
+                     rs["resource"]["attributes"]}
+        assert res_attrs["service.name"]["stringValue"] == "garage"
+        assert res_attrs["service.instance.id"]["stringValue"] \
+            == "0011223344556677"
+        spans = rs["scopeSpans"][0]["spans"]
+        by_name = {s["name"]: s for s in spans}
+        assert set(by_name) == {"otlp.parent", "otlp.child", "otlp.bad"}
+        parent = by_name["otlp.parent"]
+        child = by_name["otlp.child"]
+        assert len(parent["traceId"]) == 32 and len(parent["spanId"]) == 16
+        assert child["traceId"] == parent["traceId"]
+        assert child["parentSpanId"] == parent["spanId"]
+        assert int(child["endTimeUnixNano"]) >= int(
+            child["startTimeUnixNano"])
+        attrs = {a["key"]: a["value"] for a in child["attributes"]}
+        assert attrs["size"]["intValue"] == "123"
+    finally:
+        srv.shutdown()
+
+
+def test_otlp_collector_down_never_blocks(tmp_path):
+    """A dead collector drops spans; emit() and stop() stay cheap."""
+    from garage_tpu.utils.otlp import OtlpExporter
+
+    exp = OtlpExporter("http://127.0.0.1:9", "00").start()  # discard port
+    for i in range(10):
+        exp.sink({"trace": "ab", "span": "cd", "parent": None,
+                  "name": f"s{i}", "start_us": 1, "dur_us": 1})
+    exp.stop()
+    assert exp.sent_spans == 0 and exp.failed_posts >= 1
