@@ -536,7 +536,10 @@ def main() -> None:
     if platform == "cpu":
         maybe_reexec_on_device()
 
-    nblocks = 16 if platform == "cpu" else 128
+    # cpu fallback: enough blocks that the scrub segment measures
+    # hundreds of ms, not page-cache noise (r5: 16-block scrub numbers
+    # swung 4× between runs)
+    nblocks = 48 if platform == "cpu" else 128
     base = "/dev/shm" if os.path.isdir("/dev/shm") else None
 
     def run_segment(tag, device_mode, erasure, nb):
@@ -550,9 +553,27 @@ def main() -> None:
         finally:
             shutil.rmtree(tmp, ignore_errors=True)
 
-    # main segment: erasure(4,2), feeder auto-calibrated
+    # main segment: erasure(4,2), feeder auto-calibrated. Run TWICE,
+    # interleaved with the cpu-baseline segment below, and keep each
+    # segment's best: identical back-to-back runs on this co-tenant
+    # box have measured 40 vs 530 scrub blocks/s, so single samples
+    # (and especially single-sample RATIOS) are meaningless.
+    def best_of(a: dict, b: dict) -> dict:
+        if "error" in a:
+            return b
+        if "error" in b:
+            return a
+        out = dict(a)
+        for k, v in b.items():
+            if isinstance(v, (int, float)) and isinstance(a.get(k), (int, float)):
+                out[k] = max(a[k], v)
+        return out
+
     seg = run_segment("main", "auto" if platform != "cpu" else "off",
                       True, nblocks)
+    cpu_seg = run_segment("cpu", "off", False, nblocks)
+    seg = best_of(seg, run_segment(
+        "main2", "auto" if platform != "cpu" else "off", True, nblocks))
     extra.update({k: v for k, v in seg.items() if k != "error"})
     if "error" in seg:
         extra["put_error"] = seg["error"]
@@ -591,8 +612,10 @@ def main() -> None:
             extra["s3_device_error"] = f"{type(e).__name__}: {e}"[:300]
 
     # CPU baseline segment: replicate-3 whole blocks, host only
-    # (BASELINE.md rows 1/5: the reference's strategy on the host path)
-    seg = run_segment("cpu", "off", False, nblocks)
+    # (BASELINE.md rows 1/5: the reference's strategy on the host
+    # path). Second leg of the interleave; best of both.
+    cpu_seg = best_of(cpu_seg, run_segment("cpu2", "off", False, nblocks))
+    seg = cpu_seg
     if "error" in seg:
         extra["cpu_put_error"] = seg["error"]
     else:
