@@ -12,7 +12,7 @@ Local files (under the DataLayout path scheme):
   shards:        {hex}.s{i}        content = shard file (len+checksum hdr)
 
 RPC ops on endpoint "garage_tpu/block":
-  {op: "put", hash, part|None, data}      part=None -> whole block
+  {op: "put", hash, part|None, comp?, data}  part=None -> whole block (comp present: data = bare payload; absent: packed)
   {op: "get", hash, part|None}
   {op: "need", hash}                      -> {needed: bool}
 """
@@ -262,17 +262,23 @@ class BlockManager:
                                             bytes([blk.compression]),
                                             blk.bytes)
                 else:
-                    await self._put_replicate(hash32, blk.pack())
+                    # scheme byte travels as its own field: the
+                    # megabyte payload is never concat-copied into a
+                    # packed buffer (same trick as the erasure prefix)
+                    await self._put_replicate(hash32, blk.compression,
+                                              blk.bytes)
         finally:
             self._ram_sem.release(len(data))
 
-    async def _put_replicate(self, hash32: bytes, packed: bytes) -> None:
+    async def _put_replicate(self, hash32: bytes, comp: int,
+                             payload: bytes) -> None:
         helper = self.system.layout_helper
         with helper.write_lock():
             sets = helper.write_sets_of(hash32)
             await self.rpc.try_write_many_sets(
                 self.endpoint, sets,
-                {"op": "put", "hash": hash32, "part": None, "data": packed},
+                {"op": "put", "hash": hash32, "part": None, "comp": comp,
+                 "data": payload},
                 RequestStrategy(quorum=self.codec.write_quorum,
                                 prio=PRIO_NORMAL,
                                 timeout=60.0),
@@ -466,16 +472,22 @@ class BlockManager:
         self.metrics["bytes_written"] += len(content)
 
     def write_local(self, hash32: bytes, packed: bytes) -> None:
-        """Store a whole packed DataBlock. The payload is written as a
-        memoryview slice past the 1-byte scheme header — no copy of the
-        megabyte body (DataBlock.unpack would make one)."""
+        """Store a whole packed DataBlock (1-byte scheme + payload)."""
+        self.write_local_payload(hash32, packed[0],
+                                 memoryview(packed)[1:])
+
+    def write_local_payload(self, hash32: bytes, comp: int,
+                            payload) -> None:
+        """Store a whole block from (scheme, payload) — the zero-copy
+        form the "put" RPC carries (the payload is never concat-copied
+        behind a packed header byte)."""
         from .block import SUFFIX_OF
 
-        suffix = SUFFIX_OF.get(packed[0])
+        suffix = SUFFIX_OF.get(comp)
         if suffix is None:
             raise CorruptData(hash32)
         path = self.data_layout.block_path(hash32, suffix)
-        self._write_file(path, memoryview(packed)[1:])
+        self._write_file(path, payload)
         # drop other-compression variants if present (ref: manager.rs
         # write_block replaces regardless of compression state)
         for sfx in BLOCK_SUFFIXES:
@@ -675,7 +687,13 @@ class BlockManager:
         if op == "put":
             part = payload.get("part")
             if part is None:
-                await asyncio.to_thread(self.write_local, h, payload["data"])
+                comp = payload.get("comp")
+                if comp is not None:
+                    await asyncio.to_thread(self.write_local_payload, h,
+                                            comp, payload["data"])
+                else:  # legacy packed form (resync push path)
+                    await asyncio.to_thread(self.write_local, h,
+                                            payload["data"])
             else:
                 await asyncio.to_thread(self.write_local_shard, h, part,
                                         payload["data"])
