@@ -279,7 +279,10 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
     offset = 0
     first_hash = None
     block = first_block
-    queued_keys: set[bytes] = set()  # rows THIS request enqueued
+    # rows THIS request enqueued, per table: the targeted flush
+    # probes only keys that can exist in that table's queue
+    queued_vkeys: set[bytes] = set()
+    queued_bkeys: set[bytes] = set()
 
     async def put_one(blk: bytes, off: int, plain_len: int, h: bytes):
         from ...utils.tracing import span
@@ -297,10 +300,12 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
             # read-your-writes is preserved
             from ...table.table import queue_insert_local_many
 
-            queued_keys.update(queue_insert_local_many([
+            vk, bk = queue_insert_local_many([
                 (garage.version_table, v),
                 (garage.block_ref_table, BlockRef.new(h, version.uuid)),
-            ]))
+            ])
+            queued_vkeys.add(vk)
+            queued_bkeys.add(bk)
             await garage.block_manager.rpc_put_block(
                 h, blk, compress=False if sse_key is not None else None)
 
@@ -363,8 +368,8 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
         # quorum-visible before the caller's Complete insert
         # (read-your-writes); other requests' backlog is theirs to flush
         async with span("s3.put.flush_meta"):
-            await garage.version_table.flush_insert_queue(queued_keys)
-            await garage.block_ref_table.flush_insert_queue(queued_keys)
+            await garage.version_table.flush_insert_queue(queued_vkeys)
+            await garage.block_ref_table.flush_insert_queue(queued_bkeys)
     except BaseException:
         for t in tasks:
             t.cancel()
@@ -382,8 +387,8 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
         # Exception) must not reopen that ordering hazard — the flush
         # finishes in the background while we proceed to the tombstone.
         async def _flush_both():
-            await garage.version_table.flush_insert_queue(queued_keys)
-            await garage.block_ref_table.flush_insert_queue(queued_keys)
+            await garage.version_table.flush_insert_queue(queued_vkeys)
+            await garage.block_ref_table.flush_insert_queue(queued_bkeys)
 
         flush = asyncio.ensure_future(_flush_both())
         # keep re-awaiting until the flush actually lands: returning

@@ -151,24 +151,49 @@ class MerkleUpdater(Worker):
         self.data.db.transaction(
             lambda tx: self._apply_one(tx, row_key, new_hash))
 
-    def _apply_one(self, tx, row_key: bytes, new_hash: bytes) -> None:
+    def _apply_one(self, tx, row_key: bytes, new_hash: bytes,
+                   cache: Optional[dict] = None) -> None:
         partition = self._partition_of_row(row_key)
         khash = blake2sum(row_key)
         self._update_rec(tx, partition, b"", row_key, khash,
-                         new_hash if new_hash else None)
+                         new_hash if new_hash else None, cache)
         # only clear the todo entry if it hasn't changed since we
         # read it (a concurrent write may have requeued the row)
         cur = tx.get(self.data.merkle_todo, row_key)
         if cur == (new_hash if new_hash else b""):
             tx.remove(self.data.merkle_todo, row_key)
 
+    # ---- node access with an optional per-transaction cache: a batch
+    # of todo rows re-walks the same top trie nodes (root + first
+    # levels) for every row; caching raw node bytes inside the tx
+    # removes those repeated SELECT/INSERT round trips ---------------
+
+    def _nget(self, tx, cache, k: bytes):
+        if cache is not None and k in cache:
+            return cache[k]
+        raw = tx.get(self.data.merkle_tree, k)
+        if cache is not None:
+            cache[k] = raw
+        return raw
+
+    def _nput(self, tx, cache, k: bytes, raw: bytes) -> None:
+        tx.insert(self.data.merkle_tree, k, raw)
+        if cache is not None:
+            cache[k] = raw
+
+    def _ndel(self, tx, cache, k: bytes) -> None:
+        tx.remove(self.data.merkle_tree, k)
+        if cache is not None:
+            cache[k] = None
+
     def _update_rec(self, tx, partition: int, prefix: bytes, row_key: bytes,
-                    khash: bytes, new_vhash: Optional[bytes]) -> Optional[bytes]:
+                    khash: bytes, new_vhash: Optional[bytes],
+                    cache: Optional[dict] = None) -> Optional[bytes]:
         """Returns the node's new hash (EMPTY_HASH if it vanished), or
         None if the subtree was unchanged. ref: merkle.rs:131-247."""
         i = len(prefix)
         k = node_key(partition, prefix)
-        node = MerkleNode.unpack(tx.get(self.data.merkle_tree, k))
+        node = MerkleNode.unpack(self._nget(tx, cache, k))
         mutate: Optional[MerkleNode]
 
         if node.kind == EMPTY:
@@ -176,7 +201,7 @@ class MerkleUpdater(Worker):
         elif node.kind == INTERMEDIATE:
             byte = khash[i]
             sub = self._update_rec(tx, partition, prefix + bytes([byte]),
-                                   row_key, khash, new_vhash)
+                                   row_key, khash, new_vhash, cache)
             if sub is None:
                 mutate = None
             else:
@@ -188,9 +213,9 @@ class MerkleUpdater(Worker):
                     # (canonical shape; ref: merkle.rs:164-183)
                     cb = node.children[0][0]
                     ck = node_key(partition, prefix + bytes([cb]))
-                    child = MerkleNode.unpack(tx.get(self.data.merkle_tree, ck))
+                    child = MerkleNode.unpack(self._nget(tx, cache, ck))
                     if child.kind == LEAF:
-                        tx.remove(self.data.merkle_tree, ck)
+                        self._ndel(tx, cache, ck)
                         mutate = child
                     else:
                         mutate = node
@@ -213,19 +238,19 @@ class MerkleUpdater(Worker):
                 exkhash = blake2sum(exk)
                 sub1 = self._update_rec(tx, partition,
                                         prefix + bytes([exkhash[i]]),
-                                        exk, exkhash, node.hash)
+                                        exk, exkhash, node.hash, cache)
                 inter = MerkleNode.intermediate([(exkhash[i], sub1)])
                 sub2 = self._update_rec(tx, partition,
                                         prefix + bytes([khash[i]]),
-                                        row_key, khash, new_vhash)
+                                        row_key, khash, new_vhash, cache)
                 mutate = inter.with_child(khash[i], sub2)
 
         if mutate is None:
             return None
         if mutate.is_empty():
-            tx.remove(self.data.merkle_tree, k)
+            self._ndel(tx, cache, k)
             return EMPTY_HASH
-        tx.insert(self.data.merkle_tree, k, mutate.pack())
+        self._nput(tx, cache, k, mutate.pack())
         return mutate.node_hash()
 
     # ---- worker loop ---------------------------------------------------
@@ -245,8 +270,10 @@ class MerkleUpdater(Worker):
 
         def apply(rows):
             def body(tx):
+                cache: dict = {}  # per-tx node cache: rows share the
+                # top trie levels, so each batch re-reads them once
                 for k, v in rows:
-                    self._apply_one(tx, k, v)
+                    self._apply_one(tx, k, v, cache)
 
             self.data.db.transaction(body)
 
