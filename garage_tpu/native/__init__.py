@@ -73,7 +73,7 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
     ]
-    lib.crc32c_update.argtypes = [ctypes.c_uint32, ctypes.c_char_p,
+    lib.crc32c_update.argtypes = [ctypes.c_uint32, ctypes.c_void_p,
                                   ctypes.c_uint64]
     lib.crc32c_update.restype = ctypes.c_uint32
     lib.crc64nvme_update.argtypes = [ctypes.c_uint64, ctypes.c_char_p,
@@ -265,11 +265,17 @@ def crc64nvme_py(data: bytes, crc: int = 0) -> int:
     return crc ^ 0xFFFFFFFFFFFFFFFF
 
 
-def crc32c(data: bytes, crc: int = 0) -> int:
+def crc32c(data, crc: int = 0) -> int:
+    """Accepts bytes OR any buffer (memoryview over a shard payload —
+    the validate path checksums without copying)."""
     lib = _get()
     if lib is None:
         raise RuntimeError("native library unavailable")
-    return lib.crc32c_update(crc, data, len(data))
+    if isinstance(data, (bytes, bytearray)):
+        return lib.crc32c_update(crc, data, len(data))
+    a = np.frombuffer(data, dtype=np.uint8)
+    return lib.crc32c_update(crc, a.ctypes.data if len(a) else None,
+                             len(a))
 
 
 def crc64nvme(data: bytes, crc: int = 0) -> int:
