@@ -301,10 +301,10 @@ class BlockManager:
 
         async with span("block.encode", size=len(data)):
             payloads = await self.feeder.encode_put(data, prefix=prefix)
-        # materialize once: msgpack needs bytes, and doing it in
-        # make_call would re-copy the shard on every retry
-        payloads = [p if isinstance(p, bytes) else bytes(p)
-                    for p in payloads]
+        # shard payloads stay memoryviews over the encoder's one output
+        # buffer: split_blob hoists them out of the dict before msgpack
+        # (never serialized), self-calls hand them to validate/write
+        # directly, and remote sends scatter them as raw blob sections
         helper = self.system.layout_helper
         with helper.write_lock():
             # One shard placement per live layout version, mirroring
