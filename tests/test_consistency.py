@@ -488,3 +488,104 @@ def test_layout_transition_write_storm(tmp_path):
             await stop_all(garages, tasks)
 
     run(main())
+
+
+def test_erasure_layout_transition_shard_migration(tmp_path):
+    """Erasure(4,2) + layout transition under write load: 7 nodes, six
+    storage + one gateway; mid-PUT-storm the gateway is ADDED to the
+    layout and a storage node REMOVED (one apply — the write path must
+    satisfy a shard-placement quorum under EVERY live layout version,
+    manager._write_shard_sets). After heal/resync, every acked block
+    is fully placed on the v2 assignment and readable from every
+    current node — including with the removed node stopped AND
+    partitioned off (gather-any-k against the new placement only)."""
+    async def main():
+        from garage_tpu.model.s3 import BlockRef
+        from garage_tpu.rpc.layout import NodeRole
+        from garage_tpu.rpc.layout.version import partition_of
+        from garage_tpu.utils.data import blake3sum
+
+        rng = random.Random(4242)
+        net, garages, tasks = await make_garage_cluster(
+            tmp_path, n=7, rf=3, erasure=(4, 2), storage=list(range(6)))
+        try:
+            blocks = {}
+            stop_w = asyncio.Event()
+
+            async def writer(wid):
+                i = 0
+                while not stop_w.is_set():
+                    data = bytes([wid, i & 0xFF]) * (3000 + 131 * (i % 7))
+                    h = blake3sum(data)
+                    g = garages[rng.randrange(7)]
+                    try:
+                        await g.block_manager.rpc_put_block(h, data)
+                        await g.block_ref_table.insert(
+                            BlockRef.new(h, gen_uuid()))
+                        blocks[h] = data  # acked
+                    except Exception:
+                        pass  # transition window quorum miss: not acked
+                    i += 1
+                    await asyncio.sleep(rng.random() * 0.01)
+
+            wtasks = [asyncio.create_task(writer(w)) for w in range(3)]
+            await asyncio.sleep(0.4)  # storm against layout v1
+
+            lm = garages[0].system.layout_manager
+            lm.history.stage_role(garages[6].system.id,
+                                  NodeRole(zone="z1", capacity=1 << 30))
+            lm.history.stage_role(garages[1].system.id, None)
+            lm.apply_staged(None)
+            await asyncio.sleep(0.8)  # storm THROUGH the transition
+            stop_w.set()
+            await asyncio.gather(*wtasks)
+            assert len(blocks) > 10
+
+            from test_model import wait_until
+
+            assert await wait_until(lambda: all(
+                g.system.layout_manager.history.current().version == 2
+                for g in garages))
+
+            # spread block_ref rows (targeted partitions), then resync
+            # until every CURRENT node holds its v2-assigned shard
+            cur = [g for i, g in enumerate(garages) if i != 1]
+            parts = {partition_of(h) for h in blocks}
+            full = False
+            for _ in range(40):
+                for g in garages:
+                    for p in parts:
+                        for other in garages:
+                            if other.system.id == g.system.id:
+                                continue
+                            try:
+                                await g.block_ref_table.syncer \
+                                    .sync_partition_with(p, other.system.id)
+                            except Exception:
+                                pass
+                for g in cur:
+                    for h in blocks:
+                        try:
+                            await g.block_manager.resync.resync_block(h)
+                        except Exception:
+                            pass
+                full = all(
+                    not g.block_manager.is_shard_needed(h)
+                    for g in cur for h in blocks)
+                if full:
+                    break
+                await asyncio.sleep(0.1)
+            assert full, "v2 shard placement incomplete after transition"
+
+            # the removed node goes away entirely; reads must survive on
+            # the new placement alone
+            await garages[1].stop()
+            for g in cur:
+                net.partition(garages[1].system.id, g.system.id)
+            for g in cur:
+                for h, data in blocks.items():
+                    assert await g.block_manager.rpc_get_block(h) == data
+        finally:
+            await stop_all(garages, tasks)
+
+    run(main())
