@@ -183,6 +183,9 @@ class S3ApiServer:
                         self.region)
                 if "versioning" in q:
                     return bucket_handlers.handle_get_bucket_versioning()
+                if "versions" in q:
+                    return await list_handlers.handle_list_object_versions(
+                        ctx, req)
                 if "website" in q:
                     return await website_handlers.handle_get_bucket_website(
                         ctx)

@@ -305,6 +305,50 @@ async def _collect_uploads(ctx, prefix: str, resume, delimiter: str,
             return ups, sorted(prefixes), None, False
 
 
+async def handle_list_object_versions(ctx, req: Request) -> Response:
+    """GET ?versions. Buckets are unversioned (like the reference,
+    whose router parses this endpoint but never implements it —
+    router.rs:964 with no handler): every live object is exactly one
+    Version with VersionId "null" and IsLatest true, the AWS contract
+    for unversioned buckets, so version-aware clients (rclone, backup
+    tools) work against this store. Pagination mirrors ListObjects
+    (key-marker; version-id-marker is trivially satisfied at one
+    version per key)."""
+    q = req.query
+    prefix = q.get("prefix", "")
+    delimiter = q.get("delimiter", "")
+    max_keys = _page_size(q, "max-keys", lo=0)
+    key_marker = q.get("key-marker")
+    resume = ("k", key_marker) if key_marker else None
+    if max_keys == 0:
+        contents, prefixes, next_token, truncated = [], [], None, False
+    else:
+        contents, prefixes, next_token, truncated = await _collect_objects(
+            ctx, prefix, resume, delimiter, max_keys)
+    nodes = [xml("Name", ctx.bucket_name), xml("Prefix", prefix),
+             xml("MaxKeys", str(max_keys)),
+             xml("IsTruncated", "true" if truncated else "false")]
+    if key_marker:
+        nodes.append(xml("KeyMarker", key_marker))
+    if delimiter:
+        nodes.append(xml("Delimiter", delimiter))
+    if truncated and next_token is not None:
+        nodes.append(xml("NextKeyMarker", next_token[1]))
+        nodes.append(xml("NextVersionIdMarker", "null"))
+    for key, v in contents:
+        nodes.append(xml("Version",
+                         xml("Key", key),
+                         xml("VersionId", "null"),
+                         xml("IsLatest", "true"),
+                         xml("LastModified", _iso(v.timestamp)),
+                         xml("ETag", f'"{v.state.data.meta.etag}"'),
+                         xml("Size", str(v.state.data.meta.size)),
+                         xml("StorageClass", "STANDARD")))
+    for cp in prefixes:
+        nodes.append(xml("CommonPrefixes", xml("Prefix", cp)))
+    return xml_response(xml("ListVersionsResult", *nodes))
+
+
 async def handle_list_multipart_uploads(ctx, req: Request) -> Response:
     """ref: list.rs:169-265 handle_list_multipart_upload. Markers:
     key-marker alone starts after that key; with upload-id-marker it

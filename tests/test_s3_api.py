@@ -2100,3 +2100,32 @@ def test_unix_socket_admin_bind(tmp_path_factory):
         assert r.read()  # health text body over the UDS transport
     finally:
         srv.stop()
+
+
+def test_list_object_versions(client, listing_bucket):
+    """GET ?versions: unversioned-bucket contract — one Version per
+    key, VersionId null, IsLatest true; pagination + delimiter work."""
+    st, _, body = client.request("GET", "/listing",
+                                 query=[("versions", "")])
+    assert st == 200
+    assert b"<ListVersionsResult" in body
+    keys = xml_find(body, "Key")
+    assert keys == sorted(keys) and "c" in keys
+    assert set(xml_find(body, "VersionId")) == {"null"}
+    assert set(xml_find(body, "IsLatest")) == {"true"}
+    # delimiter folding
+    st, _, body = client.request(
+        "GET", "/listing", query=[("versions", ""), ("delimiter", "/")])
+    assert "a/" in xml_find(body, "Prefix")
+    assert xml_find(body, "Key") == ["c"]
+    # pagination via key-marker
+    st, _, body = client.request(
+        "GET", "/listing", query=[("versions", ""), ("max-keys", "2")])
+    assert xml_find(body, "IsTruncated")[0] == "true"
+    marker = xml_find(body, "NextKeyMarker")[0]
+    got = xml_find(body, "Key")
+    st, _, body = client.request(
+        "GET", "/listing",
+        query=[("versions", ""), ("key-marker", marker)])
+    got += xml_find(body, "Key")
+    assert got == sorted(set(got)) and len(got) == 6
