@@ -170,8 +170,24 @@ class S3ApiServer:
 
     # ---- router (ref: router.rs:20-1109) -------------------------------
 
+    # subresources the reference's router recognizes but neither it nor
+    # this build implements: answer 501 NotImplemented like the
+    # reference (api_server.rs:66,332) instead of silently falling
+    # through to GetObject/ListObjects with the wrong response shape
+    _UNIMPLEMENTED_SUBRESOURCES = frozenset((
+        "tagging", "acl", "policy", "policyStatus", "replication",
+        "encryption", "notification", "accelerate", "requestPayment",
+        "logging", "ownershipControls", "publicAccessBlock",
+        "intelligent-tiering", "inventory", "metrics", "analytics",
+        "object-lock", "legal-hold", "retention", "torrent", "restore",
+        "select", "attributes",
+    ))
+
     async def _route(self, req: Request, ctx: ReqCtx) -> Response:
         m, q = req.method, req.query
+        for sub in self._UNIMPLEMENTED_SUBRESOURCES:
+            if sub in q:
+                raise S3Error("NotImplemented", 501, sub)
         if ctx.key is None:
             # bucket-level ops
             if m in ("GET", "HEAD"):

@@ -2129,3 +2129,21 @@ def test_list_object_versions(client, listing_bucket):
         query=[("versions", ""), ("key-marker", marker)])
     got += xml_find(body, "Key")
     assert got == sorted(set(got)) and len(got) == 6
+
+
+def test_unimplemented_subresources_501(client):
+    """Recognized-but-unimplemented subresources answer NotImplemented
+    like the reference (api_server.rs:66), never a misshaped fallback
+    GetObject/ListObjects response."""
+    client.request("PUT", "/conformance/subres", body=b"x")
+    for path, query in (("/conformance", "tagging"),
+                        ("/conformance", "policy"),
+                        ("/conformance/subres", "tagging"),
+                        ("/conformance/subres", "acl"),
+                        ("/conformance/subres", "torrent")):
+        st, _, body = client.request("GET", path, query=[(query, "")])
+        assert st == 501, (path, query, st)
+        assert xml_error_code(body) == "NotImplemented"
+    st, _, _ = client.request("PUT", "/conformance/subres",
+                              query=[("tagging", "")], body=b"<t/>")
+    assert st == 501
