@@ -425,3 +425,33 @@ def test_feeder_hash_md5_batches_and_device_route():
     assert stats["items"] >= 1  # rode the queue, not the inline path
     stats = run(drive("require"))  # device route, cpu jax backend
     assert stats["device_items"] >= 4
+
+
+def test_feeder_stop_mid_gather_window_resolves_waiters():
+    """Cancelling the dispatcher while it sits in the hash_md5
+    lane-gather wait must fail the already-dequeued items' futures
+    (r5 review finding: they were stranded and PUT streams hung)."""
+    from garage_tpu import native
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("no native toolchain")
+
+    async def go():
+        f = DeviceFeeder(mode="off")
+        f.active_streams = 4  # force the gather window on first item
+        acc = native.Md5()
+        task = asyncio.create_task(
+            f.hash_with_md5(os.urandom(2048), acc))
+        # let the dispatcher dequeue the item and enter the window
+        await asyncio.sleep(0.002)
+        await f.stop()
+        try:
+            await asyncio.wait_for(task, 2.0)
+        except RuntimeError as e:
+            assert "feeder stopped" in str(e)
+        except asyncio.TimeoutError:
+            raise AssertionError("hash_with_md5 waiter stranded")
+
+    run(go())
