@@ -617,10 +617,15 @@ class DeviceFeeder:
             from .. import native
 
             if backend == "device":
-                # MD5 chains batch-advance host-side (8-way across
-                # items); the content hash batches to the device
-                native.md5_update_many(blobs)
-                return self._do_hash([d for _, d in blobs], backend)
+                # content hash batches to the device FIRST: if it
+                # raises (dead tunnel), the host retry re-runs this op
+                # from scratch, and MD5 state must not have advanced
+                # yet or the retry double-counts the bytes into the
+                # ETag chain. Only then batch-advance the MD5s host-
+                # side (8-way across items).
+                out = self._do_hash([d for _, d in blobs], backend)
+                native.md5_update_many(list(blobs))
+                return out
             return native.b3_md5_many(list(blobs))
         if op == "verify":
             digs = self._do_hash([b for _, b in blobs], backend)

@@ -99,7 +99,10 @@ class OtlpExporter:
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
-        self._q.put(None)  # wake the drain loop
+        try:
+            self._q.put_nowait(None)  # wake the drain loop
+        except queue.Full:
+            pass  # the drain loop's flush tick notices _stop itself
         self._thread.join(timeout)
 
     # ---- consumer ------------------------------------------------------

@@ -455,3 +455,48 @@ def test_feeder_stop_mid_gather_window_resolves_waiters():
             raise AssertionError("hash_with_md5 waiter stranded")
 
     run(go())
+
+
+def test_feeder_hash_md5_device_failure_fallback_etag_correct():
+    """A failing device hash must NOT have advanced the MD5 states
+    before the host retry re-runs the op — the retry would otherwise
+    double-count every byte into the ETag chain (r5 audit bug)."""
+    import hashlib
+
+    from garage_tpu import native
+    from garage_tpu.utils.data import blake3sum
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("no native toolchain")
+
+    async def go():
+        f = DeviceFeeder(mode="require")
+        f._device_ok = True  # skip real probe; fake device below
+        f.active_streams = 2
+        orig = f._do_hash
+        calls = {"n": 0}
+
+        def flaky(blobs, backend):
+            if backend == "device":
+                calls["n"] += 1
+                raise RuntimeError("tunnel died")
+            return orig(blobs, backend)
+
+        f._do_hash = flaky
+        accs = [native.Md5(), native.Md5()]
+        refs = [hashlib.md5(), hashlib.md5()]
+        blobs = [os.urandom(2048), os.urandom(4096)]
+        digs = await asyncio.gather(*[
+            f.hash_with_md5(b, a) for b, a in zip(blobs, accs)])
+        for r, b in zip(refs, blobs):
+            r.update(b)
+        assert calls["n"] >= 1  # the device leg really ran and failed
+        assert list(digs) == [blake3sum(b) for b in blobs]
+        # the load-bearing assert: ETag chains advanced exactly once
+        assert [a.hexdigest() for a in accs] == \
+            [r.hexdigest() for r in refs]
+        await f.stop()
+
+    run(go())
