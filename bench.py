@@ -415,6 +415,48 @@ def bench_s3_put(nobj: int, obj_mib: int = 4, device: bool = False) -> dict:
                 best_get = max(best_get, nobj * size / dt / 1e9)
         out = {"s3_put_gbps": round(best_put, 3),
                "s3_get_gbps": round(best_get, 3)}
+        if not device:
+            # multipart leg (BASELINE rows 3/4: big-part uploads):
+            # 4 concurrent 8 MiB UploadParts + Complete, best of 2
+            import xml.etree.ElementTree as ET
+
+            part_mib, nparts = 8, 4
+            pdata = np.random.default_rng(9).integers(
+                0, 256, part_mib << 20, dtype=np.uint8).tobytes()
+            best_mpu = 0.0
+            for rep in range(2):
+                st, _, b = cli.request("POST", f"/bench/mpu{rep}",
+                                       query=[("uploads", "")])
+                assert st == 200, b[:200]
+                upload_id = ET.fromstring(b).findtext(
+                    "{*}UploadId") or ET.fromstring(b).findtext("UploadId")
+
+                def put_part(pn):
+                    st, hdrs, b2 = cli.request(
+                        "PUT", f"/bench/mpu{rep}",
+                        query=[("partNumber", str(pn)),
+                               ("uploadId", upload_id)],
+                        body=pdata, unsigned_payload=True)
+                    assert st == 200, b2[:200]
+                    return pn, dict(hdrs)["etag"].strip('"')
+
+                t0 = time.perf_counter()
+                with concurrent.futures.ThreadPoolExecutor(4) as pool:
+                    etags = dict(pool.map(put_part, range(1, nparts + 1)))
+                xml_parts = "".join(
+                    f"<Part><PartNumber>{pn}</PartNumber>"
+                    f"<ETag>\"{etags[pn]}\"</ETag></Part>"
+                    for pn in sorted(etags))
+                st, _, b = cli.request(
+                    "POST", f"/bench/mpu{rep}",
+                    query=[("uploadId", upload_id)],
+                    body=(f"<CompleteMultipartUpload>{xml_parts}"
+                          f"</CompleteMultipartUpload>").encode())
+                assert st == 200, b[:300]
+                dt = time.perf_counter() - t0
+                best_mpu = max(best_mpu,
+                               nparts * (part_mib << 20) / dt / 1e9)
+            out["s3_multipart_put_gbps"] = round(best_mpu, 3)
         if device:
             # scrape the LIVE server's feeder counters before stopping
             with urllib.request.urlopen(
