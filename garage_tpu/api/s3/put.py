@@ -490,6 +490,13 @@ async def handle_copy(ctx, req: Request) -> Response:
             "source object is SSE-C encrypted; "
             "x-amz-copy-source-server-side-encryption-customer-* "
             "headers are required")
+    # x-amz-metadata-directive: REPLACE takes the request's metadata
+    # instead of the source's (ref: copy.rs:83-90) — the canonical
+    # "update an object's metadata" operation is a self-copy with
+    # REPLACE
+    replace_meta = (req.header("x-amz-metadata-directive") or "") \
+        .upper() == "REPLACE"
+
     if src_sse_hdr is not None or dst_sse is not None:
         # encryption boundary crossing: stream the source plaintext
         # through the normal save path, re-encrypting under the
@@ -500,8 +507,9 @@ async def handle_copy(ctx, req: Request) -> Response:
 
         source = await open_object_stream(helper_g, src_v, 0,
                                           src_meta.size, src_sse)
-        headers = {k: v for k, v in src_meta.headers.items()
-                   if not k.startswith("x-garage-ssec-")}
+        headers = (extract_metadata_headers(req) if replace_meta
+                   else {k: v for k, v in src_meta.headers.items()
+                         if not k.startswith("x-garage-ssec-")})
         uuid, ts, etag, _ = await save_stream(
             helper_g, ctx.bucket_id, ctx.key, headers, source,
             sse_key=dst_sse, content_length=src_meta.size,
@@ -517,9 +525,12 @@ async def handle_copy(ctx, req: Request) -> Response:
     uuid = gen_uuid()
     ts = now_msec()
     data = src_v.state.data
+    meta = (ObjectVersionMeta(extract_metadata_headers(req),
+                              data.meta.size, data.meta.etag)
+            if replace_meta else data.meta)
     if data.kind == "inline":
         ov = ObjectVersion(uuid, ts, ObjectVersionState.complete(
-            ObjectVersionData.inline(data.meta, data.blob)))
+            ObjectVersionData.inline(meta, data.blob)))
         await helper_g.object_table.insert(
             Object(ctx.bucket_id, ctx.key, [ov]))
     else:
@@ -541,7 +552,7 @@ async def handle_copy(ctx, req: Request) -> Response:
             await helper_g.block_ref_table.insert(BlockRef.new(h, uuid))
         done = Object(ctx.bucket_id, ctx.key, [ObjectVersion(
             uuid, ts, ObjectVersionState.complete(
-                ObjectVersionData.first_block(data.meta, data.blob)))])
+                ObjectVersionData.first_block(meta, data.blob)))])
         await helper_g.object_table.insert(done)
 
     from .xml import xml, xml_response

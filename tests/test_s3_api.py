@@ -2147,3 +2147,36 @@ def test_unimplemented_subresources_501(client):
     st, _, _ = client.request("PUT", "/conformance/subres",
                               query=[("tagging", "")], body=b"<t/>")
     assert st == 501
+
+
+def test_copy_metadata_directive(client):
+    """x-amz-metadata-directive: REPLACE takes the request's metadata
+    (the self-copy metadata-update idiom); default COPY carries the
+    source's (ref: copy.rs:83-90)."""
+    client.request("PUT", "/conformance/md-src", body=b"payload" * 100,
+                   headers={"content-type": "text/plain",
+                            "x-amz-meta-alpha": "one"})
+    # default: metadata copied
+    st, _, _ = client.request(
+        "PUT", "/conformance/md-dst",
+        headers={"x-amz-copy-source": "/conformance/md-src",
+                 "x-amz-meta-alpha": "IGNORED"})
+    assert st == 200
+    st, hdrs, _ = client.request("HEAD", "/conformance/md-dst")
+    h = dict(hdrs)
+    assert h.get("x-amz-meta-alpha") == "one"
+    assert h.get("content-type") == "text/plain"
+    # REPLACE: request metadata wins; self-copy updates in place
+    st, _, _ = client.request(
+        "PUT", "/conformance/md-src",
+        headers={"x-amz-copy-source": "/conformance/md-src",
+                 "x-amz-metadata-directive": "REPLACE",
+                 "content-type": "application/json",
+                 "x-amz-meta-beta": "two"})
+    assert st == 200
+    st, hdrs, body = client.request("GET", "/conformance/md-src")
+    h = dict(hdrs)
+    assert body == b"payload" * 100
+    assert h.get("content-type") == "application/json"
+    assert h.get("x-amz-meta-beta") == "two"
+    assert "x-amz-meta-alpha" not in h
