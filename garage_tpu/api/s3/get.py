@@ -137,6 +137,20 @@ async def handle_get(ctx, req: Request, head: bool = False) -> Response:
         return Response(304, _object_headers(v, meta))
 
     headers = _object_headers(v, meta)
+    # response-content-* query overrides (ref: get.rs:41-44,104-107;
+    # presigned-download UX: the signer picks the browser-facing
+    # content-type/disposition at sign time)
+    for qname, hname in (("response-content-type", "content-type"),
+                         ("response-content-language", "content-language"),
+                         ("response-content-encoding", "content-encoding"),
+                         ("response-content-disposition",
+                          "content-disposition"),
+                         ("response-cache-control", "cache-control"),
+                         ("response-expires", "expires")):
+        ov = req.query.get(qname)
+        if ov is not None:
+            headers = [(n, val) for n, val in headers if n != hname]
+            headers.append((hname, ov))
     if (req.header("x-amz-checksum-mode") or "").upper() == "ENABLED":
         for name, val in meta.headers.items():
             if name.startswith("x-garage-checksum-"):

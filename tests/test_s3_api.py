@@ -2180,3 +2180,24 @@ def test_copy_metadata_directive(client):
     assert h.get("content-type") == "application/json"
     assert h.get("x-amz-meta-beta") == "two"
     assert "x-amz-meta-alpha" not in h
+
+
+def test_response_header_overrides(client):
+    """response-content-* query params override the stored headers on
+    GET (ref: get.rs:104-107), including via presigned URLs."""
+    client.request("PUT", "/conformance/resp-ovr", body=b"ovr",
+                   headers={"content-type": "text/plain"})
+    st, hdrs, body = client.request(
+        "GET", "/conformance/resp-ovr",
+        query=[("response-content-type", "application/pdf"),
+               ("response-content-disposition",
+                'attachment; filename="x.pdf"'),
+               ("response-cache-control", "no-store")])
+    h = dict(hdrs)
+    assert st == 200 and body == b"ovr"
+    assert h["content-type"] == "application/pdf"
+    assert h["content-disposition"] == 'attachment; filename="x.pdf"'
+    assert h["cache-control"] == "no-store"
+    # no override -> stored value
+    st, hdrs, _ = client.request("GET", "/conformance/resp-ovr")
+    assert dict(hdrs)["content-type"] == "text/plain"
