@@ -81,6 +81,14 @@ def extract_metadata_headers(req: Request) -> dict:
     for name, v in req.headers.items():
         if name.startswith("x-amz-meta-"):
             out[name] = v
+    redir = req.header("x-amz-website-redirect-location")
+    if redir is not None:
+        # ref: put.rs:681-692 — stored as metadata; the web server
+        # serves a 301 to it
+        if not redir.startswith(("/", "http://", "https://")):
+            raise bad_request(
+                "Invalid x-amz-website-redirect-location header")
+        out["x-amz-website-redirect-location"] = redir
     return out
 
 

@@ -104,6 +104,11 @@ class WebServer:
         try:
             resp = await get_handlers.handle_get(ctx, req,
                                                  head=req.method == "HEAD")
+            for n, v in resp.headers:
+                if n == "x-amz-website-redirect-location":
+                    # object-level redirect (ref: web_server.rs:302-309)
+                    resp = Response(301, [("location", v)])
+                    break
         except S3Error as e:
             if e.code == "NoSuchKey" and may_redirect is not None:
                 redirect_key, url = may_redirect

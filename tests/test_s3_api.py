@@ -2201,3 +2201,27 @@ def test_response_header_overrides(client):
     # no override -> stored value
     st, hdrs, _ = client.request("GET", "/conformance/resp-ovr")
     assert dict(hdrs)["content-type"] == "text/plain"
+
+
+def test_website_redirect_location(server, client, website_bucket):
+    """x-amz-website-redirect-location: validated and stored on PUT,
+    echoed on S3 GET, served as a 301 by the website endpoint
+    (ref: put.rs:681-692, web_server.rs:302-309)."""
+    st, _, body = client.request(
+        "PUT", "/wsite/moved.html", body=b"",
+        headers={"x-amz-website-redirect-location": "/page.html"})
+    assert st == 200, body
+    # invalid target -> 400
+    st, _, _ = client.request(
+        "PUT", "/wsite/bad.html", body=b"",
+        headers={"x-amz-website-redirect-location": "elsewhere"})
+    assert st == 400
+    # S3 GET echoes the header with the object
+    st, hdrs, _ = client.request("GET", "/wsite/moved.html")
+    assert dict(hdrs)["x-amz-website-redirect-location"] == "/page.html"
+    # website endpoint serves a 301
+    status, headers, body = _web_get(server, website_bucket,
+                                     "/moved.html")
+    assert status == 301
+    assert dict(headers)["location"] == "/page.html"
+    assert body in (b"", None)
