@@ -16,7 +16,25 @@ from .xml import S3Error, xml, xml_response
 async def handle_create_bucket(helper, bucket_name: str, api_key,
                                region: str, req: Request) -> Response:
     """ref: bucket.rs handle_create_bucket."""
-    await req.body.drain()
+    body = await req.body.read_all(limit=1 << 16)
+    if body.strip():
+        # CreateBucketConfiguration: a LocationConstraint naming any
+        # other region is rejected (ref: bucket.rs:127-138)
+        import xml.etree.ElementTree as ET
+
+        try:
+            root = ET.fromstring(body.decode())
+        except (ET.ParseError, UnicodeDecodeError):
+            raise S3Error("MalformedXML", 400,
+                          "Invalid create bucket XML query")
+        for c in root.iter():
+            if c.tag.endswith("LocationConstraint") and c.text \
+                    and c.text.strip() and c.text.strip() != region:
+                raise S3Error(
+                    "InvalidLocationConstraint", 400,
+                    f"Cannot satisfy location constraint "
+                    f"`{c.text.strip()}`: buckets can only be created "
+                    f"in region `{region}`")
     existing = await helper.resolve_global_bucket_name(bucket_name)
     if existing is not None:
         if api_key.allow_write(existing) or api_key.allow_owner(existing):

@@ -2225,3 +2225,20 @@ def test_website_redirect_location(server, client, website_bucket):
     assert status == 301
     assert dict(headers)["location"] == "/page.html"
     assert body in (b"", None)
+
+
+def test_create_bucket_location_constraint(client):
+    """CreateBucketConfiguration: the configured region is accepted,
+    any other is a 400 (ref: bucket.rs:127-138)."""
+    ok = (b"<CreateBucketConfiguration><LocationConstraint>garage"
+          b"</LocationConstraint></CreateBucketConfiguration>")
+    st, _, body = client.request("PUT", "/locbkt", body=ok)
+    assert st == 200, body
+    bad = (b"<CreateBucketConfiguration><LocationConstraint>us-east-9"
+           b"</LocationConstraint></CreateBucketConfiguration>")
+    st, _, body = client.request("PUT", "/locbkt2", body=bad)
+    assert st == 400
+    assert xml_error_code(body) == "InvalidLocationConstraint"
+    st, _, body = client.request("PUT", "/locbkt3", body=b"not-xml")
+    assert st == 400
+    client.request("DELETE", "/locbkt")
