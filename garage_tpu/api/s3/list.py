@@ -28,6 +28,20 @@ def _dec_token(s: str) -> str:
         raise S3Error("InvalidArgument", 400, "bad continuation token")
 
 
+def _encoder(q):
+    """encoding-type=url -> (percent-encoder, True); SDKs (boto3 et
+    al.) request it by default so keys with arbitrary bytes survive
+    XML (ref: list.rs uriencode_maybe). Unknown values are a 400."""
+    enc = q.get("encoding-type")
+    if enc in (None, ""):
+        return (lambda s: s), False
+    if enc != "url":
+        raise S3Error("InvalidArgument", 400, "bad encoding-type")
+    from urllib.parse import quote
+
+    return (lambda s: quote(s, safe="/")), True
+
+
 def _page_size(q, name: str, lo: int = 1) -> int:
     """Validated page-size query param, clamped to <=1000. Values < lo
     are a 400: a 0-size page with IsTruncated=true and a non-advancing
@@ -164,24 +178,27 @@ async def handle_list_objects_v2(ctx, req: Request) -> Response:
         contents, prefixes, next_token, truncated = await _collect_objects(
             ctx, prefix, resume, delimiter, max_keys)
 
-    nodes = [xml("Name", ctx.bucket_name), xml("Prefix", prefix),
+    enc, encoded = _encoder(q)
+    nodes = [xml("Name", ctx.bucket_name), xml("Prefix", enc(prefix)),
              xml("KeyCount", str(len(contents) + len(prefixes))),
              xml("MaxKeys", str(max_keys)),
              xml("IsTruncated", "true" if truncated else "false")]
+    if encoded:
+        nodes.append(xml("EncodingType", "url"))
     if delimiter:
-        nodes.append(xml("Delimiter", delimiter))
+        nodes.append(xml("Delimiter", enc(delimiter)))
     if truncated and next_token is not None:
         nodes.append(xml("NextContinuationToken",
                          _enc_token(next_token[0] + next_token[1])))
     for key, v in contents:
         nodes.append(xml("Contents",
-                         xml("Key", key),
+                         xml("Key", enc(key)),
                          xml("LastModified", _iso(v.timestamp)),
                          xml("ETag", f'"{v.state.data.meta.etag}"'),
                          xml("Size", str(v.state.data.meta.size)),
                          xml("StorageClass", "STANDARD")))
     for cp in prefixes:
-        nodes.append(xml("CommonPrefixes", xml("Prefix", cp)))
+        nodes.append(xml("CommonPrefixes", xml("Prefix", enc(cp))))
     return xml_response(xml("ListBucketResult", *nodes))
 
 
@@ -202,22 +219,25 @@ async def handle_list_objects_v1(ctx, req: Request) -> Response:
     else:
         contents, prefixes, next_token, truncated = await _collect_objects(
             ctx, prefix, resume, delimiter, max_keys)
-    nodes = [xml("Name", ctx.bucket_name), xml("Prefix", prefix),
-             xml("Marker", marker), xml("MaxKeys", str(max_keys)),
+    enc, encoded = _encoder(q)
+    nodes = [xml("Name", ctx.bucket_name), xml("Prefix", enc(prefix)),
+             xml("Marker", enc(marker)), xml("MaxKeys", str(max_keys)),
              xml("IsTruncated", "true" if truncated else "false")]
+    if encoded:
+        nodes.append(xml("EncodingType", "url"))
     if delimiter:
-        nodes.append(xml("Delimiter", delimiter))
+        nodes.append(xml("Delimiter", enc(delimiter)))
     if truncated and next_token:
-        nodes.append(xml("NextMarker", next_token[1]))
+        nodes.append(xml("NextMarker", enc(next_token[1])))
     for key, v in contents:
         nodes.append(xml("Contents",
-                         xml("Key", key),
+                         xml("Key", enc(key)),
                          xml("LastModified", _iso(v.timestamp)),
                          xml("ETag", f'"{v.state.data.meta.etag}"'),
                          xml("Size", str(v.state.data.meta.size)),
                          xml("StorageClass", "STANDARD")))
     for cp in prefixes:
-        nodes.append(xml("CommonPrefixes", xml("Prefix", cp)))
+        nodes.append(xml("CommonPrefixes", xml("Prefix", enc(cp))))
     return xml_response(xml("ListBucketResult", *nodes))
 
 
@@ -325,19 +345,22 @@ async def handle_list_object_versions(ctx, req: Request) -> Response:
     else:
         contents, prefixes, next_token, truncated = await _collect_objects(
             ctx, prefix, resume, delimiter, max_keys)
-    nodes = [xml("Name", ctx.bucket_name), xml("Prefix", prefix),
+    enc, encoded = _encoder(q)
+    nodes = [xml("Name", ctx.bucket_name), xml("Prefix", enc(prefix)),
              xml("MaxKeys", str(max_keys)),
              xml("IsTruncated", "true" if truncated else "false")]
+    if encoded:
+        nodes.append(xml("EncodingType", "url"))
     if key_marker:
-        nodes.append(xml("KeyMarker", key_marker))
+        nodes.append(xml("KeyMarker", enc(key_marker)))
     if delimiter:
-        nodes.append(xml("Delimiter", delimiter))
+        nodes.append(xml("Delimiter", enc(delimiter)))
     if truncated and next_token is not None:
-        nodes.append(xml("NextKeyMarker", next_token[1]))
+        nodes.append(xml("NextKeyMarker", enc(next_token[1])))
         nodes.append(xml("NextVersionIdMarker", "null"))
     for key, v in contents:
         nodes.append(xml("Version",
-                         xml("Key", key),
+                         xml("Key", enc(key)),
                          xml("VersionId", "null"),
                          xml("IsLatest", "true"),
                          xml("LastModified", _iso(v.timestamp)),
@@ -345,7 +368,7 @@ async def handle_list_object_versions(ctx, req: Request) -> Response:
                          xml("Size", str(v.state.data.meta.size)),
                          xml("StorageClass", "STANDARD")))
     for cp in prefixes:
-        nodes.append(xml("CommonPrefixes", xml("Prefix", cp)))
+        nodes.append(xml("CommonPrefixes", xml("Prefix", enc(cp))))
     return xml_response(xml("ListVersionsResult", *nodes))
 
 
@@ -373,28 +396,31 @@ async def handle_list_multipart_uploads(ctx, req: Request) -> Response:
     ups, prefixes, next_cursor, truncated = await _collect_uploads(
         ctx, prefix, resume, delimiter, max_uploads)
 
-    nodes = [xml("Bucket", ctx.bucket_name), xml("Prefix", prefix),
+    enc, encoded = _encoder(q)
+    nodes = [xml("Bucket", ctx.bucket_name), xml("Prefix", enc(prefix)),
              xml("MaxUploads", str(max_uploads)),
              xml("IsTruncated", "true" if truncated else "false")]
+    if encoded:
+        nodes.append(xml("EncodingType", "url"))
     if delimiter:
-        nodes.append(xml("Delimiter", delimiter))
+        nodes.append(xml("Delimiter", enc(delimiter)))
     if key_marker is not None:
-        nodes.append(xml("KeyMarker", key_marker))
+        nodes.append(xml("KeyMarker", enc(key_marker)))
     if upload_id_marker:
         nodes.append(xml("UploadIdMarker", upload_id_marker))
     if truncated and next_cursor is not None:
-        nodes.append(xml("NextKeyMarker", next_cursor[1]))
+        nodes.append(xml("NextKeyMarker", enc(next_cursor[1])))
         if next_cursor[0] == "u":
             nodes.append(xml("NextUploadIdMarker", next_cursor[2]))
         elif next_cursor[0] == "i":
             nodes.append(xml("NextUploadIdMarker", "include"))
     for key, v in ups:
         nodes.append(xml("Upload",
-                         xml("Key", key),
+                         xml("Key", enc(key)),
                          xml("UploadId", v.uuid.hex()),
                          xml("Initiated", _iso(v.timestamp))))
     for cp in prefixes:
-        nodes.append(xml("CommonPrefixes", xml("Prefix", cp)))
+        nodes.append(xml("CommonPrefixes", xml("Prefix", enc(cp))))
     return xml_response(xml("ListMultipartUploadsResult", *nodes))
 
 

@@ -74,7 +74,10 @@ class S3Client:
             for h in signed)
         creq = "\n".join([
             method,
-            uri_encode(path, encode_slash=False) or "/",
+            # S3 convention: the request path is single-encoded by
+            # the caller and used VERBATIM as the canonical URI (no
+            # re-encoding - %20 must not become %2520)
+            path or "/",
             self._canonical_query(query),
             canonical_headers,
             ";".join(signed),
@@ -227,7 +230,10 @@ class S3Client:
         ]
         creq = "\n".join([
             method,
-            uri_encode(path, encode_slash=False) or "/",
+            # S3 convention: the request path is single-encoded by
+            # the caller and used VERBATIM as the canonical URI (no
+            # re-encoding - %20 must not become %2520)
+            path or "/",
             self._canonical_query(q),
             f"host:{self.host}:{self.port}\n",
             "host",

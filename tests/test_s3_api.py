@@ -2242,3 +2242,41 @@ def test_create_bucket_location_constraint(client):
     st, _, body = client.request("PUT", "/locbkt3", body=b"not-xml")
     assert st == 400
     client.request("DELETE", "/locbkt")
+
+
+def test_list_encoding_type_url(client):
+    """encoding-type=url percent-encodes keys/prefixes in listings
+    (boto3 requests it by default; unencoded special-char keys would
+    mis-parse client-side)."""
+    client.request("PUT", "/enctest")
+    raw_key = "dir with space/obj name.txt"
+    from urllib.parse import quote
+
+    st, _, _ = client.request("PUT", f"/enctest/{quote(raw_key)}",
+                              body=b"e")
+    assert st == 200
+    st, _, body = client.request(
+        "GET", "/enctest",
+        query=[("list-type", "2"), ("encoding-type", "url")])
+    assert st == 200
+    assert xml_find(body, "EncodingType") == ["url"]
+    keys = xml_find(body, "Key")
+    assert keys == [quote(raw_key, safe="/")]
+    # delimiter folding encodes CommonPrefixes too
+    st, _, body = client.request(
+        "GET", "/enctest",
+        query=[("list-type", "2"), ("encoding-type", "url"),
+               ("delimiter", "/")])
+    assert xml_find(body, "Prefix")[-1] == quote("dir with space/")
+    # versions + uploads honour it as well
+    st, _, body = client.request(
+        "GET", "/enctest", query=[("versions", ""),
+                                  ("encoding-type", "url")])
+    assert xml_find(body, "Key") == [quote(raw_key, safe="/")]
+    # unknown encoding-type is a 400
+    st, _, body = client.request(
+        "GET", "/enctest", query=[("list-type", "2"),
+                                  ("encoding-type", "base64")])
+    assert st == 400
+    client.request("DELETE", f"/enctest/{quote(raw_key)}")
+    client.request("DELETE", "/enctest")
