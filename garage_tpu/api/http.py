@@ -312,6 +312,18 @@ class HttpServer:
         t = asyncio.current_task()
         self._conns.add(t)
         peer = writer.get_extra_info("peername")
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            import socket as _s
+
+            try:
+                # response flushes are already whole buffers: never
+                # wait out Nagle. A wide receive window keeps 1 MiB
+                # PUT bodies flowing while the loop serves other conns.
+                sock.setsockopt(_s.IPPROTO_TCP, _s.TCP_NODELAY, 1)
+                sock.setsockopt(_s.SOL_SOCKET, _s.SO_RCVBUF, 1 << 21)
+            except OSError:
+                pass  # unix sockets / restricted environments
         try:
             while True:
                 try:
