@@ -2280,3 +2280,54 @@ def test_list_encoding_type_url(client):
     assert st == 400
     client.request("DELETE", f"/enctest/{quote(raw_key)}")
     client.request("DELETE", "/enctest")
+
+
+def test_otlp_trace_sink_from_forked_server(tmp_path_factory):
+    """[admin] trace_sink wiring end to end: a real server process
+    ships OTLP spans for a PUT to a local collector."""
+    import http.server
+    import json as _json
+    import threading
+
+    received = []
+
+    class Sink(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            received.append((self.path, _json.loads(body)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    col = http.server.HTTPServer(("127.0.0.1", 0), Sink)
+    threading.Thread(target=col.serve_forever, daemon=True).start()
+    tmp = str(tmp_path_factory.mktemp("otlpsrv"))
+    srv = Server(tmp)
+    with open(srv.config_path) as f:
+        cfg = f.read()
+    cfg = cfg.replace(
+        'admin_token = "test-admin-token"',
+        'admin_token = "test-admin-token"\n'
+        f'trace_sink = "http://127.0.0.1:{col.server_port}"')
+    with open(srv.config_path, "w") as f:
+        f.write(cfg)
+    try:
+        srv.start()
+        srv.setup_layout_and_key()
+        cli = S3Client("127.0.0.1", srv.s3_port, srv.key_id, srv.secret)
+        cli.request("PUT", "/otlpb")
+        cli.request("PUT", "/otlpb/k", body=b"traced")
+        deadline = time.monotonic() + 15  # exporter flushes every 3 s
+        while time.monotonic() < deadline and not received:
+            time.sleep(0.5)
+        assert received, "no OTLP batch arrived from the server"
+        path, payload = received[0]
+        assert path == "/v1/traces"
+        spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert any(s["name"] == "http.request" for s in spans)
+    finally:
+        srv.stop()
+        col.shutdown()
