@@ -344,9 +344,24 @@ def bench_s3_put(nobj: int, obj_mib: int = 4, device: bool = False) -> dict:
         def start(self) -> None:
             import select
 
-            env = dict(os.environ, PYTHONPATH=REPO, PYTHONUNBUFFERED="1",
+            # PREPEND the repo to PYTHONPATH — replacing it would drop
+            # the accelerator plugin's site dir (e.g. /root/.axon_site)
+            # and the child would silently lose the device: unpinned
+            # discovery falls back to cpu, and that negative verdict
+            # poisons the probe cache. This cost the first r5 capture.
+            pp = REPO + ((os.pathsep + os.environ["PYTHONPATH"])
+                         if os.environ.get("PYTHONPATH") else "")
+            env = dict(os.environ, PYTHONPATH=pp, PYTHONUNBUFFERED="1",
                        GARAGE_TPU_DEVICE="require")
-            env.pop("JAX_PLATFORMS", None)
+            # Drop the platform pin ONLY if it pins cpu (the test
+            # conftest's pin). A real-accelerator pin (e.g. axon) must
+            # survive: unpinned discovery silently falls back to cpu
+            # when plugin init fails under co-tenant load, and the
+            # resulting NEGATIVE probe verdict lands in a different
+            # cache namespace where it poisons later probes — the
+            # exact failure that cost the first r5 live-path capture.
+            if env.get("JAX_PLATFORMS", "").strip().lower() in ("", "cpu"):
+                env.pop("JAX_PLATFORMS", None)
             self.proc = subprocess.Popen(
                 [sys.executable, "-m", "garage_tpu.cli.server",
                  "--config", self.config_path, "--log-level", "warning"],
@@ -389,16 +404,41 @@ def bench_s3_put(nobj: int, obj_mib: int = 4, device: bool = False) -> dict:
         data = np.random.default_rng(7).integers(
             0, 256, size, dtype=np.uint8).tobytes()
 
+        # device mode proves the live path, not throughput: a crawling
+        # tunnel moves single-digit MB/s, so give those requests a
+        # timeout that survives it
+        rq_timeout = 240.0 if device else 30.0
+
         def put(i):
             st, _, b = cli.request("PUT", f"/bench/o{i}", body=data,
-                                   unsigned_payload=True)
+                                   unsigned_payload=True,
+                                   timeout=rq_timeout)
             assert st == 200, b[:200]
 
         def get(i):
-            st, _, b = cli.request("GET", f"/bench/o{i}")
+            st, _, b = cli.request("GET", f"/bench/o{i}",
+                                   timeout=rq_timeout)
             assert st == 200 and len(b) == size
-        put(0)  # warm (device mode: triggers jax import + compile in
-        # the server; the feeder settles off the timed window)
+        # warm (device mode: triggers jax import + compile in the
+        # server; the feeder settles off the timed window). Device
+        # mode retries transport-level failures: rq_timeout covers the
+        # common cold-probe wait, but connection resets and the
+        # worst-case negative-then-forced probe chain can still
+        # exhaust a single attempt.
+        warm_attempts = 5 if device else 1
+        for _w in range(warm_attempts):
+            try:
+                put(0)
+                break
+            except AssertionError:
+                # the server ANSWERED with an error — deterministic
+                # (e.g. probe verdict: tunnel dead); retrying the same
+                # server just burns the 240 s timeout repeatedly
+                raise
+            except Exception:
+                if _w == warm_attempts - 1:
+                    raise
+                time.sleep(5.0)
         if device:
             time.sleep(5.0)
             put(0)
@@ -626,7 +666,9 @@ def main() -> None:
     # device-required segment: every encode batch forced onto the
     # accelerator — proves the device path end to end (VERDICT r3 #3)
     if platform != "cpu":
-        seg = run_segment("dev", "require", True, min(nblocks, 64))
+        # 16 blocks: proves the forced end-to-end device path while
+        # staying inside the batch timeout even at ~2 MB/s tunnel rates
+        seg = run_segment("dev", "require", True, min(nblocks, 16))
         if "error" in seg:
             extra["device_put_error"] = seg["error"]
         else:
@@ -649,10 +691,18 @@ def main() -> None:
     # live S3 PUTs batching through the accelerator, feeder counters
     # scraped from its /metrics (VERDICT r4 weak #2 / r5 #1)
     if platform != "cpu":
-        try:
-            extra.update(bench_s3_put(4, device=True))
-        except Exception as e:
-            extra["s3_device_error"] = f"{type(e).__name__}: {e}"[:300]
+        for _attempt in range(2):  # one retry: the forked server's
+            # probe can lose a co-tenant congestion window the parent's
+            # own probe survived. Small objects (1 MiB): the segment
+            # exists to prove feeder_device_items>0 on the live path,
+            # and a crawling tunnel (~2 MB/s observed) must not push
+            # the whole segment past its timeouts.
+            try:
+                extra.update(bench_s3_put(2, obj_mib=1, device=True))
+                extra.pop("s3_device_error", None)
+                break
+            except Exception as e:
+                extra["s3_device_error"] = f"{type(e).__name__}: {e}"[:300]
 
     # CPU baseline segment: replicate-3 whole blocks, host only
     # (BASELINE.md rows 1/5: the reference's strategy on the host

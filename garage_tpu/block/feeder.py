@@ -166,6 +166,8 @@ class DeviceFeeder:
         self.mode = mode
         self._q: Optional[asyncio.Queue] = None
         self._task: Optional[asyncio.Task] = None
+        self._require_lock: Optional[asyncio.Lock] = None
+        self._require_err: Optional[tuple[float, str]] = None
         self._device_ok: Optional[bool] = None
         self._probing = False
         self._calibrating = False
@@ -205,11 +207,42 @@ class DeviceFeeder:
             self._task = asyncio.create_task(self._run(), name="device-feeder")
         if self.mode == "off":
             self._device_ok = False
-        elif self.mode == "require" and self._device_ok is None:
-            res = probe_device()
+
+    async def _require_probe(self) -> None:
+        """Resolve the device verdict for mode="require" WITHOUT
+        blocking the event loop: the probe is a jax subprocess that can
+        take ~2 min cold, and running it inline wedged every other
+        connection past its client timeout (first r5 live capture)."""
+        if self._require_lock is None:
+            self._require_lock = asyncio.Lock()
+        async with self._require_lock:
+            if self._device_ok is not None:
+                return
+            if self._require_err is not None:
+                # fail fast on a recent verdict: without this, every
+                # request on a dead tunnel pays the full forced-probe
+                # chain while serialized behind this lock. TTL must
+                # exceed that chain's cost (up to 4 × PROBE_TIMEOUT)
+                # or steady traffic spends most wall time re-probing.
+                ts, msg = self._require_err
+                if time.monotonic() - ts < 5 * PROBE_TIMEOUT:
+                    raise RuntimeError(msg)
+                self._require_err = None
+            res = await asyncio.to_thread(probe_device)
             if not res["ok"]:
-                raise RuntimeError(f"device required but probe failed: "
-                                   f"{res['error'] or res['platform']}")
+                # A negative verdict may be a stale cache entry or a
+                # transient co-tenant fallback (unpinned jax discovery
+                # degrades to cpu under load); "require" exists for
+                # proof runs, so pay one forced re-probe before
+                # failing — with a longer leash, since a congested
+                # tunnel can hold jax.devices() past the default.
+                res = await asyncio.to_thread(
+                    probe_device, 3 * PROBE_TIMEOUT, True)
+            if not res["ok"]:
+                msg = (f"device required but probe failed: "
+                       f"{res['error'] or res['platform']}")
+                self._require_err = (time.monotonic(), msg)
+                raise RuntimeError(msg)
             self._device_ok = True
 
     async def stop(self) -> None:
@@ -306,6 +339,12 @@ class DeviceFeeder:
 
     async def _submit(self, op: str, data, extra=None):
         self._ensure_started()
+        if self.mode == "require" and self._device_ok is None:
+            await self._require_probe()
+            # stop() may have torn down the dispatcher while we sat in
+            # the (multi-minute) probe; restart it or the enqueued item
+            # below would await a future nothing ever resolves
+            self._ensure_started()
         fut = asyncio.get_running_loop().create_future()
         await self._q.put(_Item(op, data, fut, extra))
         return await fut

@@ -98,7 +98,7 @@ class S3Client:
                 query: Optional[list[tuple[str, str]]] = None,
                 headers: Optional[dict[str, str]] = None,
                 body: bytes = b"", unsigned_payload: bool = False,
-                anonymous: bool = False):
+                anonymous: bool = False, timeout: float = 30.0):
         """-> (status, headers dict, body bytes)."""
         query = query or []
         headers = {k.lower(): v for k, v in (headers or {}).items()}
@@ -109,7 +109,7 @@ class S3Client:
             headers = self.sign(method, path, query, headers, payload_hash)
         qs = "&".join(f"{uri_encode(k)}={uri_encode(v)}" for k, v in query)
         url = path + ("?" + qs if qs else "")
-        conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=timeout)
         try:
             conn.request(method, url, body=body, headers=headers)
             r = conn.getresponse()
