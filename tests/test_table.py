@@ -332,7 +332,13 @@ def test_fullcopy_local_reads(tmp_path):
         )
         try:
             await tables[0].insert(KvEntry.new(b"cfg", b"bucket1", {"a": 1}))
+            # write quorum is n-1 (fullcopy.rs semantics): one replica may
+            # still be in flight when insert() returns — wait for fan-out
             for t in tables:
+                for _ in range(200):
+                    if t.data.read_entry(b"cfg", b"bucket1") is not None:
+                        break
+                    await asyncio.sleep(0.02)
                 assert t.data.read_entry(b"cfg", b"bucket1") is not None
             # reads are local: work even with the other two disconnected
             await systems[0].netapp.shutdown()
