@@ -52,6 +52,16 @@ _DEVICE_MIN_ITEMS = 4
 # can cost ~0.5 s, so a per-N-calls rule taxed busy traffic heavily
 # while an idle server never re-probed at all.
 _EXPLORE_SECS = 60.0
+# exploration trials of the LOSING backend are capped: over a ~2 MB/s
+# tunnel a full 8x1 MiB production batch costs seconds, and the
+# re-probe only needs one timing sample, not the whole batch. The cap
+# is byte-aware — at least 2 items, growing to 8 while the slice is
+# under _TRIAL_MAX_BYTES — so a trial of small blobs still amortizes a
+# recovered backend's fixed round-trip latency instead of permanently
+# under-measuring it. The rest of the batch runs on the winner.
+_TRIAL_MAX_ITEMS = 2
+_TRIAL_ITEMS_CAP = 8
+_TRIAL_MAX_BYTES = 4 << 20
 # a batch stuck longer than this means the device backend hung (the
 # axon tunnel can block inside XLA calls); the batch re-runs host-side
 # and the device path is disabled
@@ -442,7 +452,22 @@ class DeviceFeeder:
             # pointless trial on the known-slow backend)
             self._last_explore[op] = now
             return False
-        if now - self._last_explore[op] >= _EXPLORE_SECS:
+        # adaptive interval: the wider the measured gap, the rarer the
+        # re-probe. A backend losing 8x gets the base 60 s cadence; a
+        # tunnel-attached device losing 500x gets probed ~hourly — one
+        # trial there costs real seconds of live traffic, and a gap that
+        # wide doesn't close without a topology change anyway.
+        dev, host = self._rates(op)
+        interval = _EXPLORE_SECS
+        if dev is not None and host is not None:
+            # a 0.0 rate (every byte of that backend's window failed)
+            # is the WIDEST gap, not missing data: cap straight to 64x
+            if min(dev, host) <= 0.0:
+                interval *= 64.0
+            else:
+                ratio = max(dev, host) / min(dev, host)
+                interval *= min(64.0, max(1.0, ratio / 8.0))
+        if now - self._last_explore[op] >= interval:
             self._last_explore[op] = now
             return True
         return False
@@ -565,24 +590,28 @@ class DeviceFeeder:
 
     # ---- batch execution (worker thread) -------------------------------
 
-    def _pick_backend(self, op: str, total_bytes: int, n_items: int) -> str:
+    def _pick_backend(self, op: str, total_bytes: int,
+                      n_items: int) -> tuple[str, bool]:
+        """-> (backend, trial). trial=True marks an exploration of the
+        currently-losing backend: _run_batch caps that slice to
+        _TRIAL_MAX_ITEMS and runs the rest on the winner."""
         if self.mode == "require":
-            return "device"  # forced: bench/test proof of the device path
+            return "device", False  # forced: proof of the device path
         if self._device_ok is not True or self._calibrating:
-            return "host"
+            return "host", False
         if self._force_device.pop(op, False):
-            return "device"  # inline fast-path escape: re-probe now
+            return "device", True  # inline fast-path escape: re-probe now
         if total_bytes < _DEVICE_MIN_BYTES and n_items < _DEVICE_MIN_ITEMS:
-            return "host"  # tiny batches never amortize a round trip
+            return "host", False  # tiny batches never amortize a round trip
         dev_rate, host_rate = self._rates(op)
         if dev_rate is None:
-            return "device"  # first sizeable batch: measure the device
+            return "device", False  # first sizeable batch: measure it
         if host_rate is None:
-            return "host"
+            return "host", False
         if self._explore_due(op):
             # periodic re-probe of whichever backend is currently losing
-            return "device" if dev_rate < host_rate else "host"
-        return "device" if dev_rate >= host_rate else "host"
+            return ("device" if dev_rate < host_rate else "host"), True
+        return ("device" if dev_rate >= host_rate else "host"), False
 
     def _record(self, op: str, backend: str, nbytes: int, dt: float) -> None:
         with self._perf_lock:  # inline paths record from the loop thread
@@ -604,12 +633,12 @@ class DeviceFeeder:
         for i, item in enumerate(batch):
             by_op.setdefault(item.op, []).append(i)
         for op, idxs in by_op.items():
-            blobs = [batch[i].data for i in idxs]
             if op in ("verify", "encode_put", "hash_md5"):  # 2-tuples
-                total = sum(len(b) for _, b in blobs)
+                total = sum(len(batch[i].data[1]) for i in idxs)
             else:
-                total = sum(len(b) for b in blobs
-                            if isinstance(b, (bytes, bytearray)))
+                total = sum(len(batch[i].data) for i in idxs
+                            if isinstance(batch[i].data,
+                                          (bytes, bytearray)))
             perf_op = ("hash" if op in ("verify", "hash_md5") else
                        "encode" if op == "encode_put" else op)
             host_only = force_host
@@ -618,36 +647,77 @@ class DeviceFeeder:
 
                 if _data._content_algo != "blake3":
                     host_only = True  # blake2 never runs on device
-            backend = ("host" if host_only else
-                       self._pick_backend(perf_op, total, len(blobs)))
-            t0 = time.perf_counter()
-            try:
-                try:
-                    out = self._do_op(op, blobs, backend)
-                except Exception as e:
-                    if backend != "device":
-                        raise
-                    # a failing device (dead tunnel, OOM, XLA error) must
-                    # not fail requests while the host path works: retry
-                    # host-side and penalize the device in calibration
-                    log.warning("device %s batch failed (%s: %s); "
-                                "falling back to host", op,
-                                type(e).__name__, e)
-                    self._record(perf_op, "device", 0, 60.0)
-                    backend = "host"
-                    t0 = time.perf_counter()
-                    out = self._do_op(op, blobs, backend)
-                for i, o in zip(idxs, out):
-                    results[i] = o
-                self._record(perf_op, backend, total,
-                             time.perf_counter() - t0)
-                if backend == "device":
-                    self.stats["device_batches"] += 1
-                    self.stats["device_items"] += len(idxs)
-            except Exception as e:
-                for i in idxs:
-                    results[i] = e
+            if host_only:
+                backend, trial = "host", False
+            else:
+                backend, trial = self._pick_backend(perf_op, total,
+                                                    len(idxs))
+            cut = self._trial_cut(op, batch, idxs) if trial else len(idxs)
+            if cut < len(idxs):
+                # exploration of the losing backend: one small timing
+                # sample there, the bulk stays on the winner
+                other = "host" if backend == "device" else "device"
+                self._exec_group(op, perf_op, batch, idxs[:cut], backend,
+                                 results)
+                self._exec_group(op, perf_op, batch, idxs[cut:], other,
+                                 results)
+            else:
+                self._exec_group(op, perf_op, batch, idxs, backend,
+                                 results)
         return results
+
+    @staticmethod
+    def _trial_cut(op: str, batch: list, idxs: list) -> int:
+        """Items in the exploration slice: at least _TRIAL_MAX_ITEMS,
+        growing to _TRIAL_ITEMS_CAP while under _TRIAL_MAX_BYTES."""
+        cut, size = 0, 0
+        for i in idxs:
+            if cut >= _TRIAL_MAX_ITEMS and (
+                    size >= _TRIAL_MAX_BYTES or cut >= _TRIAL_ITEMS_CAP):
+                break
+            d = batch[i].data
+            if op in ("verify", "encode_put", "hash_md5"):
+                d = d[1]
+            size += len(d) if isinstance(d, (bytes, bytearray,
+                                             memoryview)) else 0
+            cut += 1
+        return cut
+
+    def _exec_group(self, op: str, perf_op: str, batch: list,
+                    idxs: list, backend: str, results: list) -> None:
+        blobs = [batch[i].data for i in idxs]
+        if op in ("verify", "encode_put", "hash_md5"):  # 2-tuples
+            total = sum(len(b) for _, b in blobs)
+        else:
+            total = sum(len(b) for b in blobs
+                        if isinstance(b, (bytes, bytearray)))
+        t0 = time.perf_counter()
+        try:
+            try:
+                out = self._do_op(op, blobs, backend)
+            except Exception as e:
+                if backend != "device":
+                    raise
+                # a failing device (dead tunnel, OOM, XLA error) must
+                # not fail requests while the host path works: retry
+                # host-side and penalize the device in calibration
+                log.warning("device %s batch failed (%s: %s); "
+                            "falling back to host", op,
+                            type(e).__name__, e)
+                self._record(perf_op, "device", 0, 60.0)
+                backend = "host"
+                t0 = time.perf_counter()
+                out = self._do_op(op, blobs, backend)
+            for i, o in zip(idxs, out):
+                results[i] = o
+            self._record(perf_op, backend, total,
+                         time.perf_counter() - t0)
+            if backend == "device":
+                self.stats["device_batches"] += 1
+                self.stats["device_items"] += len(idxs)
+        except Exception as e:
+            for i in idxs:
+                results[i] = e
 
     def _do_op(self, op: str, blobs: list, backend: str) -> list:
         if op == "hash":

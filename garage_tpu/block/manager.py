@@ -462,17 +462,35 @@ class BlockManager:
         return None
 
     def _write_file(self, path: str, content: bytes) -> None:
-        os.makedirs(os.path.dirname(path), exist_ok=True)
+        d = os.path.dirname(path)
+        # lazy init: tests build bare managers via __new__
+        made = getattr(self, "_made_dirs", None)
+        if made is None:
+            made = self._made_dirs = set()
+        if d not in made:
+            os.makedirs(d, exist_ok=True)
+            if len(made) >= 65536:
+                made.clear()
+            made.add(d)
         # unique tmp per writer: two concurrent puts of the same
         # content-addressed file must not steal each other's tmp (the
         # reference serializes via hash-sharded mutexes, manager.rs:113;
         # here either rename winning is fine — the bytes are identical)
         tmp = path + f".tmp{next(_tmp_ctr)}"
-        with open(tmp, "wb") as f:
-            f.write(content)
-            if self.fsync:
-                f.flush()
-                os.fsync(f.fileno())
+        for attempt in range(2):
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(content)
+                    if self.fsync:
+                        f.flush()
+                        os.fsync(f.fileno())
+                break
+            except FileNotFoundError:
+                # cached dir vanished under us (quarantine/rebalance
+                # pruning): recreate and retry once
+                if attempt:
+                    raise
+                os.makedirs(d, exist_ok=True)
         os.replace(tmp, path)
         if self.fsync:
             dirfd = os.open(os.path.dirname(path), os.O_RDONLY)

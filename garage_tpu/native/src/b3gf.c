@@ -980,11 +980,42 @@ static void gf_axpy(uint8_t c, const uint8_t *x, uint8_t *o, int64_t n) {
     gf_axpy_scalar(c, x, o, n);
 }
 
+/* Tiled r x s GF(2^8) matmul over strided rows. Column tiles sized so
+ * the r output tiles stay cache-resident while each input tile is read
+ * once from memory (the naive row-major loop re-streams every input row
+ * per output row: ~3*r*s*n bytes of traffic vs ~(s+r)*n here). Inner
+ * loop order is j-then-i so a just-loaded input tile feeds all r
+ * outputs from L1. */
+#define GF_TILE 16384
+#define GF_MAXROWS 256
+static void gf_matmul_tiled(const uint8_t *mat, int64_t r, int64_t s,
+                            const uint8_t *const *xrows,
+                            uint8_t *const *orows, int64_t n) {
+    for (int64_t t0 = 0; t0 < n; t0 += GF_TILE) {
+        int64_t tn = n - t0 < GF_TILE ? n - t0 : GF_TILE;
+        for (int64_t i = 0; i < r; i++)
+            memset(orows[i] + t0, 0, (size_t)tn);
+        for (int64_t j = 0; j < s; j++)
+            for (int64_t i = 0; i < r; i++)
+                gf_axpy(mat[i * s + j], xrows[j] + t0, orows[i] + t0, tn);
+    }
+}
+
 /* out (r, n) = mat (r, s) @ x (s, n) over GF(2^8); rows contiguous. */
 void gf256_matmul(const uint8_t *mat, int64_t r, int64_t s,
                   const uint8_t *x, int64_t n, uint8_t *out) {
     if (!nib_ready)
         nib_init();
+    if (r <= GF_MAXROWS && s <= GF_MAXROWS && r > 1) {
+        const uint8_t *xr[GF_MAXROWS];
+        uint8_t *or_[GF_MAXROWS];
+        for (int64_t j = 0; j < s; j++)
+            xr[j] = x + j * n;
+        for (int64_t i = 0; i < r; i++)
+            or_[i] = out + i * n;
+        gf_matmul_tiled(mat, r, s, xr, or_, n);
+        return;
+    }
     for (int64_t i = 0; i < r; i++) {
         uint8_t *o = out + i * n;
         memset(o, 0, (size_t)n);
@@ -1036,14 +1067,26 @@ void rs_encode_block_packed(const uint8_t *pfx, int64_t pfx_len,
                 memset(dst, 0, (size_t)want);
         }
     }
-    /* parity shards from the in-place data shards */
+    /* parity shards from the in-place data shards (tiled: each data
+     * tile read once, all m parity tiles cache-resident) */
     if (!nib_ready)
         nib_init();
-    for (int64_t i = 0; i < m; i++) {
-        uint8_t *o = out + (k + i) * stride + 16;
-        memset(o, 0, (size_t)shard_len);
+    if (k <= GF_MAXROWS && m <= GF_MAXROWS) {
+        const uint8_t *xr[GF_MAXROWS];
+        uint8_t *or_[GF_MAXROWS];
         for (int64_t j = 0; j < k; j++)
-            gf_axpy(pmat[i * k + j], out + j * stride + 16, o, shard_len);
+            xr[j] = out + j * stride + 16;
+        for (int64_t i = 0; i < m; i++)
+            or_[i] = out + (k + i) * stride + 16;
+        gf_matmul_tiled(pmat, m, k, xr, or_, shard_len);
+    } else {
+        for (int64_t i = 0; i < m; i++) {
+            uint8_t *o = out + (k + i) * stride + 16;
+            memset(o, 0, (size_t)shard_len);
+            for (int64_t j = 0; j < k; j++)
+                gf_axpy(pmat[i * k + j], out + j * stride + 16, o,
+                        shard_len);
+        }
     }
     /* headers */
     for (int64_t i = 0; i < k + m; i++) {

@@ -500,3 +500,69 @@ def test_feeder_hash_md5_device_failure_fallback_etag_correct():
         await f.stop()
 
     run(go())
+
+
+def test_feeder_explore_trial_capped_and_adaptive():
+    """Exploration of the losing backend is (a) capped to
+    _TRIAL_MAX_ITEMS per trial — over a crawling tunnel a full
+    production batch costs seconds, one timing sample doesn't — and
+    (b) scheduled on an interval that widens with the measured rate
+    gap, so a 500x-slower device is probed ~hourly, not every minute."""
+    import time as _time
+
+    from garage_tpu.block import feeder as fmod
+    from garage_tpu.block.codec import ErasureCodec
+
+    f = DeviceFeeder(codec=ErasureCodec(4, 2, use_jax=False), mode="auto")
+    f._device_ok = True
+    # seed calibration: host hugely winning (tunnel-shaped gap)
+    f._record("encode", "host", 1 << 30, 1.0)     # 1 GB/s
+    f._record("encode", "device", 1 << 21, 1.0)   # 2 MB/s
+    f._last_explore["encode"] = _time.monotonic()
+
+    # (b) adaptive interval: a 512x gap stretches the 60 s base cadence
+    # to its 64x cap, so one base interval later no trial fires
+    f._last_explore["encode"] = _time.monotonic() - 2 * fmod._EXPLORE_SECS
+    assert f._explore_due("encode") is False
+    # far past the stretched interval the trial fires
+    f._last_explore["encode"] = (
+        _time.monotonic() - 65 * fmod._EXPLORE_SECS)
+    backend, trial = f._pick_backend("encode", 8 << 20, 8)
+    assert (backend, trial) == ("device", True)
+
+    # (a) the trial slice is capped: run a batch through _run_batch
+    # with the device leg stubbed, and count what each backend saw
+    seen = {"device": 0, "host": 0}
+    real = f._do_op
+
+    def spy(op, blobs, backend):
+        seen[backend] += len(blobs)
+        return real(op, blobs, "host")  # no real device in unit tests
+
+    f._do_op = spy
+    blk = os.urandom(1 << 20)  # 1 MiB items: the byte-aware cut engages
+
+    class It:
+        def __init__(self):
+            self.op = "encode_put"
+            self.data = (b"", blk)
+            self.future = asyncio.get_event_loop().create_future()
+
+    async def go():
+        f._last_explore["encode"] = (
+            _time.monotonic() - 65 * fmod._EXPLORE_SECS)
+        items = [It() for _ in range(8)]
+        f._run_batch(items)
+
+    run(go())
+    # trial grows past _TRIAL_MAX_ITEMS until _TRIAL_MAX_BYTES: 4x1 MiB
+    want = fmod._TRIAL_MAX_BYTES >> 20
+    assert seen["device"] == want
+    assert seen["host"] == 8 - want
+
+    # a DEAD device (0.0 recorded rate) is the widest gap: the adaptive
+    # interval jumps straight to the 64x cap, not the 60 s base
+    f._record("encode", "device", 0, 60.0)
+    f._perf[("encode", "device")] = [0.0, 60.0]
+    f._last_explore["encode"] = _time.monotonic() - 2 * fmod._EXPLORE_SECS
+    assert f._explore_due("encode") is False
