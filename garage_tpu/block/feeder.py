@@ -88,6 +88,29 @@ _probe_lock = threading.Lock()
 _probe_result: Optional[dict] = None
 
 
+def _read_probe_cache() -> Optional[dict]:
+    """Disk-cached verdict if fresh, else None. Caller holds no lock."""
+    try:
+        with open(_probe_cache_path()) as f:
+            cached = json.load(f)
+        age = time.time() - cached.get("at", 0)
+        if 0 <= age < _PROBE_TTL:  # reject future timestamps
+            return cached
+    except Exception:
+        pass
+    return None
+
+
+def _write_probe_cache(res: dict) -> None:
+    try:
+        cache = _probe_cache_path()
+        with open(cache + ".tmp", "w") as f:
+            json.dump(res, f)
+        os.replace(cache + ".tmp", cache)
+    except OSError:
+        pass
+
+
 def probe_device(timeout: float = PROBE_TIMEOUT, force: bool = False) -> dict:
     """Subprocess-probe the default JAX backend. Returns
     {"ok": bool, "platform": str, "error": str}. Cached in-process and in
@@ -98,15 +121,10 @@ def probe_device(timeout: float = PROBE_TIMEOUT, force: bool = False) -> dict:
         if _probe_result is not None and not force:
             return _probe_result
         if not force:
-            try:
-                with open(_probe_cache_path()) as f:
-                    cached = json.load(f)
-                age = time.time() - cached.get("at", 0)
-                if 0 <= age < _PROBE_TTL:  # reject future timestamps
-                    _probe_result = cached
-                    return cached
-            except Exception:
-                pass
+            cached = _read_probe_cache()
+            if cached is not None:
+                _probe_result = cached
+                return cached
         res = {"ok": False, "platform": "cpu", "error": "", "at": time.time()}
         try:
             r = subprocess.run(
@@ -124,15 +142,36 @@ def probe_device(timeout: float = PROBE_TIMEOUT, force: bool = False) -> dict:
             res["error"] = f"jax.devices() did not return within {timeout}s"
         except OSError as e:
             res["error"] = str(e)
+        if force and res["ok"]:
+            cached = _read_probe_cache()
+            if cached is not None and cached.get("hung"):
+                # the poison marks a device that ANSWERS probes but hangs
+                # on real work — this probe-only success proves nothing
+                # new, so the forced caller gets its result while the
+                # shared verdict stays poisoned until the TTL expires
+                return res
         _probe_result = res
-        try:
-            cache = _probe_cache_path()
-            with open(cache + ".tmp", "w") as f:
-                json.dump(res, f)
-            os.replace(cache + ".tmp", cache)
-        except OSError:
-            pass
+        _write_probe_cache(res)
         return res
+
+
+def poison_probe_cache(error: str) -> None:
+    """Record a negative device verdict (in-process + /tmp TTL cache)
+    with the `hung` marker. Used when the device answered the probe but
+    then HUNG in real work (calibration/batch): without this every
+    co-located feeder re-reads the stale positive probe and pays the
+    full watchdog timeout itself. mode="require" still force-re-probes
+    and proceeds on its own result, but a probe-only success does NOT
+    clear the hung marker for auto feeders (only the TTL does).
+
+    May block up to a probe timeout on _probe_lock — call from a worker
+    thread, never the event loop."""
+    global _probe_result
+    res = {"ok": False, "platform": "cpu", "error": error,
+           "at": time.time(), "hung": True}
+    with _probe_lock:
+        _probe_result = res
+        _write_probe_cache(res)
 
 
 def _verify_matches(digs: list, items: list) -> list[bool]:
@@ -301,6 +340,9 @@ class DeviceFeeder:
                         log.error("device calibration stuck >%ss; "
                                   "disabling device path", _BATCH_TIMEOUT)
                         ok = False
+                        poison_probe_cache(
+                            f"calibration stuck >{_BATCH_TIMEOUT}s "
+                            "(device answered probe, hung on work)")
                 elif res["error"]:
                     log.info("device probe failed, host data plane: %s",
                              res["error"])
@@ -568,6 +610,14 @@ class DeviceFeeder:
                               "path and re-running host-side",
                               _BATCH_TIMEOUT)
                     self._device_ok = False
+                    if self.mode != "require":
+                        # thread: poison blocks on _probe_lock if a
+                        # probe is mid-flight, and this is the loop
+                        threading.Thread(
+                            target=poison_probe_cache,
+                            args=(f"device batch stuck "
+                                  f">{_BATCH_TIMEOUT}s",),
+                            daemon=True).start()
                     # bounded too: if even the JAX-free host path stalls,
                     # fail this batch instead of wedging the dispatcher
                     results = await asyncio.wait_for(

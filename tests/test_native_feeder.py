@@ -566,3 +566,60 @@ def test_feeder_explore_trial_capped_and_adaptive():
     f._perf[("encode", "device")] = [0.0, 60.0]
     f._last_explore["encode"] = _time.monotonic() - 2 * fmod._EXPLORE_SECS
     assert f._explore_due("encode") is False
+
+
+def test_probe_cache_poison_and_require_override():
+    """A device that answers the probe but hangs on work poisons the
+    shared probe cache with the `hung` marker (co-located feeders skip
+    it for the TTL instead of each paying the watchdog timeout). A
+    forced re-probe — mode="require"'s escape hatch — gets its own
+    fresh result, but a probe-only success must NOT clear the hung
+    marker: answering a probe is exactly what a hung-on-work device
+    still does."""
+    import json as _json
+
+    from garage_tpu.block import feeder as fmod
+
+    cache_path = fmod._probe_cache_path()
+    old_result = fmod._probe_result
+    old_disk = None
+    try:
+        with open(cache_path, "rb") as f:
+            old_disk = f.read()
+    except OSError:
+        pass
+    old_run = fmod.subprocess.run
+    try:
+        fmod.poison_probe_cache("calibration stuck >300s (test)")
+        res = fmod.probe_device()
+        assert res["ok"] is False
+        assert "stuck" in res["error"]
+        with open(cache_path) as f:
+            on_disk = _json.load(f)
+        assert on_disk["ok"] is False and on_disk["hung"] is True
+
+        # forced re-probe that SUCCEEDS (stubbed subprocess: the device
+        # answers): caller gets the positive result...
+        class _R:
+            returncode = 0
+            stdout = "axon\n"
+            stderr = ""
+
+        fmod.subprocess.run = lambda *a, **k: _R()
+        forced = fmod.probe_device(force=True)
+        assert forced["ok"] is True and forced["platform"] == "axon"
+        # ...but the shared verdict stays poisoned for auto feeders
+        assert fmod.probe_device()["ok"] is False
+        with open(cache_path) as f:
+            assert _json.load(f)["hung"] is True
+    finally:
+        fmod.subprocess.run = old_run
+        fmod._probe_result = old_result
+        try:
+            if old_disk is None:
+                os.unlink(cache_path)
+            else:
+                with open(cache_path, "wb") as f:
+                    f.write(old_disk)
+        except OSError:
+            pass
