@@ -297,9 +297,18 @@ async def _put_cluster_bench(tmp: str, platform: str, nblocks: int,
     feeder_perf = {**managers[0].feeder.perf_summary(),
                    **{f"scrub_{k2}": v for k2, v in
                       mgr1.feeder.perf_summary().items()}}
+    # wire+disk bytes per 1 MiB block: the erasure path's structural
+    # advantage (k+m shards of 1/k each vs `factor` whole copies) that
+    # an in-process loopback bench cannot price — on a real network and
+    # disks, replicate-3 moves 2x the bytes RS(4,2) does
+    if erasure:
+        wire = (k + m) * ((block_len + k - 1) // k + 16) / (1 << 20)
+    else:
+        wire = 3.0
     await _teardown(systems + [s1], managers + [mgr1], tasks)
     return {
         "put_gbps": round(put_gbps, 3),
+        "put_wire_mib_per_block": round(wire, 2),
         "scrub_blocks_per_s": round(scrub_bps, 1),
         "scrub_corrupt": bad,
         "feeder_device_items": feeder_stats["device_items"],
@@ -713,6 +722,8 @@ def main() -> None:
         extra["cpu_put_error"] = seg["error"]
     else:
         extra["cpu_put_gbps"] = seg["put_gbps"]
+        extra["cpu_put_wire_mib_per_block"] = seg.get(
+            "put_wire_mib_per_block")
         extra["cpu_scrub_blocks_per_s"] = seg["scrub_blocks_per_s"]
         if extra.get("put_gbps"):
             extra["put_vs_cpu_baseline"] = round(
