@@ -53,6 +53,24 @@ import time
 import numpy as np
 
 
+def _best_of_reps(run_chain, amount: float, unit_div: float,
+                  slow_below: float, platform: str, reps: int = 4) -> float:
+    """Best-of-N timing SPREAD OVER TIME: the dev tunnel is co-tenant
+    noisy on the scale of minutes, so back-to-back reps all land in the
+    same congestion window; sleeping between slow reps samples several
+    windows. run_chain() executes one full dependency chain including
+    its end-of-chain sync; the rate is amount/unit_div per second."""
+    best = 0.0
+    for rep in range(reps):
+        t0 = time.perf_counter()
+        run_chain()
+        dt = time.perf_counter() - t0
+        best = max(best, amount / unit_div / dt)
+        if platform != "cpu" and rep < reps - 1 and best < slow_below:
+            time.sleep(8.0)
+    return best
+
+
 def bench_rs_encode(jax, platform: str) -> float:
     """Sustained RS(10,4) encode GB/s, measured with a DEPENDENCY CHAIN:
     each iteration's input folds in the previous parity, so iterations
@@ -84,21 +102,15 @@ def bench_rs_encode(jax, platform: str) -> float:
 
     x = step(data)  # compile + warm
     _ = np.asarray(x[0, 0, :8])
-    best = 0.0
-    # best-of-N SPREAD OVER TIME: the dev tunnel is co-tenant noisy on
-    # the scale of minutes, so back-to-back reps all land in the same
-    # congestion window; sleeping between reps samples several windows
-    for _rep in range(4):
-        t0 = time.perf_counter()
+
+    def chain():
         x = data
         for _ in range(iters):
             x = step(x)
         _ = np.asarray(x[0, 0, :8])  # one tiny d2h: full-chain completion
-        dt = time.perf_counter() - t0
-        best = max(best, batch * k * shard_len * iters / dt / 1e9)
-        if platform != "cpu" and _rep < 3 and best < 8.0:
-            time.sleep(8.0)
-    return best
+
+    return _best_of_reps(chain, batch * k * shard_len * iters, 1e9, 8.0,
+                         platform)
 
 
 def bench_blake3(jax, platform: str) -> tuple[float, float]:
@@ -139,17 +151,73 @@ def bench_blake3(jax, platform: str) -> tuple[float, float]:
 
     x = step(rows)
     x.block_until_ready()
-    best = 0.0
-    for _rep in range(4):  # best-of-N across congestion windows
-        t0 = time.perf_counter()
+
+    def chain():
+        nonlocal x
         for _ in range(iters):
             x = step(x)
         x.block_until_ready()
-        dt = time.perf_counter() - t0
-        best = max(best, batch * (1 << 20) * iters / dt / 1e9)
-        if platform != "cpu" and _rep < 3 and best < 1.5:
-            time.sleep(8.0)
+
+    best = _best_of_reps(chain, batch * (1 << 20) * iters, 1e9, 1.5,
+                         platform)
     return e2e, best
+
+
+def bench_scrub_kernel(jax, platform: str) -> float:
+    """Device-resident parity-check scrub DETECT rate, in logical
+    1 MiB blocks/s (VERDICT r4 next-round #2: a driver-captured number
+    behind the "scrub ≥10×" kernel claim, not just DEVICE_PATH.md's
+    writeup).
+
+    This is the PRODUCT deep-scrub detect kernel
+    (ScrubWorker._deep_scrub -> feeder.parity_check ->
+    ops/rs.parity_check): re-derive the m parity shards from the k
+    stored data shards (GF(2^8) bit-matmul — the same kernel as the
+    encode headline) and compare with the stored parity; any
+    single-shard corruption flips every parity row, so a clean compare
+    certifies the stripe without per-shard hashing. Localization +
+    repair (decode + content-hash, ScrubWorker._repair_stripe) run
+    host-side only on flagged stripes. Chained like bench_rs_encode:
+    each iteration's data folds in the previous verdict, so iterations
+    cannot overlap and one end-of-chain sync times `iters` sequential
+    passes. blocks/s counts logical pre-encode bytes (k·S) in MiB."""
+    import jax.numpy as jnp
+
+    from garage_tpu.ops import gf256, rs
+
+    k, m = 10, 4
+    if platform == "cpu":
+        shard_len, batch, iters = 1 << 16, 4, 3
+    else:
+        shard_len, batch, iters = 1 << 20, 8, 20  # 80 MiB data per step
+    parity_bits = gf256.bitmat_t_for(rs.parity_matrix(k, m))
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, size=(batch, k, shard_len), dtype=np.uint8)
+    parity = rs.encode(k, m, data)
+    shards = jnp.concatenate([jnp.asarray(data), parity], axis=1)
+
+    @jax.jit
+    def step(x):
+        d = x[:, :k, :]
+        p2 = gf256.bit_matmul_apply(parity_bits, d)
+        bad = jnp.any(p2 != x[:, k:, :], axis=(1, 2))  # (B,) detect verdict
+        # fold the verdict into the data so the next iteration depends
+        # on this one (same discipline as bench_rs_encode); stored
+        # parity becomes p2 so the compare work never degenerates
+        fold = bad.astype(jnp.uint8)[:, None, None]
+        return jnp.concatenate([d ^ fold, p2], axis=1)
+
+    x = step(shards)  # compile + warm
+    _ = np.asarray(x[0, 0, :8])
+
+    def chain():
+        x = shards
+        for _ in range(iters):
+            x = step(x)
+        _ = np.asarray(x[0, 0, :8])
+
+    return _best_of_reps(chain, batch * k * shard_len * iters, 1 << 20,
+                         4000, platform)
 
 
 async def _build_cluster(tmp: str, n: int, rm, device_mode: str,
@@ -625,6 +693,17 @@ def main() -> None:
         extra["blake3_device_gbps"] = round(b3_dev, 3)
     if native_b3 is not None:
         extra["blake3_native_host_gbps"] = native_b3
+    try:
+        sk = round(bench_scrub_kernel(jax, platform), 1)
+        if platform == "cpu":
+            # TPU kernel on the host jax backend — label it so the
+            # number can't be read as a device rate (same rule as the
+            # blake3 relabeling above)
+            extra["scrub_kernel_jax_on_host_blocks_per_s"] = sk
+        else:
+            extra["scrub_kernel_blocks_per_s"] = sk
+    except Exception as e:
+        extra["scrub_kernel_error"] = f"{type(e).__name__}: {e}"[:300]
     if platform == "cpu":
         maybe_reexec_on_device()
 
@@ -731,6 +810,13 @@ def main() -> None:
         if extra.get("scrub_blocks_per_s"):
             extra["scrub_vs_cpu_baseline"] = round(
                 extra["scrub_blocks_per_s"]
+                / max(seg["scrub_blocks_per_s"], 1e-9), 2)
+        if extra.get("scrub_kernel_blocks_per_s") and platform != "cpu":
+            # the driver-captured form of the "scrub ≥10×" claim:
+            # device-resident detect kernel vs the measured host
+            # replicate-3 hash-scrub baseline in the SAME run
+            extra["scrub_kernel_vs_cpu_baseline"] = round(
+                extra["scrub_kernel_blocks_per_s"]
                 / max(seg["scrub_blocks_per_s"], 1e-9), 2)
 
     print(json.dumps({

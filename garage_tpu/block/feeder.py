@@ -561,6 +561,32 @@ class DeviceFeeder:
         futs = [self._submit("verify", (h, d)) for h, d in items]
         return list(await asyncio.gather(*futs))
 
+    async def parity_check(self, stripes: list[list[bytes]]) -> list[bool]:
+        """Scrub deep pass: per-stripe cross-shard consistency. Each
+        stripe is the full [k data + m parity] shard payload list
+        (equal lengths within one stripe). True = the stored parity
+        rows equal parity re-derived from the data rows — any single
+        corrupt shard flips every parity row (ops/rs.parity_check on
+        the device route; native GF matmul compare on the host
+        route)."""
+        if self.codec is None:
+            raise RuntimeError("feeder has no codec")
+        if not stripes:
+            return []
+        if self._host_inline_ok("parity"):
+            # already batched; one thread handoff amortized over the
+            # whole multi-MiB native call (same shape as verify_blocks)
+            self.stats["inline_items"] += len(stripes)
+            t0 = time.perf_counter()
+            out = await asyncio.to_thread(self._do_parity_check, stripes,
+                                          "host")
+            self._record("parity", "host",
+                         sum(len(b) for s in stripes for b in s),
+                         time.perf_counter() - t0)
+            return out
+        futs = [self._submit("parity_check", s) for s in stripes]
+        return list(await asyncio.gather(*futs))
+
     # ---- dispatcher ----------------------------------------------------
 
     async def _run(self) -> None:
@@ -685,12 +711,15 @@ class DeviceFeeder:
         for op, idxs in by_op.items():
             if op in ("verify", "encode_put", "hash_md5"):  # 2-tuples
                 total = sum(len(batch[i].data[1]) for i in idxs)
+            elif op == "parity_check":  # item = one stripe (shard list)
+                total = sum(len(b) for i in idxs for b in batch[i].data)
             else:
                 total = sum(len(batch[i].data) for i in idxs
                             if isinstance(batch[i].data,
                                           (bytes, bytearray)))
             perf_op = ("hash" if op in ("verify", "hash_md5") else
-                       "encode" if op == "encode_put" else op)
+                       "encode" if op == "encode_put" else
+                       "parity" if op == "parity_check" else op)
             host_only = force_host
             if perf_op == "hash":
                 from ..utils import data as _data
@@ -728,8 +757,11 @@ class DeviceFeeder:
             d = batch[i].data
             if op in ("verify", "encode_put", "hash_md5"):
                 d = d[1]
-            size += len(d) if isinstance(d, (bytes, bytearray,
-                                             memoryview)) else 0
+            if op == "parity_check":
+                size += sum(len(b) for b in d)
+            else:
+                size += len(d) if isinstance(d, (bytes, bytearray,
+                                                 memoryview)) else 0
             cut += 1
         return cut
 
@@ -738,6 +770,8 @@ class DeviceFeeder:
         blobs = [batch[i].data for i in idxs]
         if op in ("verify", "encode_put", "hash_md5"):  # 2-tuples
             total = sum(len(b) for _, b in blobs)
+        elif op == "parity_check":
+            total = sum(len(b) for s in blobs for b in s)
         else:
             total = sum(len(b) for b in blobs
                         if isinstance(b, (bytes, bytearray)))
@@ -793,6 +827,8 @@ class DeviceFeeder:
             return self._do_encode(blobs, backend)
         if op == "encode_put":
             return self._do_encode_put(blobs, backend)
+        if op == "parity_check":
+            return self._do_parity_check(blobs, backend)
         raise RuntimeError(f"unknown feeder op {op!r}")
 
     def _do_hash(self, blobs: list[bytes], backend: str) -> list[bytes]:
@@ -874,4 +910,42 @@ class DeviceFeeder:
             parity = rs.encode_np(codec.k, codec.m, shards)
             out.append([bytes(s) for s in shards]
                        + [bytes(p) for p in parity])
+        return out
+
+    def _do_parity_check(self, stripes: list[list[bytes]], backend: str
+                         ) -> list[bool]:
+        """stripes = [[k data + m parity shard payloads]] -> per-stripe
+        consistency verdicts. Device: one padded (B, k+m, S) batch
+        through the encode bit-matmul + compare (zero padding is safe:
+        the code is linear). Host: native GF matmul per stripe, numpy
+        as last resort — same no-JAX-on-host rule as _do_encode."""
+        from ..ops import rs
+
+        codec = self.codec
+        k, m = codec.k, codec.m
+        if backend == "device":
+            smax = max(len(s[0]) for s in stripes)
+            arr = np.zeros((len(stripes), k + m, smax), dtype=np.uint8)
+            for i, s in enumerate(stripes):
+                for j, b in enumerate(s):
+                    arr[i, j, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+            return [bool(v) for v in np.asarray(rs.parity_check(k, m, arr))]
+        pmat = rs.parity_matrix(k, m)
+        native_mod = None
+        try:
+            from .. import native
+
+            if native.available():
+                native_mod = native
+        except Exception:
+            pass
+        out = []
+        for s in stripes:
+            data = np.stack(
+                [np.frombuffer(b, dtype=np.uint8) for b in s[:k]])
+            parity = (native_mod.gf_matmul(pmat, data)
+                      if native_mod is not None
+                      else rs.encode_np(k, m, data))
+            out.append(all(bytes(parity[j]) == bytes(s[k + j])
+                           for j in range(m)))
         return out

@@ -150,14 +150,20 @@ class ScrubWorker(Worker):
 
         Whole blocks verify as ONE batched content-hash pass through the
         device feeder (the TPU replacement for the reference's
-        block-at-a-time rehash loop, src/block/repair.rs:169-528);
-        erasure shards verify their per-shard header checksums host-side
-        (cheap blake2 over the shard file)."""
+        block-at-a-time rehash loop, src/block/repair.rs:169-528).
+        Erasure blocks get two passes: per-shard header checksums
+        host-side (cheap, catches local bit rot), then the cross-shard
+        DEEP pass (_deep_scrub) — stripes gathered by their scrub
+        leader and parity-checked in feeder batches, which catches a
+        shard that is internally consistent but WRONG, the class of
+        corruption the reference's whole-block rehash would see and
+        per-shard checksums cannot."""
         m = self.manager
         if m.erasure:
-            return await asyncio.to_thread(
+            bad = await asyncio.to_thread(
                 lambda: sum(0 if self._scrub_shards(h) else 1 for h in batch)
             )
+            return bad + await self._deep_scrub(batch)
 
         def read_all():
             out = []
@@ -200,6 +206,129 @@ class ScrubWorker(Worker):
             if m.read_local_shard(hash32, part) is None:
                 ok = False
         return ok
+
+    async def _deep_scrub(self, batch: list[bytes]) -> int:
+        """Cross-shard parity detect + repair for erasure stripes.
+
+        Per-shard header checksums only certify each shard file against
+        itself; a shard that passes its own checksum but holds the
+        wrong bytes (aborted overwrite, misplaced file, buggy writer)
+        silently poisons a future decode. The stripe's scrub LEADER —
+        first node of its placement, so exactly one node pays the
+        gather per pass — fetches all width shards and batches them
+        through feeder.parity_check: parity re-derivation (the encode
+        bit-matmul) flags any inconsistent stripe in one device pass.
+        Localization + repair run host-side only on flagged stripes
+        (_repair_stripe). Blocks with missing shards are skipped here:
+        absence is resync/repair's job, and parity over a partial
+        stripe cannot tell loss from corruption."""
+        from .codec import shard_nodes_of
+
+        m = self.manager
+        me = m.system.id
+        v = m.system.layout_helper.current()
+        stripes, metas = [], []
+        for h in batch:
+            placement = shard_nodes_of(v, h, m.codec.width)
+            if not placement or placement[0] != me:
+                continue
+            got = await m._gather_parts(h, placement, m.codec.width)
+            if got is None:
+                continue
+            parts, packed_len = got
+            stripes.append([parts[i] for i in range(m.codec.width)])
+            metas.append((h, parts, packed_len, placement))
+        if not stripes:
+            return 0
+        oks = await m.feeder.parity_check(stripes)
+        bad = 0
+        for ok, (h, parts, packed_len, placement) in zip(oks, metas):
+            if ok:
+                continue
+            bad += 1
+            repaired = await self._repair_stripe(h, parts, packed_len,
+                                                 placement)
+            log.warning("deep scrub: stripe %s parity-inconsistent (%s)",
+                        h.hex()[:16],
+                        "repaired" if repaired else "NOT repaired")
+        return bad
+
+    async def _repair_stripe(self, hash32: bytes, parts: dict[int, bytes],
+                             packed_len: int, placement: list[bytes]
+                             ) -> bool:
+        """Find + fix the corrupt shard(s) of a parity-inconsistent
+        stripe. Ground truth is the block's content address: a decode
+        from a candidate k-subset is right iff the unpacked block
+        hashes to hash32. Tries the all-systematic subset, then each
+        single-data-shard exclusion (covers any single corrupt shard,
+        the overwhelmingly likely case); the corrected stripe is
+        re-encoded and every differing shard pushed to its holder
+        through the normal shard-put path (validate + tmp/rename
+        replace)."""
+        from ..net.message import PRIO_BACKGROUND
+        from .block import DataBlock
+        from .manager import unpack_shard
+
+        m = self.manager
+        codec = m.codec
+        k, w = codec.k, codec.width
+
+        def try_subset(idx: tuple[int, ...]):
+            # decode stays host-side (numpy) on purpose: localization
+            # runs inside the scrub worker and a dead device must never
+            # wedge it — the batched detect above already rides the
+            # feeder's watchdogs
+            import numpy as _np
+
+            from ..ops import rs
+
+            try:
+                if all(i < k for i in idx):
+                    packed = codec.decode({i: parts[i] for i in idx},
+                                          packed_len)  # pure concat
+                else:
+                    shards = _np.stack(
+                        [_np.frombuffer(parts[i], dtype=_np.uint8)
+                         for i in idx])
+                    data = rs.decode_np(k, codec.m, idx, shards)
+                    packed = rs.join_stripe(data, packed_len)
+                blk = DataBlock.unpack(packed)
+                blk.verify(hash32)
+                return packed
+            except Exception:
+                return None
+
+        candidates = [tuple(range(k))]
+        for drop in range(k):
+            candidates.append(tuple(i for i in range(k) if i != drop)
+                              + (k,))
+        good_packed = None
+        for idx in candidates:
+            good_packed = await asyncio.to_thread(try_subset, idx)
+            if good_packed is not None:
+                break
+        if good_packed is None:
+            # >1 corrupt shard (or corrupt beyond what single-exclusion
+            # finds): leave the files for operator repair; the count is
+            # already in the scrub stats
+            return False
+        framed = await m.feeder.encode_put(good_packed)
+        fixed = True
+        for i, node in enumerate(placement[:w]):
+            raw = bytes(framed[i])
+            if unpack_shard(raw)[0] == parts[i]:
+                continue
+            try:
+                await m.endpoint.call(
+                    node, {"op": "put", "hash": hash32, "part": i,
+                           "data": raw},
+                    PRIO_BACKGROUND, timeout=60.0)
+            except Exception as e:
+                log.warning("deep scrub: pushing repaired shard %d of %s "
+                            "to %s failed (%s)", i, hash32.hex()[:16],
+                            node.hex()[:8], e)
+                fixed = False
+        return fixed
 
     async def wait_for_work(self):
         await asyncio.sleep(60.0)

@@ -197,6 +197,10 @@ def bit_matmul_apply(bitmat_t, x):
 
 
 def bitmat_t_for(a: np.ndarray):
-    """Device constant for bit_matmul_apply: expand_bitmatrix(a).T as int8."""
-    jnp = _jnp()
-    return jnp.asarray(expand_bitmatrix(a).T.astype(np.int8))
+    """Constant operand for bit_matmul_apply: expand_bitmatrix(a).T as
+    int8. Returned as NUMPY on purpose: callers may be lru-cached
+    builders (rs._jit_apply) whose first invocation can happen inside
+    ANOTHER jit trace — a device array created there is a leaked tracer
+    once the closure is cached. XLA constant-folds the numpy operand at
+    trace time either way."""
+    return expand_bitmatrix(a).T.astype(np.int8)

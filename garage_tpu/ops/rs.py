@@ -152,6 +152,28 @@ def repair(k: int, m: int, present: tuple[int, ...], missing: tuple[int, ...], s
     return _apply(f"rep{k},{m},{present},{missing}", mat, shards)
 
 
+@functools.lru_cache(maxsize=None)
+def _jit_parity_check(k: int, m: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def chk(stripes):
+        p2 = encode(k, m, stripes[:, :k, :])
+        return jnp.all(p2 == stripes[:, k:, :], axis=(1, 2))
+
+    return chk
+
+
+def parity_check(k: int, m: int, stripes):
+    """stripes (B, k+m, n) uint8 -> (B,) bool: stored parity equals
+    parity re-derived from the data shards — ONE fused device pass (the
+    scrub detect kernel; any single corrupt shard flips every parity
+    row). Zero-padding stripes to a common n is safe: the code is
+    linear, so zero data rows encode to zero parity rows."""
+    return _jit_parity_check(k, m)(stripes)
+
+
 # ---------------------------------------------------------------------------
 # Host (numpy) reference + small-input fallback
 # ---------------------------------------------------------------------------

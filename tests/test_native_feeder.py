@@ -623,3 +623,31 @@ def test_probe_cache_poison_and_require_override():
                     f.write(old_disk)
         except OSError:
             pass
+
+
+def test_parity_check_backends_agree_and_detect():
+    """_do_parity_check host (native/numpy) and device (padded jax
+    batch) agree, and both flag a stripe with one corrupted shard —
+    mixed shard lengths in one batch exercise the zero-padding rule
+    (linear code: zero rows encode to zero parity)."""
+    from garage_tpu.block.codec import ErasureCodec
+
+    codec = ErasureCodec(4, 2, use_jax=False)
+    f = DeviceFeeder(codec=codec, mode="off")
+    rng = np.random.default_rng(5)
+    stripes = []
+    for n in (1024, 65536, 100_000):
+        block = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+        stripes.append(codec.encode(block))
+    s = list(stripes[1])
+    s[2] = bytes(b ^ 1 for b in s[2])
+    stripes[1] = s
+    want = [True, False, True]
+    assert f._do_parity_check(stripes, "host") == want
+    assert f._do_parity_check(stripes, "device") == want
+
+    async def go():
+        assert await f.parity_check(stripes) == want
+        await f.stop()
+
+    run(go())
