@@ -240,6 +240,11 @@ class BlockManager:
                     ValueError("read-only variable")),
             )
 
+            def set_deep(v):
+                sw.deep = v.lower() in ("1", "true", "yes")
+
+            vars.register_rw("scrub-deep", lambda: int(sw.deep), set_deep)
+
     async def stop(self) -> None:
         await self.feeder.stop()
 
@@ -414,7 +419,7 @@ class BlockManager:
                 return None
 
         parts: dict[int, bytes] = {}
-        packed_len = None
+        lens: list[int] = []
         order = list(enumerate(placement))  # systematic first by design
         i = 0
         pending: dict[asyncio.Task, int] = {}
@@ -433,9 +438,16 @@ class BlockManager:
                 r = t.result()
                 if r is not None:
                     parts[idx] = r[0]
-                    packed_len = r[1]
+                    lens.append(r[1])
         if len(parts) < need:
             return None
+        # MAJORITY packed_len, not last-arrival: the shard header's
+        # length field is outside the shard checksum, so one rotted or
+        # forged header must not poison the whole decode (deep-scrub
+        # repair decodes candidate subsets against this value; the read
+        # path would fail content verification and miss a recoverable
+        # block). With <= m corrupt shards the majority is the truth.
+        packed_len = max(set(lens), key=lens.count)
         return parts, packed_len
 
     # ==== refcount hooks (called from block_ref table trigger) ==========

@@ -64,6 +64,12 @@ class ScrubWorker(Worker):
         self._jitter = random.random() * 0.4 + 0.8  # ±20%
         self._iter = None  # live sorted walk; rebuilt from cursor on restart
         self._pending_cmd: str | None = None
+        # erasure deep pass toggle (runtime-tunable: `worker set
+        # scrub-deep 0` turns off the per-stripe gather on clusters
+        # where scrub bandwidth matters more than wrong-shard detection)
+        self.deep = True
+        self.deep_checked = 0  # stripes parity-checked as leader
+        self.deep_repaired = 0  # flagged stripes fully repaired
 
     def _due(self) -> bool:
         return (time.time() - self.state.last_completed
@@ -163,7 +169,9 @@ class ScrubWorker(Worker):
             bad = await asyncio.to_thread(
                 lambda: sum(0 if self._scrub_shards(h) else 1 for h in batch)
             )
-            return bad + await self._deep_scrub(batch)
+            if self.deep:
+                bad += await self._deep_scrub(batch)
+            return bad
 
         def read_all():
             out = []
@@ -227,28 +235,44 @@ class ScrubWorker(Worker):
         m = self.manager
         me = m.system.id
         v = m.system.layout_helper.current()
-        stripes, metas = [], []
+        leaders = []
         for h in batch:
             placement = shard_nodes_of(v, h, m.codec.width)
-            if not placement or placement[0] != me:
-                continue
-            got = await m._gather_parts(h, placement, m.codec.width)
+            if placement and placement[0] == me:
+                leaders.append((h, placement))
+        if not leaders:
+            return 0
+        # stripe gathers are independent: run them concurrently so a
+        # slow holder costs the batch max(latency), not the sum
+        gathered = await asyncio.gather(
+            *[m._gather_parts(h, p, m.codec.width) for h, p in leaders])
+        stripes, metas, flagged = [], [], []
+        for (h, placement), got in zip(leaders, gathered):
             if got is None:
                 continue
             parts, packed_len = got
-            stripes.append([parts[i] for i in range(m.codec.width)])
-            metas.append((h, parts, packed_len, placement))
-        if not stripes:
-            return 0
-        oks = await m.feeder.parity_check(stripes)
-        bad = 0
-        for ok, (h, parts, packed_len, placement) in zip(oks, metas):
-            if ok:
+            self.deep_checked += 1
+            stripe = [parts[i] for i in range(m.codec.width)]
+            if len({len(s) for s in stripe}) != 1:
+                # unequal shard lengths ARE the inconsistency (e.g. a
+                # misplaced shard of another block): flag straight to
+                # repair — stacking them would crash parity_check and a
+                # deterministic raise here would wedge the scrub cursor
+                # on this batch forever
+                flagged.append((h, parts, packed_len, placement))
                 continue
+            stripes.append(stripe)
+            metas.append((h, parts, packed_len, placement))
+        if stripes:
+            oks = await m.feeder.parity_check(stripes)
+            flagged.extend(meta for ok, meta in zip(oks, metas) if not ok)
+        bad = 0
+        for h, parts, packed_len, placement in flagged:
             bad += 1
             repaired = await self._repair_stripe(h, parts, packed_len,
                                                  placement)
-            log.warning("deep scrub: stripe %s parity-inconsistent (%s)",
+            self.deep_repaired += bool(repaired)
+            log.warning("deep scrub: stripe %s inconsistent (%s)",
                         h.hex()[:16],
                         "repaired" if repaired else "NOT repaired")
         return bad
@@ -336,9 +360,13 @@ class ScrubWorker(Worker):
     def info(self):
         from ..utils.background import WorkerInfo
 
+        cursor = self.state.cursor[:4].hex() if self.state.cursor else "-"
+        if self.manager.erasure and self.deep:
+            cursor += (f" deep:{self.deep_checked}"
+                       f"/{self.deep_repaired} repaired")
         return WorkerInfo(
             name=self.name,
-            progress=self.state.cursor[:4].hex() if self.state.cursor else "-",
+            progress=cursor,
             tranquility=int(self.state.tranquility),
         )
 

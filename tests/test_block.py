@@ -438,3 +438,53 @@ def test_deep_scrub_detects_and_repairs_forged_shard(tmp_path):
             await stop_all(systems, tasks)
 
     run(main())
+
+
+def test_deep_scrub_repairs_wrong_length_shard(tmp_path):
+    """The misplaced-file class: a shard file holding a valid-framed
+    shard of a DIFFERENT block (different length, different packed_len
+    header) passes local validation; deep scrub must flag it WITHOUT
+    crashing the batch (unequal lengths can't stack into the parity
+    kernel), repair it, and the majority packed_len rule must keep the
+    corrupt header from poisoning the localization decode."""
+    async def main():
+        from garage_tpu.block import ScrubWorker
+
+        net, systems, managers, tasks = await make_block_cluster(
+            tmp_path, n=6, rf=3, erasure=(4, 2)
+        )
+        try:
+            data = os.urandom(150_000)
+            h = blake2sum(data)
+            await managers[0].rpc_put_block(h, data)
+            for _ in range(100):
+                held = sorted(i for m in managers for i in m.local_parts(h))
+                if held == [0, 1, 2, 3, 4, 5]:
+                    break
+                await asyncio.sleep(0.02)
+            assert held == [0, 1, 2, 3, 4, 5]
+
+            layout = systems[0].layout_helper.current()
+            placement = shard_nodes_of(layout, h, 6)
+            leader = next(m for m in managers
+                          if m.system.id == placement[0])
+
+            victim = next(m for m in managers if 3 in m.local_parts(h))
+            true_raw = victim.read_local_shard(h, 3)
+            true_payload, _ = unpack_shard(true_raw)
+            # a stray shard: wrong length AND wrong packed_len header
+            stray = pack_shard(os.urandom(len(true_payload) + 512),
+                               999_999)
+            victim.write_local_shard(h, 3, stray)
+
+            sw = ScrubWorker(leader)
+            bad = await sw.scrub_batch([h])
+            assert bad == 1
+            fixed, _ = unpack_shard(victim.read_local_shard(h, 3))
+            assert fixed == true_payload
+            assert await sw.scrub_batch([h]) == 0
+            assert await managers[1].rpc_get_block(h) == data
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())

@@ -1059,6 +1059,12 @@ def test_cli_worker_get_set(server):
     assert "2.5" in out
     out = server.cli("worker", "get", "resync-tranquility")
     assert "2.5" in out
+    # erasure deep-scrub toggle (runtime-only)
+    out = server.cli("worker", "get", "scrub-deep")
+    assert "1" in out
+    out = server.cli("worker", "set", "scrub-deep", "0")
+    out = server.cli("worker", "get", "scrub-deep")
+    assert "0" in out
 
 
 def test_cli_repair_and_block_ops(server, client):
