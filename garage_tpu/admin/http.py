@@ -438,6 +438,16 @@ class AdminHttpServer:
               "Number of blocks in the resync queue")
         gauge("block_resync_errored_blocks",
               g.block_manager.resync.errors_len())
+        sw = g.block_manager.scrub_worker
+        if sw is not None:
+            out.append("# HELP block_scrub_corruptions "
+                       "Corruptions found across all scrub passes")
+            out.append("# TYPE block_scrub_corruptions counter")
+            gauge("block_scrub_corruptions", sw.state.corruptions)
+            out.append("# TYPE block_scrub_deep_stripes_checked counter")
+            gauge("block_scrub_deep_stripes_checked", sw.deep_checked)
+            out.append("# TYPE block_scrub_deep_stripes_repaired counter")
+            gauge("block_scrub_deep_stripes_repaired", sw.deep_repaired)
 
         for t in g.all_tables():
             s = t.data.stats()

@@ -323,9 +323,21 @@ class ScrubWorker(Worker):
                 return None
 
         candidates = [tuple(range(k))]
-        for drop in range(k):
-            candidates.append(tuple(i for i in range(k) if i != drop)
-                              + (k,))
+        # one corrupt data shard, substituted by EACH parity shard in
+        # turn: trying every parity keeps a simultaneously-corrupt
+        # parity shard from blocking the substitution, so a
+        # data+parity double corruption still localizes (re-encode then
+        # fixes both). Two corrupt DATA shards stay out of scope — the
+        # pair search is combinatorial and the reference repairs
+        # nothing in this class at all.
+        # parity-OUTER order: the full single-corruption sweep with
+        # parity k runs first (the common case succeeds within k+1
+        # candidates), and only then the other parities sweep for the
+        # data+parity double-corruption case
+        for p in range(k, w):
+            for drop in range(k):
+                candidates.append(tuple(i for i in range(k) if i != drop)
+                                  + (p,))
         good_packed = None
         for idx in candidates:
             good_packed = await asyncio.to_thread(try_subset, idx)

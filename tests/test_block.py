@@ -488,3 +488,54 @@ def test_deep_scrub_repairs_wrong_length_shard(tmp_path):
             await stop_all(systems, tasks)
 
     run(main())
+
+
+def test_deep_scrub_repairs_data_plus_parity_double_corruption(tmp_path):
+    """RS(4,2) tolerates two losses; deep scrub localizes a double
+    corruption of one DATA and one PARITY shard: the data exclusion
+    must substitute the *healthy* parity shard (trying each in turn),
+    then the re-encode fixes both."""
+    async def main():
+        from garage_tpu.block import ScrubWorker
+
+        net, systems, managers, tasks = await make_block_cluster(
+            tmp_path, n=6, rf=3, erasure=(4, 2)
+        )
+        try:
+            data = os.urandom(180_000)
+            h = blake2sum(data)
+            await managers[0].rpc_put_block(h, data)
+            for _ in range(100):
+                held = sorted(i for m in managers for i in m.local_parts(h))
+                if held == [0, 1, 2, 3, 4, 5]:
+                    break
+                await asyncio.sleep(0.02)
+            assert held == [0, 1, 2, 3, 4, 5]
+
+            layout = systems[0].layout_helper.current()
+            placement = shard_nodes_of(layout, h, 6)
+            leader = next(m for m in managers
+                          if m.system.id == placement[0])
+
+            originals = {}
+            for part in (2, 4):  # data shard 2, parity shard 4
+                holder = next(m for m in managers
+                              if part in m.local_parts(h))
+                payload, plen = unpack_shard(
+                    holder.read_local_shard(h, part))
+                originals[part] = (holder, payload)
+                forged = bytes(b ^ 0xA5 for b in payload[:128]) \
+                    + payload[128:]
+                holder.write_local_shard(h, part, pack_shard(forged, plen))
+
+            sw = ScrubWorker(leader)
+            assert await sw.scrub_batch([h]) == 1
+            for part, (holder, payload) in originals.items():
+                fixed, _ = unpack_shard(holder.read_local_shard(h, part))
+                assert fixed == payload, f"shard {part} not repaired"
+            assert await sw.scrub_batch([h]) == 0
+            assert await managers[0].rpc_get_block(h) == data
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())

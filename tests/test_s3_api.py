@@ -1338,6 +1338,8 @@ def test_metrics_exposition(server, client):
     assert "block_corruptions" in text
     assert "block_resync_queue_length" in text
     assert "block_resync_errored_blocks" in text
+    assert "block_scrub_corruptions" in text
+    assert "block_scrub_deep_stripes_checked" in text
     assert 'table_size_bytes{table="object"}' in text
     assert 'table_rows{table="object"}' in text
     assert "cluster_node_up" in text
