@@ -37,7 +37,11 @@ async def handle_insert_batch(ctx, req: Request) -> Response:
             ct = (parse_causality_token(it["ct"])
                   if it.get("ct") else None)
             v = it.get("v")
-            value = base64.b64decode(v) if v is not None else None
+            # strict base64 like the reference (batch.rs:30-33): a
+            # value with out-of-alphabet bytes is a client bug, not
+            # data to silently mangle
+            value = (base64.b64decode(v, validate=True)
+                     if v is not None else None)
         except (KeyError, TypeError, ValueError):
             raise S3Error("InvalidRequest", 400, "malformed batch item")
         items.append((pk, sk, ct, value))
@@ -45,9 +49,30 @@ async def handle_insert_batch(ctx, req: Request) -> Response:
     return Response(204)
 
 
+def check_start_in_prefix(start, prefix) -> None:
+    """ref: range.rs:30-40 — a start key outside the prefix window is a
+    contradiction the reference rejects up front (both directions).
+    Non-string values 400 too: the reference rejects them at
+    deserialization, and letting them through turns into a 500 at the
+    first .startswith/.encode."""
+    for v in (start, prefix):
+        if v is not None and not isinstance(v, str):
+            raise S3Error("InvalidRequest", 400,
+                          "prefix/start must be strings")
+    if prefix and start is not None and not start.startswith(prefix):
+        raise S3Error(
+            "InvalidRequest", 400,
+            f"Start key '{start}' does not start with prefix '{prefix}'")
+
+
 def _parse_query(qjson: dict) -> dict:
     if not isinstance(qjson, dict) or "partitionKey" not in qjson:
         raise S3Error("InvalidRequest", 400, "query needs partitionKey")
+    if not isinstance(qjson["partitionKey"], str):
+        raise S3Error("InvalidRequest", 400, "partitionKey must be a string")
+    if qjson.get("end") is not None and not isinstance(qjson["end"], str):
+        raise S3Error("InvalidRequest", 400, "end must be a string")
+    check_start_in_prefix(qjson.get("start"), qjson.get("prefix"))
     raw_limit = qjson.get("limit")
     return {
         "partition_key": qjson["partitionKey"],

@@ -11,6 +11,7 @@ import json
 from ...model.k2v.item_table import BYTES, CONFLICTS, ENTRIES, VALUES
 from ..http import Request, Response
 from ..s3.xml import S3Error
+from .batch import check_start_in_prefix
 
 MAX_LIMIT = 1000
 
@@ -20,6 +21,7 @@ async def handle_read_index(ctx, req: Request) -> Response:
     prefix = q.get("prefix")
     start = q.get("start")
     end = q.get("end")
+    check_start_in_prefix(start, prefix)
     try:
         limit = min(int(q.get("limit", MAX_LIMIT)), MAX_LIMIT)
     except ValueError:

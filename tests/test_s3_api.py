@@ -2340,3 +2340,77 @@ def test_otlp_trace_sink_from_forked_server(tmp_path_factory):
     finally:
         srv.stop()
         col.shutdown()
+
+
+def test_k2v_error_codes(k2v):
+    """ref parity: src/garage/tests/k2v/errorcodes.rs — each malformed
+    request answers 400; the happy-path insert answers 204."""
+    import json as _json
+
+    bkt = k2v.bucket
+
+    def req(method, path, query=None, headers=None, body=b""):
+        st, _, rbody = k2v._req(method, path, query=query,
+                                headers=headers, body=body)
+        return st, rbody
+
+    # regular insert works (204)
+    st, _ = req("PUT", f"/{bkt}/root", query=[("sort_key", "test1")],
+                body=b"Hello, world!")
+    assert st == 204
+
+    # trash causality token on insert
+    st, _ = req("PUT", f"/{bkt}/root", query=[("sort_key", "test1")],
+                headers={"x-garage-causality-token": "tra$sh"},
+                body=b"Hello, world!")
+    assert st == 400
+
+    # search without partitionKey
+    st, _ = req("POST", f"/{bkt}", query=[("search", "")],
+                body=b'[{}]')
+    assert st == 400
+
+    # search whose start does not lie in the prefix (range.rs:30-40)
+    st, _ = req("POST", f"/{bkt}", query=[("search", "")],
+                body=_json.dumps(
+                    [{"partitionKey": "root", "prefix": "a",
+                      "start": "bx"}]).encode())
+    assert st == 400
+
+    # search with invalid json
+    st, _ = req("POST", f"/{bkt}", query=[("search", "")],
+                body=b'[{"partitionKey": "root"')
+    assert st == 400
+
+    # batch insert with invalid causality token
+    st, _ = req("POST", f"/{bkt}",
+                body=b'[{"pk": "root", "sk": "a", "ct": "tra$h",'
+                     b' "v": "aGVsbG8sIHdvcmxkCg=="}]')
+    assert st == 400
+
+    # batch insert with invalid base64 value (strict alphabet)
+    st, _ = req("POST", f"/{bkt}",
+                body=b'[{"pk": "root", "sk": "a", "ct": null,'
+                     b' "v": "aGVsbG8sIHdvcmx$Cg=="}]')
+    assert st == 400
+
+    # poll with invalid causality token
+    st, _ = req("GET", f"/{bkt}/root",
+                query=[("sort_key", "test1"),
+                       ("causality_token", "tra$h"),
+                       ("timeout", "10")])
+    assert st == 400
+
+    # read-index start outside prefix
+    st, _ = req("GET", f"/{bkt}",
+                query=[("prefix", "a"), ("start", "bx")])
+    assert st == 400
+
+    # non-string query fields are a 400 (the reference rejects them at
+    # deserialization), never a 500
+    st, _ = req("POST", f"/{bkt}", query=[("search", "")],
+                body=b'[{"partitionKey": "root", "start": 5}]')
+    assert st == 400
+    st, _ = req("POST", f"/{bkt}", query=[("search", "")],
+                body=b'[{"partitionKey": 7}]')
+    assert st == 400
