@@ -161,3 +161,26 @@ def test_pallas_kernel_matches_numpy_interpret():
     data2 = rng.integers(0, 256, (1, k, 1280), dtype=np.uint8)
     out2 = np.asarray(pallas_gf.encode(k, m, data2, interpret=True))
     assert np.array_equal(out2[0], rs.encode_np(k, m, data2[0]))
+
+
+def test_parity_check_detects_any_single_corruption():
+    """Property: for RS(k,m), flipping ANY single byte of ANY shard
+    (data or parity) makes parity_check report the stripe inconsistent,
+    and only that stripe — the linear code guarantees every non-zero
+    error in one row perturbs at least one parity row."""
+    k, m, S, B = 4, 2, 1024, 6
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, (B, k, S), dtype=np.uint8)
+    parity = np.asarray(rs.encode(k, m, data))
+    clean = np.concatenate([data, parity], axis=1)
+    assert np.asarray(rs.parity_check(k, m, clean)).tolist() == [True] * B
+
+    for _ in range(24):
+        b = int(rng.integers(B))
+        row = int(rng.integers(k + m))
+        col = int(rng.integers(S))
+        bad = clean.copy()
+        bad[b, row, col] ^= int(rng.integers(1, 256))
+        verdict = np.asarray(rs.parity_check(k, m, bad)).tolist()
+        want = [i != b for i in range(B)]
+        assert verdict == want, (b, row, col)
