@@ -539,3 +539,41 @@ def test_deep_scrub_repairs_data_plus_parity_double_corruption(tmp_path):
             await stop_all(systems, tasks)
 
     run(main())
+
+
+def test_deep_scrub_skips_unreachable_stripes(tmp_path):
+    """A down shard holder must not wedge or fail the deep pass: the
+    gather comes back short, the stripe is skipped (absence is
+    resync/repair's job), and the batch completes with 0 corruptions."""
+    async def main():
+        from garage_tpu.block import ScrubWorker
+
+        net, systems, managers, tasks = await make_block_cluster(
+            tmp_path, n=6, rf=3, erasure=(4, 2)
+        )
+        try:
+            data = os.urandom(100_000)
+            h = blake2sum(data)
+            await managers[0].rpc_put_block(h, data)
+            for _ in range(100):
+                held = sorted(i for m in managers for i in m.local_parts(h))
+                if held == [0, 1, 2, 3, 4, 5]:
+                    break
+                await asyncio.sleep(0.02)
+
+            layout = systems[0].layout_helper.current()
+            placement = shard_nodes_of(layout, h, 6)
+            leader = next(m for m in managers
+                          if m.system.id == placement[0])
+            # kill a NON-leader holder
+            downed = next(s for s in systems
+                          if s.id == placement[3])
+            await downed.netapp.shutdown()
+
+            sw = ScrubWorker(leader)
+            assert await asyncio.wait_for(sw.scrub_batch([h]), 30) == 0
+            assert sw.deep_checked == 0  # skipped, not silently passed
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
