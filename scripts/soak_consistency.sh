@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Soak the randomized consistency/chaos suite across seeds: each seed
+# re-runs the multi-writer convergence, partition/heal, layout-storm
+# and shard-migration scenarios with fresh interleavings.
+# Usage: scripts/soak_consistency.sh [first_seed] [n_seeds]
+cd "$(dirname "$0")/.." || exit 1
+first=${1:-1}
+n=${2:-8}
+fails=0
+for ((s = first; s < first + n; s++)); do
+  if GARAGE_TPU_CONSISTENCY_SEED=$s timeout 600 \
+      python -m pytest tests/test_consistency.py -q -x >/tmp/soak_$s.log 2>&1
+  then
+    echo "seed $s: ok"
+  else
+    fails=$((fails + 1))
+    echo "seed $s: FAIL (log: /tmp/soak_$s.log)"
+  fi
+done
+echo "soak done: $n seeds, $fails failures"
+exit $((fails > 0))
