@@ -362,3 +362,30 @@ def test_seen_marker_roundtrip():
     assert m2.is_new("k3", CausalContext({1: 1}))
     assert RangeSeenMarker.parse("!!bad!!") is None
     assert RangeSeenMarker.parse("").seen == {}
+
+
+def test_k2v_poll_item_wakes_on_delete(tmp_path):
+    """ref parity: poll.rs — a DELETE is a change like any other: a
+    poller blocked on the pre-delete causality token must wake and see
+    the tombstone (empty live values), not time out."""
+    async def main():
+        net, garages, tasks = await make_garage_cluster(tmp_path, n=3, rf=3)
+        g0 = garages[0]
+        try:
+            bucket_id = gen_uuid()
+            await g0.k2v_rpc.insert(bucket_id, "p", "k", None, b"v1")
+            item = await g0.k2v_item_table.get(
+                partition_pk(bucket_id, "p"), b"k")
+            ct = item.causal_context()
+
+            task = asyncio.create_task(garages[1].k2v_rpc.poll_item(
+                bucket_id, "p", "k", ct, timeout=20.0))
+            await asyncio.sleep(0.2)
+            assert not task.done()
+            await g0.k2v_rpc.insert(bucket_id, "p", "k", ct, None)  # delete
+            got = await asyncio.wait_for(task, 20.0)
+            assert got is not None and got.live_values() == []
+        finally:
+            await stop_all(garages, tasks)
+
+    run(main())
