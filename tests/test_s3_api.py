@@ -2414,3 +2414,14 @@ def test_k2v_error_codes(k2v):
     st, _ = req("POST", f"/{bkt}", query=[("search", "")],
                 body=b'[{"partitionKey": 7}]')
     assert st == 400
+
+
+def test_cli_stats(server, client):
+    """`garage stats` over admin RPC: table and block-store counters."""
+    import json as _json
+
+    out = server.cli("stats")
+    stats = _json.loads(out)
+    assert "object" in stats["tables"]
+    assert "bytes_written" in stats["block"]
+    assert "resync_queue" in stats
