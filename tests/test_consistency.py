@@ -425,8 +425,16 @@ def test_layout_transition_write_storm(tmp_path):
                     acked.append((key, uuid, ts))
                     await asyncio.sleep(rng.random() * 0.01)
 
+            async def storm_until(n: int, deadline_s: float = 30.0):
+                # condition-based: a fixed window on a loaded box can
+                # ack too few writes to exercise the invariants below
+                deadline = asyncio.get_event_loop().time() + deadline_s
+                while len(acked) < n \
+                        and asyncio.get_event_loop().time() < deadline:
+                    await asyncio.sleep(0.05)
+
             wtasks = [asyncio.create_task(writer(i)) for i in range(4)]
-            await asyncio.sleep(0.5)  # storm against layout v1 first
+            await storm_until(25)  # storm against layout v1 first
 
             # mid-storm transition: + node3, - node1, applied on node 0
             from garage_tpu.rpc.layout import NodeRole
@@ -437,7 +445,7 @@ def test_layout_transition_write_storm(tmp_path):
             lm.history.stage_role(garages[1].system.id, None)
             lm.apply_staged(None)
             # keep storming THROUGH the transition while gossip spreads
-            await asyncio.sleep(1.0)
+            await storm_until(60)
             stop.set()
             await asyncio.gather(*wtasks)
             assert len(acked) > 50
@@ -536,15 +544,26 @@ def test_erasure_layout_transition_shard_migration(tmp_path):
                     i += 1
                     await asyncio.sleep(rng.random() * 0.01)
 
+            async def storm_until(n: int, deadline_s: float = 30.0):
+                # condition-based, not time-based: on a loaded co-tenant
+                # box a fixed window can ack arbitrarily few writes
+                # (soak seeds 135/136 landed 3 in 1.2 s), which starves
+                # the assertions below of material rather than proving
+                # anything about the product
+                deadline = asyncio.get_event_loop().time() + deadline_s
+                while len(blocks) < n \
+                        and asyncio.get_event_loop().time() < deadline:
+                    await asyncio.sleep(0.05)
+
             wtasks = [asyncio.create_task(writer(w)) for w in range(3)]
-            await asyncio.sleep(0.4)  # storm against layout v1
+            await storm_until(6)  # storm against layout v1
 
             lm = garages[0].system.layout_manager
             lm.history.stage_role(garages[6].system.id,
                                   NodeRole(zone="z1", capacity=1 << 30))
             lm.history.stage_role(garages[1].system.id, None)
             lm.apply_staged(None)
-            await asyncio.sleep(0.8)  # storm THROUGH the transition
+            await storm_until(12)  # storm THROUGH the transition
             stop_w.set()
             await asyncio.gather(*wtasks)
             assert len(blocks) > 10
