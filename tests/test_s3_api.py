@@ -2425,3 +2425,36 @@ def test_cli_stats(server, client):
     assert "object" in stats["tables"]
     assert "bytes_written" in stats["block"]
     assert "resync_queue" in stats
+
+
+def test_list_multichar_delimiter(client):
+    """ref parity: list.rs test_multichar_delimiter (garage issue #692,
+    reference results verified against Amazon): a multi-character
+    delimiter folds at every occurrence of the WHOLE delimiter string
+    after the prefix, and keys equal to a fold-point still list."""
+    st, _, b = client.request("PUT", "/multichardelim")
+    assert st == 200, b
+    for k in ("a/", "a/b/", "a/b/c/", "a/b/c/d", "a/c/", "a/c/b/",
+              "a/c/b/e"):
+        st, _, b = client.request("PUT", f"/multichardelim/{k}")
+        assert st == 200, b
+
+    st, _, body = client.request(
+        "GET", "/multichardelim",
+        query=[("list-type", "2"), ("delimiter", "/")])
+    assert st == 200
+    assert xml_find(body, "Key") == []
+    root = ET.fromstring(body)
+    common = [el.find("./{*}Prefix").text for el in root.iter()
+              if el.tag.split("}")[-1] == "CommonPrefixes"]
+    assert common == ["a/"]
+
+    st, _, body = client.request(
+        "GET", "/multichardelim",
+        query=[("list-type", "2"), ("delimiter", "b/")])
+    assert st == 200
+    assert xml_find(body, "Key") == ["a/", "a/c/"]
+    root = ET.fromstring(body)
+    common = [el.find("./{*}Prefix").text for el in root.iter()
+              if el.tag.split("}")[-1] == "CommonPrefixes"]
+    assert common == ["a/b/", "a/c/b/"]
