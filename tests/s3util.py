@@ -155,7 +155,8 @@ class S3Client:
     def put_chunked(self, path: str, chunks: list[bytes],
                     trailer: Optional[tuple[str, str]] = None,
                     corrupt_chunk_sig: bool = False,
-                    extra_headers: Optional[dict[str, str]] = None):
+                    extra_headers: Optional[dict[str, str]] = None,
+                    query: Optional[list[tuple[str, str]]] = None):
         """PUT with aws-chunked signed framing (+ optional signed
         trailer)."""
         mode = ("STREAMING-AWS4-HMAC-SHA256-PAYLOAD-TRAILER" if trailer
@@ -170,7 +171,8 @@ class S3Client:
             headers["x-amz-trailer"] = trailer[0]
         if extra_headers:
             headers.update(extra_headers)
-        headers = self.sign("PUT", path, [], headers, mode, now=now)
+        headers = self.sign("PUT", path, query or [], headers, mode,
+                            now=now)
         seed = headers["authorization"].rsplit("Signature=", 1)[1]
         body = self.chunked_signed_body(chunks, amz_date, seed,
                                         trailer=trailer)
@@ -179,9 +181,13 @@ class S3Client:
             body = (body[:i]
                     + (b"0" if body[i:i + 1] != b"0" else b"1")
                     + body[i + 1:])
+        url = path
+        if query:
+            url += "?" + "&".join(
+                f"{uri_encode(k)}={uri_encode(v)}" for k, v in query)
         conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
         try:
-            conn.request("PUT", path, body=body, headers=headers)
+            conn.request("PUT", url, body=body, headers=headers)
             r = conn.getresponse()
             rbody = r.read()
             return r.status, {k.lower(): v for k, v in r.getheaders()}, rbody

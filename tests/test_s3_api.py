@@ -2458,3 +2458,22 @@ def test_list_multichar_delimiter(client):
     common = [el.find("./{*}Prefix").text for el in root.iter()
               if el.tag.split("}")[-1] == "CommonPrefixes"]
     assert common == ["a/b/", "a/c/b/"]
+
+
+def test_streaming_signature_on_config_endpoints(client):
+    """ref parity: streaming_signature.rs test_create_bucket_streaming /
+    test_put_website_streaming — aws-chunked signed bodies must work on
+    EVERY endpoint, not just object PUT (the body decoder sits below
+    the router)."""
+    # CreateBucket with a chunked (empty) signed body
+    st, _, b = client.put_chunked("/streamcfg", [])
+    assert st == 200, b
+    # PutBucketWebsite with a chunked XML body
+    xml = (b"<WebsiteConfiguration><IndexDocument><Suffix>index.html"
+           b"</Suffix></IndexDocument></WebsiteConfiguration>")
+    st, _, b = client.put_chunked("/streamcfg", [xml],
+                                  query=[("website", "")])
+    assert st in (200, 204), b
+    st, _, body = client.request("GET", "/streamcfg",
+                                 query=[("website", "")])
+    assert st == 200 and b"index.html" in body
