@@ -2477,3 +2477,13 @@ def test_streaming_signature_on_config_endpoints(client):
     st, _, body = client.request("GET", "/streamcfg",
                                  query=[("website", "")])
     assert st == 200 and b"index.html" in body
+
+
+def test_admin_v0_compat_paths(server):
+    """ref parity: router_v0.rs — /v0/* routes serve the same handlers
+    as /v1/*."""
+    st, body = _admin(server, "GET", "/v0/status")
+    assert st == 200
+    assert "garageVersion" in body and "nodes" in body
+    st, body = _admin(server, "GET", "/v0/health")
+    assert st == 200
