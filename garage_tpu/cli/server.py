@@ -42,7 +42,12 @@ async def run_server(cfg_path: str) -> None:
     stop = asyncio.Event()
 
     loop = asyncio.get_event_loop()
-    for sig in (signal.SIGINT, signal.SIGTERM):
+    # SIGHUP is a shutdown signal like the reference's
+    # (server.rs:185-189), not a reload; absent on some platforms
+    for name in ("SIGINT", "SIGTERM", "SIGHUP"):
+        sig = getattr(signal, name, None)
+        if sig is None:
+            continue
         try:
             loop.add_signal_handler(sig, stop.set)
         except NotImplementedError:
