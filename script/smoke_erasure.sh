@@ -38,6 +38,12 @@ for i in $(seq 1 $N); do
 metadata_dir = "$TMP/node$i/meta"
 data_dir = "$TMP/node$i/data"
 replication_factor = 3
+# the double-kill below removes BOTH metadata replicas of any
+# partition whose 3-node set contains both victims (ring-dependent);
+# degraded mode (read quorum 1, the reference's knob for exactly this)
+# keeps metadata readable whenever ANY replica survives, so the smoke
+# exercises the block layer's full m=2 loss tolerance deterministically
+consistency_mode = "degraded"
 erasure_coding = "4,2"
 db_engine = "sqlite"
 block_size = 65536
