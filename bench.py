@@ -597,6 +597,157 @@ def bench_s3_put(nobj: int, obj_mib: int = 4, device: bool = False) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_qos(duration: float = 6.0, nthreads: int = 8,
+              obj_mib: int = 1) -> dict:
+    """QoS admission control under pressure: sustained S3 PUTs against
+    an in-process erasure(4,2) cluster WHILE deep scrub re-walks the
+    store, with a deliberately tight bytes/s budget. Reports admitted
+    vs offered throughput, the shed rate (503 SlowDown), and what the
+    feedback governor did to scrub tranquility while users were
+    waiting — the traffic-control plane the qos/ subsystem exists for."""
+    import concurrent.futures
+    import pathlib
+    import shutil
+    import sys
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    for p in (here, os.path.join(here, "tests")):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    from s3util import S3Client
+    from test_model import make_garage_cluster, stop_all
+
+    from garage_tpu.api.s3.api_server import S3ApiServer
+    from garage_tpu.model.helper import GarageHelper, allow_all
+    from garage_tpu.qos.limiter import QosLimits
+
+    tmp = tempfile.mkdtemp(
+        prefix="gt_qosbench_",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
+    pool = concurrent.futures.ThreadPoolExecutor(nthreads)
+
+    async def scenario() -> dict:
+        import socket as _socket
+
+        net, garages, tasks = await make_garage_cluster(
+            pathlib.Path(tmp), n=6, rf=3, erasure=(4, 2))
+        g = garages[0]
+        helper = GarageHelper(g)
+        key = await helper.create_key("qos-bench")
+        bucket = await helper.create_bucket("qos-bench")
+        await helper.set_bucket_key_permissions(bucket.id, key.key_id,
+                                                allow_all())
+        srv = S3ApiServer(g)
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        await srv.start("127.0.0.1", port)
+        cli = S3Client("127.0.0.1", port, key.key_id,
+                       key.params.secret_key, region=g.config.s3_region)
+        loop = asyncio.get_running_loop()
+        size = obj_mib << 20
+        data = np.random.default_rng(11).integers(
+            0, 256, size, dtype=np.uint8).tobytes()
+
+        def put(name):
+            st, hdrs, _ = cli.request("PUT", f"/qos-bench/{name}",
+                                      body=data, unsigned_payload=True,
+                                      timeout=60.0)
+            return st
+
+        try:
+            # prefill (unlimited) so scrub has stripes to walk — and to
+            # measure what this box can actually push, so the budget
+            # below meaningfully overloads fast and slow machines alike
+            t0 = time.monotonic()
+            for st in await asyncio.gather(*[
+                    loop.run_in_executor(pool, put, f"seed{i}")
+                    for i in range(16)]):
+                assert st == 200, st
+            prefill_bps = 16 * size / (time.monotonic() - t0)
+
+            # tight budget: ~1/3 of measured capacity, 1 s burst,
+            # near-zero waiting room -> sustained overload MUST shed
+            limit_bps = max(1 << 20, int(prefill_bps / 3))
+            g.qos.set_limits(QosLimits(global_bytes_per_s=limit_bps,
+                                       global_bytes_burst=limit_bps,
+                                       max_wait_s=0.05))
+            if g.qos_governor is not None:
+                g.qos_governor.interval = 0.5  # sample fast in a short run
+
+            # deep scrub runs CONCURRENTLY on every node, restarted
+            # whenever a pass drains, throttled only by its (governed)
+            # tranquility
+            stop_scrub = asyncio.Event()
+
+            async def keep_scrubbing():
+                while not stop_scrub.is_set():
+                    for g2 in garages:
+                        sw = g2.block_manager.scrub_worker
+                        if sw is not None and sw.state.cursor == b"" \
+                                and not sw._due():
+                            sw.command("start")
+                    await asyncio.sleep(0.5)
+
+            scrub_task = asyncio.create_task(keep_scrubbing())
+
+            counts = {"ok": 0, "shed": 0, "other": 0}
+            t_end = time.monotonic() + duration
+
+            def hammer(i):
+                n = 0
+                while time.monotonic() < t_end:
+                    st = put(f"w{i}-{n}")
+                    n += 1
+                    if st == 200:
+                        counts["ok"] += 1
+                    elif st == 503:
+                        counts["shed"] += 1
+                    else:
+                        counts["other"] += 1
+
+            t0 = time.monotonic()
+            await asyncio.gather(*[loop.run_in_executor(pool, hammer, i)
+                                   for i in range(nthreads)])
+            dt = time.monotonic() - t0
+            stop_scrub.set()
+            await scrub_task
+
+            total = counts["ok"] + counts["shed"] + counts["other"]
+            deep_checked = sum(
+                g2.block_manager.scrub_worker.deep_checked
+                for g2 in garages
+                if g2.block_manager.scrub_worker is not None)
+            gov = g.qos_governor
+            sw0 = g.block_manager.scrub_worker
+            return {
+                "qos_put_admitted_mbps": round(
+                    counts["ok"] * size / dt / 1e6, 1),
+                "qos_put_offered_mbps": round(
+                    total * size / dt / 1e6, 1),
+                "qos_limit_mbps": round(limit_bps / 1e6, 1),
+                "qos_shed_rate": round(counts["shed"] / max(total, 1), 3),
+                "qos_admitted": counts["ok"],
+                "qos_sheds": counts["shed"],
+                "qos_errors": counts["other"],
+                "qos_deep_stripes_checked": deep_checked,
+                "qos_governor_pressure": (round(gov.pressure, 3)
+                                          if gov is not None else None),
+                "qos_scrub_tranquility": (round(sw0.state.tranquility, 2)
+                                          if sw0 is not None else None),
+            }
+        finally:
+            await srv.stop()
+            await stop_all(garages, tasks)
+
+    try:
+        return asyncio.run(asyncio.wait_for(scenario(), 300))
+    finally:
+        pool.shutdown(wait=False)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_native_blake3() -> float:
     """The native host BLAKE3 kernel (b3gf.c, AVX2 8-way) — what the
     product actually hashes with on the host path."""
@@ -810,6 +961,13 @@ def main() -> None:
         extra.update(bench_s3_put(8 if platform == "cpu" else 16))
     except Exception as e:
         extra["s3_put_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # qos admission control: sustained PUTs + concurrent deep scrub
+    # against a tight byte budget — admitted vs shed + governor action
+    try:
+        extra.update(bench_qos())
+    except Exception as e:
+        extra["qos_error"] = f"{type(e).__name__}: {e}"[:300]
     if platform == "cpu":
         maybe_reexec_on_device()
 

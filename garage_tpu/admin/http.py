@@ -145,6 +145,38 @@ class AdminHttpServer:
             raw = await req.body.read_all(limit=1 << 20)
             return json.loads(raw.decode()) if raw else None
 
+        if path == "/v1/qos" and m == "GET":
+            return _json(self._qos_state())
+        if path == "/v1/qos" and m == "POST":
+            spec = await body_json() or {}
+            qos = getattr(self.garage, "qos", None)
+            if qos is None:
+                raise BadRequest("qos engine not available")
+            gov = getattr(self.garage, "qos_governor", None)
+            gov_spec = spec.pop("governor", None)
+            if spec:
+                qos.update_limits(spec)
+            if gov_spec is not None:
+                if gov is None:
+                    raise BadRequest("governor not running "
+                                     "(disabled in config)")
+                if isinstance(gov_spec, bool):
+                    gov_spec = {"enabled": gov_spec}
+                if "enabled" in gov_spec:
+                    gov.enabled = bool(gov_spec["enabled"])
+                if "target_latency_s" in gov_spec:
+                    t = float(gov_spec["target_latency_s"])
+                    if t <= 0:
+                        raise BadRequest("target_latency_s must be > 0")
+                    gov.target_latency = t
+                if "scrub_range" in gov_spec:
+                    lo, hi = map(float, gov_spec["scrub_range"])
+                    gov.scrub_range = (lo, hi)
+                if "resync_range" in gov_spec:
+                    lo, hi = map(float, gov_spec["resync_range"])
+                    gov.resync_range = (lo, hi)
+            return _json(self._qos_state())
+
         if path in ("/status", "/v1/status") and m == "GET":
             r = await self.rpc.op_status({})
             return _json({
@@ -370,6 +402,13 @@ class AdminHttpServer:
 
         return None
 
+    def _qos_state(self) -> dict:
+        qos = getattr(self.garage, "qos", None)
+        gov = getattr(self.garage, "qos_governor", None)
+        out = qos.state() if qos is not None else {}
+        out["governor"] = gov.state() if gov is not None else None
+        return out
+
     async def _check_domain(self, req: Request) -> Response:
         """Website vhost check for reverse proxies; deliberately
         UNAUTHENTICATED like the reference (api_server.rs routes
@@ -467,6 +506,30 @@ class AdminHttpServer:
             if peer.ping_max is not None:
                 gauge("cluster_node_ping_max_seconds", round(peer.ping_max, 6),
                       node=nid)
+
+        # qos admission-control plane (garage_tpu/qos/)
+        qos = getattr(g, "qos", None)
+        if qos is not None:
+            c = qos.counters
+            out.append("# TYPE qos_requests counter")
+            gauge("qos_admitted_total", c.admitted)
+            gauge("qos_shed_total", c.shed)
+            gauge("qos_queued_waits_total", c.queued_waits)
+            gauge("qos_queued_seconds_total",
+                  round(c.queued_seconds, 6))
+            gauge("qos_shaped_bytes_total", c.shaped_bytes)
+            for scope, n in c.shed_by_scope.items():
+                gauge("qos_shed_by_scope", n, scope=scope)
+            if qos._conc is not None:
+                gauge("qos_in_flight", qos._conc.active)
+                gauge("qos_queued", qos._conc.queued)
+        gov = getattr(g, "qos_governor", None)
+        if gov is not None:
+            gauge("qos_governor_pressure_current",
+                  round(gov.pressure, 4))
+            if gov.ewma is not None:
+                gauge("qos_governor_ewma_latency_seconds",
+                      round(gov.ewma, 6))
 
         # op counters/durations from the process-wide registry
         # (rpc/table/api/block series; ref: rpc/metrics.rs etc.)

@@ -23,6 +23,7 @@ import logging
 from typing import Optional
 
 from ...model.helper import GarageHelper
+from ...qos.limiter import SlowDown
 from ...utils.error import BadRequest, NoSuchBucket, NoSuchKey
 from ..http import HttpError, HttpServer, Request, Response
 from ..s3.api_server import ReqCtx
@@ -35,9 +36,12 @@ from . import item as item_handlers
 log = logging.getLogger("garage_tpu.api.k2v")
 
 
-def json_error(code: str, status: int, message: str) -> Response:
+def json_error(code: str, status: int, message: str,
+               headers: Optional[list] = None) -> Response:
     body = json.dumps({"code": code, "message": message}).encode()
-    return Response(status, [("content-type", "application/json")], body)
+    return Response(status,
+                    [("content-type", "application/json")]
+                    + (headers or []), body)
 
 
 class K2VApiServer:
@@ -60,7 +64,19 @@ class K2VApiServer:
 
     async def handle(self, req: Request) -> Response:
         try:
-            return await self._handle(req)
+            # same two-stage qos admission as the S3 frontend: global
+            # (cheap, pre-auth) here, per-key/per-bucket in _handle
+            qos = getattr(self.garage, "qos", None)
+            if qos is None:
+                return await self._handle(req)
+            cl = req.header("content-length")
+            async with qos.admit(
+                    "k2v", nbytes=int(cl) if cl and cl.isdigit() else None):
+                return await self._handle(req)
+        except SlowDown as e:
+            return json_error("SlowDown", 503,
+                              "Please reduce your request rate.",
+                              headers=[("retry-after", e.header_value())])
         except S3Error as e:
             return json_error(e.code, e.status, e.message)
         except HttpError as e:
@@ -84,6 +100,11 @@ class K2VApiServer:
         bucket_name, _, partition_key = path.partition("/")
         if not bucket_name:
             raise S3Error("InvalidRequest", 400, "no bucket in path")
+        qos = getattr(self.garage, "qos", None)
+        if qos is not None:
+            await qos.admit_scoped(key_id=api_key.key_id,
+                                   bucket=bucket_name)
+
         bucket_id = await self.helper.resolve_global_bucket_name(bucket_name)
         if bucket_id is None:
             raise no_such_bucket(bucket_name)

@@ -13,6 +13,7 @@ from typing import Optional
 from ...model.helper import GarageHelper
 from ...utils.error import BadRequest, NoSuchBucket, NoSuchKey
 from ..http import HttpError, HttpServer, Request, Response
+from ...qos.limiter import SlowDown
 from ..signature import verify_request, wrap_body
 from . import bucket as bucket_handlers
 from . import delete as delete_handlers
@@ -22,9 +23,20 @@ from . import lifecycle as lifecycle_handlers
 from . import multipart as multipart_handlers
 from . import put as put_handlers
 from . import website as website_handlers
-from .xml import S3Error, access_denied, no_such_bucket
+from .xml import S3Error, access_denied, no_such_bucket, slow_down
 
 log = logging.getLogger("garage_tpu.api.s3")
+
+
+def declared_body_length(req: Request):
+    """Body size a request admits to up front — the qos bytes-bucket
+    charge. aws-chunked bodies declare the true payload size separately
+    (raw content-length includes per-chunk framing); bodies with
+    neither header are charged nothing here and shaped per-block on the
+    streaming path instead (put.py Chunker)."""
+    cl = (req.header("x-amz-decoded-content-length")
+          or req.header("content-length"))
+    return int(cl) if cl and cl.isdigit() else None
 
 
 class ReqCtx:
@@ -80,7 +92,18 @@ class S3ApiServer:
 
     async def handle(self, req: Request) -> Response:
         try:
-            return await self._handle(req)
+            # global admission (qos/): requests/s + declared body bytes
+            # + bounded concurrency, BEFORE SigV4 — shedding must stay
+            # cheap or overload melts the node doing auth for requests
+            # it then rejects. Per-key/per-bucket stages run in _handle
+            # once identity is resolved.
+            qos = getattr(self.garage, "qos", None)
+            if qos is None:
+                return await self._handle(req)
+            async with qos.admit("s3", nbytes=declared_body_length(req)):
+                return await self._handle(req)
+        except SlowDown as e:
+            return slow_down(e.header_value()).response()
         except S3Error as e:
             return e.response()
         except HttpError as e:
@@ -101,6 +124,14 @@ class S3ApiServer:
         api_key = None
         if verified is not None:
             api_key = await self.helper.get_existing_key(verified.key_id)
+
+        # per-key / per-bucket admission, now that identity is known
+        # (raises qos SlowDown, translated to 503 by handle())
+        qos = getattr(self.garage, "qos", None)
+        if qos is not None:
+            await qos.admit_scoped(
+                key_id=api_key.key_id if api_key is not None else None,
+                bucket=bucket_name)
 
         if bucket_name is None:
             if req.method == "GET":

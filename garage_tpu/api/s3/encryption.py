@@ -46,6 +46,18 @@ TAG_LEN = 16
 OVERHEAD = NONCE_LEN + TAG_LEN
 
 
+def _aesgcm():
+    """AESGCM or a clean S3 error when the wheel is absent (bare image:
+    everything but SSE-C keeps working)."""
+    try:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    except ModuleNotFoundError:
+        raise S3Error("NotImplemented", 501,
+                      "SSE-C requires the `cryptography` wheel, which "
+                      "is not installed on this node")
+    return AESGCM
+
+
 class SseCKey:
     __slots__ = ("key", "md5_b64")
 
@@ -54,13 +66,13 @@ class SseCKey:
         self.md5_b64 = md5_b64
 
     def encrypt_block(self, plain: bytes) -> bytes:
-        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        AESGCM = _aesgcm()
 
         nonce = os.urandom(NONCE_LEN)
         return nonce + AESGCM(self.key).encrypt(nonce, plain, b"")
 
     def decrypt_block(self, cipher: bytes) -> bytes:
-        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        AESGCM = _aesgcm()
 
         if len(cipher) < OVERHEAD:
             raise S3Error("InvalidRequest", 400, "corrupt encrypted block")

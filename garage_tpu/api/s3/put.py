@@ -39,10 +39,13 @@ class Chunker:
     block — instead of the old bytearray extend+slice+memmove trio,
     which was a measurable share of the one-core PUT path."""
 
-    def __init__(self, body, block_size: int):
+    def __init__(self, body, block_size: int, shape=None):
         self.body = body
         self.block_size = block_size
         self.eof = False
+        # qos byte-shaper (async callable) for bodies whose length was
+        # unknown at admission time — see qos.QosEngine.shape_bytes
+        self.shape = shape
         self._rest = b""  # overshoot carry (AwsChunkedReader returns
         # whole decoded client chunks, ignoring the requested size)
 
@@ -66,6 +69,8 @@ class Chunker:
         if have > self.block_size:
             self._rest = whole[self.block_size:]
             whole = whole[:self.block_size]
+        if self.shape is not None:
+            await self.shape(len(whole))
         return whole
 
 
@@ -167,7 +172,13 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
     from ...utils.tracing import span
 
     block_size = garage.config.block_size
-    chunker = Chunker(body, block_size)
+    # bodies that declared a length were charged to the qos bytes
+    # bucket at admission; unknown-length (chunked) bodies are shaped
+    # per-block here instead, so neither path double-charges
+    qos = getattr(garage, "qos", None)
+    shape = (qos.shape_bytes if qos is not None
+             and content_length is None else None)
+    chunker = Chunker(body, block_size, shape=shape)
     async with span("s3.put.first_read_and_lookup"):
         first_block, existing = await asyncio.gather(
             chunker.next(), garage.object_table.get(bucket_id, key.encode())

@@ -39,11 +39,12 @@ class S3Error(Exception):
     """ref: api/s3/error.rs — code + HTTP status + message."""
 
     def __init__(self, code: str, status: int, message: str = "",
-                 resource: str = ""):
+                 resource: str = "", headers: Optional[list] = None):
         self.code = code
         self.status = status
         self.message = message or code
         self.resource = resource
+        self.headers = headers or []
         super().__init__(f"{code}: {self.message}")
 
     def response(self) -> Response:
@@ -54,6 +55,7 @@ class S3Error(Exception):
                 xml("Resource", self.resource),
                 xml("Region", "garage")),
             status=self.status,
+            extra_headers=self.headers,
         )
 
 
@@ -72,3 +74,13 @@ def access_denied(msg: str = "Access Denied.") -> S3Error:
 
 def bad_request(msg: str) -> S3Error:
     return S3Error("InvalidRequest", 400, msg)
+
+
+def slow_down(retry_after_header: str) -> S3Error:
+    """Admission-control shed (ref: S3's real overload answer — code
+    `SlowDown`, HTTP 503 — plus the standard Retry-After hint)."""
+    return S3Error(
+        "SlowDown", 503,
+        "Please reduce your request rate.",
+        headers=[("retry-after", retry_after_header)],
+    )

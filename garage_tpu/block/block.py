@@ -13,7 +13,14 @@ from __future__ import annotations
 import zlib
 from dataclasses import dataclass
 
-import zstandard
+try:
+    import zstandard
+    _ZstdError = zstandard.ZstdError
+except ModuleNotFoundError:  # bare image: fall back to the zlib scheme
+    zstandard = None
+
+    class _ZstdError(Exception):
+        """Placeholder so except-tuples stay valid; never raised."""
 
 from ..utils.data import content_hash_matches
 from ..utils.error import CorruptData
@@ -29,6 +36,12 @@ SUFFIX_OF = {COMPRESSION_NONE: "", COMPRESSION_ZLIB: ".zlib",
              COMPRESSION_ZSTD: ".zst"}
 COMP_OF_SUFFIX = {v: k for k, v in SUFFIX_OF.items()}
 BLOCK_SUFFIXES = list(SUFFIX_OF.values())
+
+
+class MissingCodec(RuntimeError):
+    """A stored block uses a compression scheme whose codec wheel is
+    not installed here. The data is NOT corrupt — readers must fail the
+    read without quarantining the file."""
 
 
 def comp_of_path(p: str) -> int:
@@ -59,7 +72,18 @@ class DataBlock:
         """Compress (zstd, ref default scheme) if it helps; otherwise
         keep plain (ref: block.rs:85-99 from_buffer). Incompressible
         payloads are detected from a leading sample before paying for
-        the full pass."""
+        the full pass. Without the zstandard wheel the zlib scheme is
+        written instead — every reader probes all schemes, so mixed
+        stores interoperate."""
+        if zstandard is None:
+            if len(data) > 2 * cls._SAMPLE:
+                probe = zlib.compress(data[: cls._SAMPLE], level)
+                if len(probe) > cls._SAMPLE * cls._SAMPLE_RATIO:
+                    return cls(COMPRESSION_NONE, data)
+            c = zlib.compress(data, level)
+            if len(c) < len(data):
+                return cls(COMPRESSION_ZLIB, c)
+            return cls(COMPRESSION_NONE, data)
         cctx = zstandard.ZstdCompressor(level=level)
         if len(data) > 2 * cls._SAMPLE:
             probe = cctx.compress(data[: cls._SAMPLE])
@@ -74,6 +98,10 @@ class DataBlock:
         if self.compression == COMPRESSION_NONE:
             return self.bytes
         if self.compression == COMPRESSION_ZSTD:
+            if zstandard is None:
+                raise MissingCodec(
+                    "zstd-compressed block but the zstandard wheel is "
+                    "not installed")
             # a fresh decompressor per call: ZstdDecompressor instances
             # are not safe for concurrent use, and GET (to_thread) can
             # race a ScrubWorker read on another worker thread
@@ -87,7 +115,7 @@ class DataBlock:
         blake2 accepted for stores migrated from the legacy algo."""
         try:
             plain = self.plain_bytes()
-        except (zlib.error, zstandard.ZstdError) as e:
+        except (zlib.error, _ZstdError) as e:
             raise CorruptData(hash32) from e
         if not content_hash_matches(plain, hash32):
             raise CorruptData(hash32)

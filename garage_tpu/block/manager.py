@@ -216,6 +216,9 @@ class BlockManager:
 
         def set_rt(v):
             res.tranquility = float(v)
+            # an explicit operator set takes the knob away from the qos
+            # governor until it is explicitly re-enabled
+            res.tranquility_manual = True
 
         vars.register_rw("resync-tranquility",
                          lambda: res.tranquility, set_rt)
@@ -223,6 +226,7 @@ class BlockManager:
         if sw is not None:
             def set_st(v):
                 sw.state.tranquility = float(v)
+                sw.state.tranquility_manual = True
                 sw.persister.save(sw.state)
 
             def set_paused(v):
@@ -348,9 +352,10 @@ class BlockManager:
 
     async def rpc_get_block(self, hash32: bytes) -> bytes:
         if self.erasure:
-            packed = await self._get_erasure(hash32)
-        else:
-            packed = await self._get_replicate(hash32)
+            # verification happens inside: a decode is retried against
+            # every distinct packed_len candidate before giving up
+            return await self._get_erasure(hash32)
+        packed = await self._get_replicate(hash32)
         blk = DataBlock.unpack(packed)
         blk.verify(hash32)
         return blk.plain_bytes()
@@ -376,11 +381,20 @@ class BlockManager:
         raise MissingBlock(hash32)
 
     async def _get_erasure(self, hash32: bytes) -> bytes:
+        """Gather k shards, decode, verify against the content address.
+
+        The shard header's packed_len field sits outside the shard
+        checksum, so _gather_parts majority-votes it — but a vote can
+        TIE (e.g. k=2 with one rotted header). On verify failure every
+        other distinct candidate is decoded and checked before moving
+        on: a recoverable block must never be reported corrupt because
+        the wrong tiebreak was picked (ADVICE r5)."""
         helper = self.system.layout_helper
         versions = list(reversed(
             helper.history.versions + helper.history.old_versions
         ))
         tried = set()
+        gathered_any = False
         for v in versions:
             placement = shard_nodes_of(v, hash32, self.codec.width)
             key = tuple(placement)
@@ -389,22 +403,44 @@ class BlockManager:
             tried.add(key)
             got = await self._gather_parts(hash32, placement,
                                            self.codec.read_need)
-            if got is not None:
-                parts, packed_len = got
-                return self.codec.decode(parts, packed_len)
+            if got is None:
+                continue
+            gathered_any = True
+            parts, candidates = got
+            for packed_len in candidates:
+                try:
+                    blk = DataBlock.unpack(
+                        self.codec.decode(parts, packed_len))
+                    blk.verify(hash32)
+                except (CorruptData, ValueError, IndexError):
+                    # a forged/rotted length can make the decode itself
+                    # blow up, not just the content check — either way
+                    # the next candidate gets its chance
+                    log.info("block %s: decode at packed_len=%d failed "
+                             "verification", hash32[:4].hex(), packed_len)
+                    continue
+                return blk.plain_bytes()
+        if gathered_any:
+            raise CorruptData(hash32)
         raise MissingBlock(hash32)
 
     async def _gather_parts(self, hash32: bytes, placement: list[bytes],
                             need: int):
         """Fetch parts concurrently until `need` distinct indices are in
         hand; over-request nothing (systematic shards first, then the
-        rest on failure)."""
+        rest on failure). -> (parts, packed_len candidates ranked by
+        vote count, majority first) or None."""
         me = self.system.id
 
         async def fetch(node, idx):
             try:
                 if node == me:
-                    raw = self.read_local_shard(hash32, idx)
+                    # off the event loop: deep scrub drives MiB-scale
+                    # local reads through here, and a cold-cache disk
+                    # read would stall every foreground request
+                    # (ADVICE r5)
+                    raw = await asyncio.to_thread(
+                        self.read_local_shard, hash32, idx)
                     if raw is None:
                         return None
                     return unpack_shard(raw)
@@ -446,9 +482,15 @@ class BlockManager:
         # forged header must not poison the whole decode (deep-scrub
         # repair decodes candidate subsets against this value; the read
         # path would fail content verification and miss a recoverable
-        # block). With <= m corrupt shards the majority is the truth.
-        packed_len = max(set(lens), key=lens.count)
-        return parts, packed_len
+        # block). With <= m corrupt shards the majority is the truth —
+        # but a vote can TIE, so every distinct value is returned ranked
+        # by count (ties broken toward the larger length: truncating a
+        # real block always fails verification, padding can succeed for
+        # trailing-zero payloads) and callers that verify content try
+        # them in order.
+        ranked = sorted(set(lens),
+                        key=lambda v: (-lens.count(v), -v))
+        return parts, ranked
 
     # ==== refcount hooks (called from block_ref table trigger) ==========
 

@@ -35,16 +35,20 @@ class ScrubState(migrate.Migratable):
 
     def __init__(self, cursor: bytes = b"", last_completed: float = 0.0,
                  corruptions: int = 0, tranquility: float = 4.0,
-                 paused: bool = False):
+                 paused: bool = False, tranquility_manual: bool = False):
         self.cursor = cursor
         self.last_completed = last_completed
         self.corruptions = corruptions
         self.tranquility = tranquility
         self.paused = paused
+        # True after an operator `worker set scrub-tranquility`:
+        # PERSISTED, so the qos governor keeps its hands off the knob
+        # across restarts until explicitly re-enabled
+        self.tranquility_manual = tranquility_manual
 
     def pack(self):
         return [self.cursor, self.last_completed, self.corruptions,
-                self.tranquility, self.paused]
+                self.tranquility, self.paused, self.tranquility_manual]
 
     @classmethod
     def unpack(cls, o):
@@ -183,10 +187,15 @@ class ScrubWorker(Worker):
                 try:
                     with open(p, "rb") as f:
                         raw = f.read()
-                    from .block import DataBlock
+                    from .block import DataBlock, MissingCodec
 
                     blk = DataBlock(comp_of_path(p), raw)
-                    out.append((h, p, blk.plain_bytes()))
+                    try:
+                        out.append((h, p, blk.plain_bytes()))
+                    except MissingCodec:
+                        # codec wheel absent, data not corrupt: skip,
+                        # never quarantine (block.py MissingCodec)
+                        out.append((h, None, None))
                 except Exception:
                     out.append((h, p, None))  # unreadable = corrupt
             return out
@@ -250,7 +259,8 @@ class ScrubWorker(Worker):
         for (h, placement), got in zip(leaders, gathered):
             if got is None:
                 continue
-            parts, packed_len = got
+            parts, len_candidates = got
+            packed_len = len_candidates[0]  # majority vote
             self.deep_checked += 1
             stripe = [parts[i] for i in range(m.codec.width)]
             if len({len(s) for s in stripe}) != 1:
@@ -367,7 +377,14 @@ class ScrubWorker(Worker):
         return fixed
 
     async def wait_for_work(self):
-        await asyncio.sleep(60.0)
+        # 1 s polling tick, not one 60 s sleep: an operator `repair
+        # scrub start` must take effect promptly, not after the tail of
+        # an idle minute (ref: repair.rs reacts to its command channel
+        # immediately)
+        for _ in range(60):
+            if self._pending_cmd is not None:
+                return
+            await asyncio.sleep(1.0)
 
     def info(self):
         from ..utils.background import WorkerInfo

@@ -36,6 +36,9 @@ class BlockResyncManager:
         self.errors = db.open_tree("block_resync_errors")  # hash -> (count, next_ms)
         self.n_workers = 1
         self.tranquility = 0.0
+        # True after an operator `worker set resync-tranquility`: the
+        # qos governor leaves the knob alone until re-enabled
+        self.tranquility_manual = False
 
     # ---- queue ---------------------------------------------------------
 
@@ -244,7 +247,8 @@ class BlockResyncManager:
         got = await m._gather_parts(hash32, placement, m.codec.read_need)
         if got is None:
             return None
-        parts, packed_len = got
+        parts, len_candidates = got
+        packed_len = len_candidates[0]  # majority vote
         if idx in parts:
             return pack_shard(parts[idx], packed_len)
         rebuilt = m.codec.repair_parts(parts, (idx,))

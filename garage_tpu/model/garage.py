@@ -180,6 +180,22 @@ class Garage:
             block_ref_recount_fn(self.block_ref_table)
         )
 
+        # ---- qos admission control (garage_tpu/qos/) -------------------
+        from ..qos import QosEngine
+        from ..qos.limiter import QosLimits
+
+        qc = config.qos
+        self.qos = QosEngine(QosLimits(
+            global_rps=qc.global_rps, global_burst=qc.global_burst,
+            global_bytes_per_s=qc.global_bytes_per_s,
+            global_bytes_burst=qc.global_bytes_burst,
+            per_key_rps=qc.per_key_rps,
+            per_bucket_rps=qc.per_bucket_rps,
+            max_concurrent=qc.max_concurrent, max_queue=qc.max_queue,
+            max_wait_s=qc.max_wait_s,
+        ))
+        self.qos_governor = None  # spawned in spawn_workers
+
         # one global lock serializing bucket/key/alias mutations
         # (ref: garage.rs:61 bucket_lock + helper/locked.rs)
         self.bucket_lock = asyncio.Lock()
@@ -230,6 +246,36 @@ class Garage:
             t.spawn_workers(self.runner)
         self.block_manager.spawn_workers(self.runner, scrub=scrub)
         self.block_manager.register_bg_vars(self.bg_vars)
+        qc = self.config.qos
+        if qc.governor:
+            from ..qos import GovernorWorker
+
+            self.qos_governor = GovernorWorker(
+                self, interval=qc.governor_interval,
+                target_latency=qc.governor_target_latency,
+                scrub_range=(qc.scrub_tranquility_min,
+                             qc.scrub_tranquility_max),
+                resync_range=(qc.resync_tranquility_min,
+                              qc.resync_tranquility_max),
+            )
+            self.runner.spawn_worker(self.qos_governor)
+            gov = self.qos_governor
+
+            bm = self.block_manager
+
+            def set_gov(v):
+                gov.enabled = v.lower() in ("1", "true", "yes")
+                if gov.enabled:
+                    # re-enabling hands the tranquility knobs back from
+                    # any manual `worker set` override
+                    bm.resync.tranquility_manual = False
+                    sw = getattr(bm, "scrub_worker", None)
+                    if sw is not None:
+                        sw.state.tranquility_manual = False
+                        sw.persister.save(sw.state)
+
+            self.bg_vars.register_rw("qos-governor",
+                                     lambda: int(gov.enabled), set_gov)
         from .s3.lifecycle_worker import LifecycleWorker
 
         self.runner.spawn_worker(LifecycleWorker(self))

@@ -46,15 +46,24 @@ import logging
 import struct
 from typing import Awaitable, Callable, Optional
 
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    HAVE_CRYPTO = True
+except ModuleNotFoundError:
+    # bare image: the in-process LocalChannel transport (net/local.py)
+    # carries no crypto and keeps working; only the TCP SecureChannel
+    # needs these wheels, and its handshakes refuse with a clear error
+    HAVE_CRYPTO = False
+    Ed25519PrivateKey = Ed25519PublicKey = None
+    X25519PrivateKey = X25519PublicKey = ChaCha20Poly1305 = None
 
 from ..utils.error import RpcError
 from .message import PRIO_HIGH, pack, unpack
@@ -63,6 +72,10 @@ from .stream import ByteStream
 log = logging.getLogger("garage_tpu.net")
 
 MAGIC = b"GRGTPU\x04\x00"  # protocol version gate (ref: net/netapp.rs:35-40)
+# distinct magic for the no-crypto fallback wire: a crypto-equipped
+# node and a bare one REJECT each other's hellos instead of silently
+# downgrading the whole cluster to plaintext
+PLAIN_MAGIC = b"GRGTPP\x04\x00"
 # 256 KiB chunks on TCP: per-chunk costs (AEAD pass + header + writer
 # wakeup) were the dominant CPU on the block path at the reference-style
 # ~8 KiB (a 1.5 MiB shard transfer = ~190 chunks); at ~1 ms
@@ -159,6 +172,105 @@ class HandshakeError(RpcError):
     pass
 
 
+class PlainChannel:
+    """No-crypto record layer for the `cryptography`-less fallback:
+    [u32 len][u32 req_id][u32 field][payload]. Cluster membership is
+    still gated (HMAC over netid in the plain handshake below) but
+    there is NO confidentiality or per-record integrity — dev/test
+    transport, never a production one."""
+
+    max_chunk = MAX_CHUNK
+
+    def __init__(self, reader, writer):
+        self.reader = reader
+        self.writer = writer
+
+    async def send_frame(self, req_id: int, field: int,
+                         parts: list = ()) -> None:
+        payload = b"".join(
+            p if isinstance(p, (bytes, bytearray)) else bytes(p)
+            for p in parts)
+        self.writer.write(struct.pack("<III", len(payload) + 8,
+                                      req_id, field) + payload)
+        await self.writer.drain()
+
+    async def recv_frame(self) -> tuple[int, int, list]:
+        (n,) = struct.unpack("<I", await self.reader.readexactly(4))
+        body = await self.reader.readexactly(n)
+        req_id, field = struct.unpack_from("<II", body)
+        return req_id, field, [memoryview(body)[8:]]
+
+    def close(self) -> None:
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+async def _plain_client_handshake(reader, writer, netid: bytes, privkey
+                                  ) -> tuple[bytes, PlainChannel]:
+    """Initiator side of the no-crypto fallback: three messages so BOTH
+    directions prove LIVE knowledge of the cluster secret (each side's
+    final MAC covers the other side's fresh nonce — a recorded
+    handshake replays into neither role); identity is the
+    HashIdentityKey public id. Only reachable when BOTH ends lack the
+    wheel — the distinct PLAIN_MAGIC makes mixed pairs fail closed."""
+    import os as _os
+
+    pub = privkey.public_key().public_bytes_raw()
+    nonce = _os.urandom(16)
+    hello = PLAIN_MAGIC + pub + nonce
+    writer.write(hello + _hmac(netid, b"hello-plain", hello))
+    await writer.drain()
+
+    srv = await reader.readexactly(len(PLAIN_MAGIC) + 32 + 16 + 32)
+    if srv[: len(PLAIN_MAGIC)] != PLAIN_MAGIC:
+        raise HandshakeError(
+            "protocol mismatch (peer has crypto transport; this node "
+            "lacks the `cryptography` wheel)")
+    off = len(PLAIN_MAGIC)
+    srv_pub = srv[off : off + 32]
+    head = srv[: off + 48]
+    srv_mac = srv[off + 48 : off + 80]
+    # server's MAC covers OUR nonce (inside hello): server is live
+    if not hmac_mod.compare_digest(
+            srv_mac, _hmac(netid, b"srv-plain", hello, head)):
+        raise HandshakeError("peer does not know the cluster secret")
+    # confirm over the server's fresh nonce (inside head): we are live
+    writer.write(_hmac(netid, b"cli-plain", hello, head))
+    await writer.drain()
+    return srv_pub, PlainChannel(reader, writer)
+
+
+async def _plain_server_handshake(reader, writer, netid: bytes, privkey
+                                  ) -> tuple[bytes, PlainChannel]:
+    """Acceptor side of the no-crypto fallback. The hello MAC alone is
+    replayable (it covers only client-chosen bytes), so the channel is
+    granted ONLY after the client's confirm MAC over our fresh nonce —
+    a recorded handshake cannot be replayed into a usable channel."""
+    import os as _os
+
+    hello = await reader.readexactly(len(PLAIN_MAGIC) + 32 + 16)
+    mac = await reader.readexactly(32)
+    if hello[: len(PLAIN_MAGIC)] != PLAIN_MAGIC:
+        raise HandshakeError(
+            "protocol mismatch (peer has crypto transport; this node "
+            "lacks the `cryptography` wheel)")
+    if not hmac_mod.compare_digest(mac,
+                                   _hmac(netid, b"hello-plain", hello)):
+        raise HandshakeError("peer does not know the cluster secret")
+    cli_pub = hello[len(PLAIN_MAGIC) : len(PLAIN_MAGIC) + 32]
+    pub = privkey.public_key().public_bytes_raw()
+    head = PLAIN_MAGIC + pub + _os.urandom(16)
+    writer.write(head + _hmac(netid, b"srv-plain", hello, head))
+    await writer.drain()
+    confirm = await reader.readexactly(32)
+    if not hmac_mod.compare_digest(
+            confirm, _hmac(netid, b"cli-plain", hello, head)):
+        raise HandshakeError("peer failed the liveness confirm")
+    return cli_pub, PlainChannel(reader, writer)
+
+
 async def client_handshake(
     reader: asyncio.StreamReader,
     writer: asyncio.StreamWriter,
@@ -166,6 +278,9 @@ async def client_handshake(
     privkey: Ed25519PrivateKey,
 ) -> tuple[bytes, "SecureChannel"]:
     """Initiator side. Returns (peer node id, channel)."""
+    if not HAVE_CRYPTO:
+        return await _plain_client_handshake(reader, writer, netid,
+                                             privkey)
     pub = privkey.public_key().public_bytes_raw()
     eph = X25519PrivateKey.generate()
     eph_pub = eph.public_key().public_bytes_raw()
@@ -201,6 +316,9 @@ async def server_handshake(
     privkey: Ed25519PrivateKey,
 ) -> tuple[bytes, "SecureChannel"]:
     """Acceptor side. Returns (peer node id, channel)."""
+    if not HAVE_CRYPTO:
+        return await _plain_server_handshake(reader, writer, netid,
+                                             privkey)
     hello = await reader.readexactly(len(MAGIC) + 32 + 32)
     mac = await reader.readexactly(32)
     if hello[: len(MAGIC)] != MAGIC:

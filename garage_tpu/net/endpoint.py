@@ -55,8 +55,14 @@ class Endpoint:
         from ..utils.metrics import registry
         from ..utils.tracing import span
 
+        # bg label partitions foreground (interactive) from background
+        # (resync/scrub bulk) series so the qos governor can sample
+        # foreground latency without chasing its own repair traffic
+        from .message import PRIO_BACKGROUND
+
         with registry().timer("rpc_request_duration_seconds",
-                              endpoint=self.path):
+                              endpoint=self.path,
+                              bg="1" if prio >= PRIO_BACKGROUND else "0"):
             try:
                 async with span("rpc.call", endpoint=self.path,
                                 node=node[:4].hex()):

@@ -14,7 +14,11 @@ import logging
 import time
 from typing import Callable, Optional
 
-from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+try:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import \
+        Ed25519PrivateKey
+except ModuleNotFoundError:
+    Ed25519PrivateKey = None
 
 from ..utils.error import RpcError
 from .conn import Conn, SecureChannel, client_handshake, server_handshake
@@ -24,15 +28,57 @@ from .stream import ByteStream
 log = logging.getLogger("garage_tpu.net")
 
 
-def gen_node_key() -> Ed25519PrivateKey:
+class HashIdentityKey:
+    """Stand-in node key when the `cryptography` wheel is absent.
+
+    The in-process LocalNetwork transport never signs anything — a node
+    key there is pure identity, so 32 random private bytes with a
+    blake2b-derived "public" id give the same uniqueness and
+    persistence semantics. Same raw-bytes (de)serialization surface as
+    Ed25519PrivateKey so load_or_gen_node_key round-trips either kind
+    (though a key file is only portable between same-capability
+    builds). TCP handshakes refuse separately (conn.py HAVE_CRYPTO)."""
+
+    def __init__(self, raw: bytes):
+        self._raw = raw
+        import hashlib
+
+        self._pub = hashlib.blake2b(b"gt-node-id" + raw,
+                                    digest_size=32).digest()
+
+    @classmethod
+    def generate(cls) -> "HashIdentityKey":
+        import os
+
+        return cls(os.urandom(32))
+
+    def public_key(self) -> "HashIdentityKey":
+        return self  # duck-typed: caller only wants public_bytes_raw()
+
+    def public_bytes_raw(self) -> bytes:
+        return self._pub
+
+    def private_bytes_raw(self) -> bytes:
+        return self._raw
+
+    def sign(self, _msg: bytes) -> bytes:
+        raise RpcError("node key cannot sign: `cryptography` wheel "
+                       "not installed")
+
+
+def gen_node_key():
+    if Ed25519PrivateKey is None:
+        return HashIdentityKey.generate()
     return Ed25519PrivateKey.generate()
 
 
-def node_key_from_bytes(raw: bytes) -> Ed25519PrivateKey:
+def node_key_from_bytes(raw: bytes):
+    if Ed25519PrivateKey is None:
+        return HashIdentityKey(raw)
     return Ed25519PrivateKey.from_private_bytes(raw)
 
 
-def node_key_to_bytes(key: Ed25519PrivateKey) -> bytes:
+def node_key_to_bytes(key) -> bytes:
     return key.private_bytes_raw()
 
 

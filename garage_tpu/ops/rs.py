@@ -168,9 +168,13 @@ def _jit_parity_check(k: int, m: int):
 def parity_check(k: int, m: int, stripes):
     """stripes (B, k+m, n) uint8 -> (B,) bool: stored parity equals
     parity re-derived from the data shards — ONE fused device pass (the
-    scrub detect kernel; any single corrupt shard flips every parity
-    row). Zero-padding stripes to a common n is safe: the code is
-    linear, so zero data rows encode to zero parity rows."""
+    scrub detect kernel). A corrupt *data* shard flips every re-derived
+    parity row (each parity is a function of all k data shards); a
+    corrupt *parity* row differs only in itself — either way at least
+    one row mismatches, so any single corruption is detected, but
+    localization needs the decode sweep in repair.py. Zero-padding
+    stripes to a common n is safe: the code is linear, so zero data
+    rows encode to zero parity rows."""
     return _jit_parity_check(k, m)(stripes)
 
 

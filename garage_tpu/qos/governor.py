@@ -1,0 +1,154 @@
+"""Feedback-driven background-work governor.
+
+The fixed `Tranquilizer` tranquility of resync/scrub workers is blind
+to foreground latency: a deep-scrub storm keeps hammering the disks and
+event loop while users wait, and an idle cluster still crawls through
+repair at the configured trickle. This worker closes the loop:
+
+  sample    per-interval mean of foreground request latency — the
+            `api_request_duration_seconds` series (every S3/K2V/admin
+            request) plus the foreground-priority slice of
+            `rpc_request_duration_seconds` (net dispatch path; resync
+            and scrub RPCs travel PRIO_BACKGROUND and are excluded so
+            the governor never chases its own tail).
+  smooth    EWMA so one slow request doesn't whipsaw the workers.
+  control   integral controller on `pressure` in [0, 1]: latency above
+            target pushes pressure up (background yields), below target
+            bleeds it off (background sprints); no foreground traffic
+            at all decays it toward 0.
+  actuate   pressure maps linearly onto each worker's tranquility
+            range: scrub tranquility in [scrub_min, scrub_max]
+            (a duration multiplier, repair.py) and resync tranquility
+            in [resync_min, resync_max] (an inter-item delay in
+            seconds, resync.py).
+
+While enabled the governor OWNS those two tranquilities — with one
+exception: an explicit operator `worker set resync-tranquility` /
+`scrub-tranquility` places a manual hold on that knob (operators
+outrank the loop). Retune the *bounds* via admin `/v1/qos`, disable
+the whole loop with `worker set qos-governor 0`, or re-enable with
+`... 1` (which also clears manual holds).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from ..utils.background import Throttled, Worker, WorkerInfo
+from ..utils.metrics import registry
+
+log = logging.getLogger("garage_tpu.qos")
+
+
+def foreground_latency_totals() -> tuple[int, float]:
+    """(count, total_seconds) of foreground work since process start."""
+    reg = registry()
+    c1, t1 = reg.totals("api_request_duration_seconds")
+    c2, t2 = reg.totals("rpc_request_duration_seconds", bg="0")
+    return c1 + c2, t1 + t2
+
+
+class GovernorWorker(Worker):
+    name = "qos governor"
+
+    # integral gain per step, and the cap on one step's |pressure| move
+    GAIN = 0.25
+    MAX_STEP = 0.5
+    # pressure decay per idle interval (no foreground samples at all)
+    IDLE_DECAY = 0.15
+    EWMA_ALPHA = 0.3
+
+    def __init__(self, garage, interval: float = 2.0,
+                 target_latency: float = 0.05,
+                 scrub_range: tuple[float, float] = (1.0, 30.0),
+                 resync_range: tuple[float, float] = (0.0, 2.0),
+                 sample_fn: Optional[Callable[[], tuple[int, float]]] = None):
+        self.garage = garage
+        self.interval = interval
+        self.target_latency = target_latency
+        self.scrub_range = scrub_range
+        self.resync_range = resync_range
+        self.sample_fn = sample_fn or foreground_latency_totals
+        self.enabled = True
+        self.pressure = 0.0
+        self.ewma: Optional[float] = None
+        self._last: Optional[tuple[int, float]] = None
+        self.adjustments = 0
+
+    # ---- control step (synchronous, unit-testable) ---------------------
+
+    def step(self) -> None:
+        count, total = self.sample_fn()
+        if self._last is None:
+            self._last = (count, total)
+            return
+        dc = count - self._last[0]
+        dt = total - self._last[1]
+        self._last = (count, total)
+        if dc > 0:
+            lat = dt / dc
+            self.ewma = (lat if self.ewma is None
+                         else self.ewma + self.EWMA_ALPHA * (lat - self.ewma))
+            err = self.ewma / self.target_latency - 1.0
+            move = max(-self.MAX_STEP, min(self.MAX_STEP, self.GAIN * err))
+            self.pressure = max(0.0, min(1.0, self.pressure + move))
+        else:
+            # cluster is foreground-idle: let background work sprint
+            self.pressure = max(0.0, self.pressure - self.IDLE_DECAY)
+        self._apply()
+
+    def _apply(self) -> None:
+        bm = getattr(self.garage, "block_manager", None)
+        if bm is None:
+            return
+        u = self.pressure
+        # a manual `worker set <x>-tranquility` holds that knob until
+        # the governor is explicitly re-enabled (`worker set
+        # qos-governor 1` clears the holds) — operators outrank the
+        # control loop
+        if not getattr(bm.resync, "tranquility_manual", False):
+            lo, hi = self.resync_range
+            bm.resync.tranquility = lo + u * (hi - lo)
+        sw = getattr(bm, "scrub_worker", None)
+        if sw is not None and not getattr(sw.state, "tranquility_manual",
+                                          False):
+            lo, hi = self.scrub_range
+            # in-memory only: the scrub worker persists its state at
+            # each batch/pass boundary anyway, and a persister write per
+            # governor tick would be pure write amplification
+            sw.state.tranquility = lo + u * (hi - lo)
+        self.adjustments += 1
+        registry().inc("qos_governor_pressure", self.pressure)
+
+    # ---- worker protocol -----------------------------------------------
+
+    async def work(self):
+        if self.enabled:
+            self.step()
+        return Throttled(self.interval)
+
+    async def wait_for_work(self):
+        import asyncio
+
+        await asyncio.sleep(self.interval)
+
+    def info(self) -> WorkerInfo:
+        ewma_ms = f"{self.ewma * 1000:.1f}ms" if self.ewma else "-"
+        return WorkerInfo(
+            name=self.name,
+            progress=(f"pressure {self.pressure:.2f}, ewma {ewma_ms}"
+                      + ("" if self.enabled else " (disabled)")),
+        )
+
+    def state(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "pressure": round(self.pressure, 4),
+            "ewma_latency_s": (round(self.ewma, 6)
+                               if self.ewma is not None else None),
+            "target_latency_s": self.target_latency,
+            "scrub_range": list(self.scrub_range),
+            "resync_range": list(self.resync_range),
+            "adjustments": self.adjustments,
+        }

@@ -8,9 +8,16 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import tomllib
 from dataclasses import dataclass, field
 from typing import Any, Optional
+
+try:  # stdlib on 3.11+; bare 3.10 images have neither tomllib nor tomli
+    import tomllib
+except ModuleNotFoundError:
+    try:
+        import tomli as tomllib  # type: ignore[no-redef]
+    except ModuleNotFoundError:
+        tomllib = None
 
 
 @dataclass
@@ -29,6 +36,34 @@ class TpuConfig:
     batch_blocks: int = 16
     # platform override for tests ("cpu" forces the jnp fallback path)
     platform: Optional[str] = None
+
+
+@dataclass
+class QosConfig:
+    """[qos] admission control + background-work governor (no reference
+    analogue; see garage_tpu/qos/). A None limit disables that limiter
+    entirely — an absent [qos] section costs nothing on the request
+    path. The governor IS on by default (background repair yields to
+    foreground latency, sprints when idle); `governor = false` keeps
+    the static tranquilities, and an explicit `worker set
+    *-tranquility` always outranks it (persisted for scrub)."""
+
+    global_rps: Optional[float] = None
+    global_burst: Optional[float] = None
+    global_bytes_per_s: Optional[float] = None
+    global_bytes_burst: Optional[float] = None
+    per_key_rps: Optional[float] = None
+    per_bucket_rps: Optional[float] = None
+    max_concurrent: Optional[int] = None
+    max_queue: int = 64
+    max_wait_s: float = 0.5
+    governor: bool = True
+    governor_interval: float = 2.0
+    governor_target_latency: float = 0.05  # seconds
+    scrub_tranquility_min: float = 1.0
+    scrub_tranquility_max: float = 30.0
+    resync_tranquility_min: float = 0.0
+    resync_tranquility_max: float = 2.0
 
 
 @dataclass
@@ -82,6 +117,7 @@ class Config:
     metadata_snapshots_dir: Optional[str] = None  # default {meta}/snapshots
 
     tpu: TpuConfig = field(default_factory=TpuConfig)
+    qos: QosConfig = field(default_factory=QosConfig)
 
     @property
     def data_dirs(self) -> list[DataDir]:
@@ -122,22 +158,122 @@ def parse_capacity(s: str) -> int:
     return int(s)
 
 
+def _toml_scalar(s: str):
+    s = s.strip()
+    if len(s) >= 2 and s[0] == s[-1] and s[0] in "\"'":
+        return s[1:-1].replace('\\"', '"').replace("\\\\", "\\")
+    if s == "true":
+        return True
+    if s == "false":
+        return False
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        return s  # bare value; garage.toml doesn't use these
+
+
+def _split_toml_array(s: str) -> list[str]:
+    out, depth, cur, quote = [], 0, "", None
+    for ch in s:
+        if quote:
+            cur += ch
+            if ch == quote and not cur.endswith("\\" + quote):
+                quote = None
+            continue
+        if ch in "\"'":
+            quote = ch
+            cur += ch
+        elif ch in "[{":
+            depth += 1
+            cur += ch
+        elif ch in "]}":
+            depth -= 1
+            cur += ch
+        elif ch == "," and depth == 0:
+            out.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur.strip():
+        out.append(cur)
+    return out
+
+
+def _toml_value(s: str):
+    s = s.strip()
+    if s.startswith("[") and s.endswith("]"):
+        return [_toml_value(p) for p in _split_toml_array(s[1:-1])]
+    if s.startswith("{") and s.endswith("}"):
+        d = {}
+        for pair in _split_toml_array(s[1:-1]):
+            k, _, v = pair.partition("=")
+            d[k.strip().strip('"')] = _toml_value(v)
+        return d
+    return _toml_scalar(s)
+
+
+def parse_toml_minimal(text: str) -> dict:
+    """Fallback TOML-subset parser for images without tomllib/tomli
+    (Python <= 3.10): sections, key = scalar/array/inline-table,
+    comments. Covers the full garage.toml surface this build reads;
+    NOT a general TOML implementation (no multi-line values, no
+    [[array-of-tables]], no date types)."""
+    root: dict = {}
+    cur = root
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            cur = root
+            for part in line[1:-1].split("."):
+                cur = cur.setdefault(part.strip().strip('"'), {})
+            continue
+        key, sep, val = line.partition("=")
+        if not sep:
+            raise ValueError(f"unparseable config line: {line!r}")
+        # cut at the first '#' that is outside any quoted string
+        quote = None
+        for i, ch in enumerate(val):
+            if quote:
+                if ch == quote:
+                    quote = None
+            elif ch in "\"'":
+                quote = ch
+            elif ch == "#":
+                val = val[:i]
+                break
+        cur[key.strip().strip('"')] = _toml_value(val)
+    return root
+
+
 def read_config(path: str) -> Config:
     """ref: util/config.rs:259 read_config. Env var GARAGE_RPC_SECRET etc.
     override file values (subset of the reference's layered secrets)."""
     with open(path, "rb") as f:
-        raw = tomllib.load(f)
+        data = f.read()
+    if tomllib is not None:
+        raw = tomllib.loads(data.decode())
+    else:
+        raw = parse_toml_minimal(data.decode())
     return config_from_dict(raw)
 
 
 def config_from_dict(raw: dict) -> Config:
     cfg = Config()
-    simple_fields = {f.name for f in dataclasses.fields(Config)} - {"data_dir", "tpu"}
+    simple_fields = {f.name for f in dataclasses.fields(Config)} \
+        - {"data_dir", "tpu", "qos"}
     for key, val in raw.items():
         if key == "data_dir":
             cfg.data_dir = _parse_data_dir(val)
         elif key == "tpu" and isinstance(val, dict):
             cfg.tpu = TpuConfig(**val)
+        elif key == "qos" and isinstance(val, dict):
+            cfg.qos = QosConfig(**val)
         elif key in ("s3_api", "k2v_api", "admin", "web",
                      "consul_discovery", "kubernetes_discovery"):
             # nested sections like the reference layout

@@ -50,6 +50,21 @@ class MetricsRegistry:
     def timer(self, name: str, **labels) -> "_Timer":
         return _Timer(self, name, labels)
 
+    def totals(self, name: str, **match) -> tuple[int, float]:
+        """Aggregate (count, sum) across every series of `name` whose
+        labels include all of `match` (qos governor latency source)."""
+        count, total = 0, 0.0
+        want = set((k, str(v)) for k, v in match.items())
+        for (n, labels), s in list(self._series.items()):
+            if n != name:
+                continue
+            if want and not want.issubset(
+                    (k, str(v)) for k, v in labels):
+                continue
+            count += s.count
+            total += s.total
+        return count, total
+
     def render(self) -> Iterator[str]:
         """Prometheus text lines: <name>_count, <name>_sum, <name>_max."""
         seen_help = set()

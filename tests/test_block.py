@@ -19,6 +19,12 @@ from garage_tpu.rpc import ReplicationMode, System
 from garage_tpu.rpc.layout import NodeRole
 from garage_tpu.utils.data import blake2sum
 
+try:
+    import zstandard  # noqa: F401
+    HAVE_ZSTD = True
+except ModuleNotFoundError:
+    HAVE_ZSTD = False  # block.py falls back to the zlib scheme
+
 NETID = b"block-test"
 
 
@@ -75,7 +81,9 @@ def test_datablock_roundtrip():
     data = b"hello world " * 100
     h = blake2sum(data)
     blk = DataBlock.compress(data)
-    assert blk.compression == 2  # compressible -> zstd (ref default)
+    # compressible -> zstd (ref default); zlib scheme when the wheel
+    # is absent (block.py fallback)
+    assert blk.compression == (2 if HAVE_ZSTD else 1)
     blk.verify(h)
     assert blk.plain_bytes() == data
     rt = DataBlock.unpack(blk.pack())
@@ -181,8 +189,12 @@ def test_local_store_and_corruption(tmp_path):
         f.write(_zlib.compress(old, 1))
     assert DataBlock.unpack(m.read_local(h_old)).plain_bytes() == old
     m.write_local(h_old, DataBlock.compress(old).pack())
-    assert m._find(h_old, [".zlib"]) is None  # old variant dropped
-    assert m._find(h_old, [".zst"]) is not None
+    if HAVE_ZSTD:
+        assert m._find(h_old, [".zlib"]) is None  # old variant dropped
+        assert m._find(h_old, [".zst"]) is not None
+    else:
+        # zlib fallback: the rewrite lands on the same-suffix path
+        assert m._find(h_old, [".zlib"]) is not None
 
     # corrupt the file on disk: read detects, quarantines, queues resync
     path = m._find(h, BLOCK_SUFFIXES)
