@@ -534,6 +534,59 @@ def bench_s3_put(nobj: int, obj_mib: int = 4, device: bool = False) -> dict:
         out = {"s3_put_gbps": round(best_put, 3),
                "s3_get_gbps": round(best_get, 3)}
         if not device:
+            # ---- range reads + readahead sweep (ISSUE 2) -------------
+            import json as _json
+
+            def admin_tuning(spec: dict) -> dict:
+                rq = urllib.request.Request(
+                    f"http://127.0.0.1:{srv.admin_port}/v1/s3/tuning",
+                    data=_json.dumps(spec).encode(), method="POST",
+                    headers={"authorization": "Bearer test-admin-token"})
+                with urllib.request.urlopen(rq, timeout=10) as r:
+                    return _json.loads(r.read().decode())
+
+            lo, hi = size // 4, size // 4 + size // 2  # mid-object,
+            # starts mid-block: exercises the partial-block slice path
+
+            def get_range(i):
+                st, _, b = cli.request(
+                    "GET", f"/bench/o{i}",
+                    headers={"range": f"bytes={lo}-{hi - 1}"},
+                    timeout=rq_timeout)
+                assert st == 206 and len(b) == hi - lo
+
+            best_range = 0.0
+            with concurrent.futures.ThreadPoolExecutor(4) as pool:
+                for _rep in range(3):
+                    t0 = time.perf_counter()
+                    list(pool.map(get_range, range(nobj)))
+                    dt = time.perf_counter() - t0
+                    best_range = max(best_range,
+                                     nobj * (hi - lo) / dt / 1e9)
+            out["s3_get_range_gbps"] = round(best_range, 3)
+
+            # GET throughput vs readahead depth (0 = the pre-pipeline
+            # sequential behavior, the fallback switch) — flipped at
+            # runtime through the admin API, no server restarts
+            sweep = {}
+            try:
+                with concurrent.futures.ThreadPoolExecutor(4) as pool:
+                    for ra in (0, 1, 3, 6):
+                        admin_tuning({"get_readahead_blocks": ra})
+                        best = 0.0
+                        for _rep in range(2):
+                            t0 = time.perf_counter()
+                            list(pool.map(get, range(nobj)))
+                            dt = time.perf_counter() - t0
+                            best = max(best, nobj * size / dt / 1e9)
+                        sweep[str(ra)] = round(best, 3)
+                out["s3_get_readahead_sweep"] = sweep
+                if sweep.get("0"):
+                    out["s3_get_readahead_speedup"] = round(
+                        max(sweep.values()) / sweep["0"], 2)
+            finally:
+                admin_tuning({"get_readahead_blocks": 3})
+        if not device:
             # multipart leg (BASELINE rows 3/4: big-part uploads):
             # 4 concurrent 8 MiB UploadParts + Complete, best of 2
             import xml.etree.ElementTree as ET
@@ -961,6 +1014,11 @@ def main() -> None:
         extra.update(bench_s3_put(8 if platform == "cpu" else 16))
     except Exception as e:
         extra["s3_put_error"] = f"{type(e).__name__}: {e}"[:300]
+    # the gap this PR tracks: how much of the internal block path's
+    # throughput the HTTP/signature frontend actually delivers
+    if extra.get("s3_put_gbps") and extra.get("put_gbps"):
+        extra["frontend_efficiency"] = round(
+            extra["s3_put_gbps"] / extra["put_gbps"], 3)
 
     # qos admission control: sustained PUTs + concurrent deep scrub
     # against a tight byte budget — admitted vs shed + governor action

@@ -145,6 +145,40 @@ class AdminHttpServer:
             raw = await req.body.read_all(limit=1 << 20)
             return json.loads(raw.decode()) if raw else None
 
+        if path == "/v1/s3/tuning":
+            # S3 data-plane knobs (README "S3 data-plane tuning"):
+            # runtime-readable AND writable so bench sweeps don't need a
+            # server restart per setting. Writes touch plain ints read
+            # fresh on every request — safe on a live node.
+            cfg = self.garage.config
+            if m == "POST":
+                spec = await body_json() or {}
+                # validate EVERYTHING before the first setattr — a 400
+                # must never leave half the update applied on a live
+                # node (same rule as the bucket-update handler below)
+                bounds = {"get_readahead_blocks": (0, 64),
+                          "put_blocks_max_parallel": (1, 64)}
+                validated = {}
+                for k, raw in spec.items():
+                    if k not in bounds:
+                        raise BadRequest(f"unknown s3 tuning knob {k!r}")
+                    lo, hi = bounds[k]
+                    v = int(raw)
+                    if v < lo or v > hi:
+                        raise BadRequest(f"{k} must be in [{lo}, {hi}]")
+                    validated[k] = v
+                for k, v in validated.items():
+                    setattr(cfg, "s3_" + k, v)
+            elif m != "GET":
+                return None
+            from ..api.http import DRAIN_HIGH_WATER
+
+            return _json({
+                "get_readahead_blocks": cfg.s3_get_readahead_blocks,
+                "put_blocks_max_parallel": cfg.s3_put_blocks_max_parallel,
+                "drain_high_water": DRAIN_HIGH_WATER,
+            })
+
         if path == "/v1/qos" and m == "GET":
             return _json(self._qos_state())
         if path == "/v1/qos" and m == "POST":
@@ -487,6 +521,8 @@ class AdminHttpServer:
             gauge("block_scrub_deep_stripes_checked", sw.deep_checked)
             out.append("# TYPE block_scrub_deep_stripes_repaired counter")
             gauge("block_scrub_deep_stripes_repaired", sw.deep_repaired)
+            out.append("# TYPE block_scrub_header_repaired counter")
+            gauge("block_scrub_header_repaired", sw.header_repaired)
 
         for t in g.all_tables():
             s = t.data.stats()

@@ -206,12 +206,26 @@ async def read_request(reader: asyncio.StreamReader,
                    version)
 
 
+# bytes buffered in the transport before the writer pauses to drain.
+# Draining after EVERY chunk costs an await (and often a scheduler trip)
+# per block; draining only past a high-water mark keeps the hot GET loop
+# on the fast path while still bounding memory to ~one mark per
+# connection on top of the transport's own buffer. Runtime-visible via
+# admin GET /v1/s3/tuning.
+DRAIN_HIGH_WATER = 1 << 20
+
+# coalesce head+body into one transport write below this body size: one
+# syscall for the whole response (the common XML/JSON/error case). Large
+# bodies are handed to the transport unjoined — no copy.
+_COALESCE_MAX = 64 * 1024
+
+
 async def write_response(writer: asyncio.StreamWriter, req: Optional[Request],
                          resp: Response, keep_alive: bool) -> None:
     head = [f"HTTP/1.1 {resp.status} {STATUS_REASONS.get(resp.status, 'X')}"]
     names = {n.lower() for n, _ in resp.headers}
     body = resp.body
-    fixed = isinstance(body, (bytes, bytearray))
+    fixed = isinstance(body, (bytes, bytearray, memoryview))
     # RFC 7230 §3.3.2: a message must not carry both Content-Length and
     # Transfer-Encoding. Streams whose length the handler declared are
     # written with content-length framing; only unknown-length streams
@@ -225,38 +239,92 @@ async def write_response(writer: asyncio.StreamWriter, req: Optional[Request],
         resp.headers.append(("connection", "keep-alive" if keep_alive else "close"))
     for n, v in resp.headers:
         head.append(f"{n}: {v}")
-    writer.write(("\r\n".join(head) + "\r\n\r\n").encode("latin-1"))
+    head_bytes = ("\r\n".join(head) + "\r\n\r\n").encode("latin-1")
     if req is not None and req.method == "HEAD":
+        writer.write(head_bytes)
         await writer.drain()
+        if not fixed:
+            await _aclose_body(body)
         return
     if fixed:
-        writer.write(bytes(body))
+        # zero-copy: bytes-like bodies go to the transport as-is (the
+        # old path re-materialized bytes(body), copying every bytearray
+        # and memoryview). Small responses coalesce head+body into one
+        # write — one packet for the whole response.
+        if body and len(body) <= _COALESCE_MAX:
+            writer.write(head_bytes + bytes(body))
+        else:
+            writer.write(head_bytes)
+            if body:
+                writer.write(body)
         await writer.drain()
-    elif chunked:
-        async for chunk in body:
-            if chunk:
-                writer.write(b"%x\r\n" % len(chunk) + bytes(chunk) + b"\r\n")
-                await writer.drain()
-        writer.write(b"0\r\n\r\n")
-        await writer.drain()
-    else:
-        declared = int(dict((n.lower(), v) for n, v in resp.headers)
-                       ["content-length"])
-        written = 0
-        async for chunk in body:
-            if chunk:
+        return
+    try:
+        if chunked:
+            pending = len(head_bytes)
+            first = True
+            async for chunk in body:
+                if not chunk:
+                    continue
+                # head (first time) + chunk-size line coalesce into one
+                # small write; the chunk itself is never copied
+                frame = b"%x\r\n" % len(chunk)
+                writer.write(head_bytes + frame if first else frame)
+                first = False
+                writer.write(chunk)
+                writer.write(b"\r\n")
+                pending += len(chunk)
+                if pending >= DRAIN_HIGH_WATER:
+                    await writer.drain()
+                    pending = 0
+            writer.write(head_bytes + b"0\r\n\r\n" if first
+                         else b"0\r\n\r\n")
+            await writer.drain()
+        else:
+            declared = int(dict((n.lower(), v) for n, v in resp.headers)
+                           ["content-length"])
+            written = 0
+            pending = len(head_bytes)
+            first = True
+            async for chunk in body:
+                if not chunk:
+                    continue
                 if written + len(chunk) > declared:
                     # never write past the declared boundary: the client
                     # would parse the excess as the next response
                     raise ConnectionError(
                         f"stream exceeds declared {declared} bytes")
-                writer.write(bytes(chunk))
+                if first:
+                    writer.write(head_bytes)
+                    first = False
+                writer.write(chunk)
                 written += len(chunk)
-                await writer.drain()
-        if written != declared:
-            # short stream would desync a keep-alive connection: abort
-            raise ConnectionError(
-                f"stream wrote {written} of {declared} declared bytes")
+                pending += len(chunk)
+                if pending >= DRAIN_HIGH_WATER:
+                    await writer.drain()
+                    pending = 0
+            if first:
+                writer.write(head_bytes)
+            await writer.drain()
+            if written != declared:
+                # short stream would desync a keep-alive conn: abort
+                raise ConnectionError(
+                    f"stream wrote {written} of {declared} declared bytes")
+    finally:
+        # deterministic generator shutdown: a client disconnect (write
+        # raising) or a mid-stream error must cancel the readahead
+        # pipeline NOW, not whenever the GC finalizes the generator
+        await _aclose_body(body)
+
+
+async def _aclose_body(body) -> None:
+    aclose = getattr(body, "aclose", None)
+    if aclose is None:
+        return
+    try:
+        await aclose()
+    except Exception:
+        pass  # the response is already dead; nothing to salvage
 
 
 class HttpServer:

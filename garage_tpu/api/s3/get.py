@@ -8,7 +8,9 @@ list; conditionals (If-None-Match / If-Modified-Since) answer 304.
 
 from __future__ import annotations
 
+import asyncio
 import datetime
+from collections import deque
 from typing import AsyncIterator, Optional
 
 from ..http import Request, Response
@@ -98,10 +100,19 @@ def _object_headers(version, meta) -> list[tuple[str, str]]:
 
 
 def parse_range(spec: str, size: int) -> Optional[tuple[int, int]]:
-    """'bytes=a-b' -> (start, end_exclusive), or None if unparsable."""
+    """'bytes=a-b' -> (start, end_exclusive), or None if unparsable.
+
+    Multi-range specs ('bytes=0-0,5-9') are rejected as a whole (-> 416
+    upstream): this server serves single ranges only, and silently
+    answering with just the first range hands the client a body it
+    didn't ask for — a multipart/byteranges consumer would misparse it.
+    """
     if not spec.startswith("bytes="):
         return None
-    r = spec[len("bytes="):].split(",")[0].strip()
+    ranges = [p for p in spec[len("bytes="):].split(",") if p.strip()]
+    if len(ranges) != 1:
+        return None
+    r = ranges[0].strip()
     start_s, _, end_s = r.partition("-")
     try:
         if start_s == "":
@@ -275,12 +286,10 @@ async def open_object_stream(garage, src_v, start: int, end: int,
                                         src_sse))
 
 
-async def _stream_blocks(garage, blocks, start: int, end: int,
-                         sse_key=None) -> AsyncIterator[bytes]:
-    """Stream [start, end) of the concatenated block list
-    (ref: get.rs body_from_blocks_range). Block sizes in the version
-    map are plaintext sizes; with `sse_key` each fetched block is
-    decrypted before slicing, so ranges address plaintext offsets."""
+def _plan_blocks(blocks, start: int, end: int) -> list[tuple[bytes, int, int]]:
+    """-> [(hash, lo, hi)] covering [start, end) of the concatenated
+    block list. lo/hi are plaintext offsets within each block."""
+    plan = []
     pos = 0
     for _key, (h, size) in blocks:
         if pos + size <= start:
@@ -288,10 +297,91 @@ async def _stream_blocks(garage, blocks, start: int, end: int,
             continue
         if pos >= end:
             break
+        plan.append((h, max(0, start - pos), min(size, end - pos)))
+        pos += size
+    return plan
+
+
+# decrypt below this size stays inline: a thread hop costs more than
+# the AES-GCM call itself (matches the put path's 64 KiB hash threshold)
+_DECRYPT_OFFLOAD_MIN = 64 * 1024
+
+
+def _slice(data, lo: int, hi: int):
+    """Zero-copy body slice: a partial block is served through a
+    memoryview instead of materializing a fresh bytes object (the HTTP
+    writer accepts any bytes-like)."""
+    if lo == 0 and hi >= len(data):
+        return data
+    return memoryview(data)[lo:hi]
+
+
+async def _stream_blocks(garage, blocks, start: int, end: int,
+                         sse_key=None) -> AsyncIterator[bytes]:
+    """Stream [start, end) of the concatenated block list
+    (ref: get.rs body_from_blocks_range + the ordered readahead buffer
+    it feeds). Block sizes in the version map are plaintext sizes; with
+    `sse_key` each fetched block is decrypted before slicing, so ranges
+    address plaintext offsets.
+
+    Readahead: up to `[s3_api] get_readahead_blocks` blocks beyond the
+    one currently being streamed are fetched concurrently with
+    asyncio.create_task, and yielded strictly in order — the next
+    block(s) ride the wire while the current one drains to the client,
+    so GET throughput is no longer one-block-RTT-at-a-time.
+    Per-block failover lives inside rpc_get_block and is unchanged; a
+    block that fails on every holder fails the stream exactly where the
+    sequential loop would have. Client disconnects close this generator
+    (http.write_response calls aclose), whose finally block cancels
+    every in-flight prefetch — no orphaned tasks.
+    get_readahead_blocks = 0 reproduces the sequential behavior."""
+    plan = _plan_blocks(blocks, start, end)
+    depth = getattr(garage.config, "s3_get_readahead_blocks", 3)
+
+    if depth <= 0:
+        # strictly sequential fallback switch
+        for h, lo, hi in plan:
+            data = await garage.block_manager.rpc_get_block(h)
+            if sse_key is not None:
+                data = sse_key.decrypt_block(data)
+            yield _slice(data, lo, hi)
+        return
+
+    async def fetch(h):
         data = await garage.block_manager.rpc_get_block(h)
         if sse_key is not None:
-            data = sse_key.decrypt_block(data)
-        lo = max(0, start - pos)
-        hi = min(size, end - pos)
-        yield data[lo:hi]
-        pos += size
+            # AES-GCM releases the GIL; MiB-scale blocks decrypt in a
+            # worker thread so the loop keeps serving other requests.
+            # Decrypting inside the prefetch task (not at yield time)
+            # overlaps decrypt with the wire, and ordered yields keep
+            # the plaintext sequence correct regardless of which
+            # prefetch finishes first.
+            if len(data) >= _DECRYPT_OFFLOAD_MIN:
+                data = await asyncio.to_thread(sse_key.decrypt_block, data)
+            else:
+                data = sse_key.decrypt_block(data)
+        return data
+
+    window: deque[asyncio.Task] = deque()
+    nxt = 0  # next plan index to schedule
+    try:
+        while nxt < len(plan) or window:
+            # current block + `depth` ahead may be in flight at once
+            while nxt < len(plan) and len(window) < depth + 1:
+                window.append(asyncio.create_task(fetch(plan[nxt][0])))
+                nxt += 1
+            _h, lo, hi = plan[nxt - len(window)]
+            # await while the task is STILL in the window: if this
+            # generator itself is cancelled mid-await, the task must
+            # remain reachable by the finally below or it leaks
+            data = await window[0]
+            window.popleft()
+            yield _slice(data, lo, hi)
+    finally:
+        # client went away (or a fetch failed): cancel synchronously
+        # first so nothing new starts, then settle the tasks so no
+        # "exception was never retrieved" noise outlives the request
+        for t in window:
+            t.cancel()
+        if window:
+            await asyncio.gather(*window, return_exceptions=True)

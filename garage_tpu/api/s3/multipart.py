@@ -148,22 +148,36 @@ async def handle_put_part(ctx, req: Request) -> Response:
 
 class _StreamReader:
     """Adapts an async byte-chunk generator to the body-reader interface
-    Chunker expects (read(n) returning b'' at EOF)."""
+    Chunker expects (read(n) returning b'' at EOF, never over-returning).
+
+    Fast path: with an empty carry buffer, a generator chunk that fits
+    the request passes through untouched — the GET readahead pipeline's
+    blocks reach the put pipeline (CopyObject re-encryption,
+    UploadPartCopy) without the old extend+slice+memmove round trip."""
 
     def __init__(self, gen):
         self._gen = gen
         self._buf = bytearray()
         self._eof = False
 
-    async def read(self, n: int = 65536) -> bytes:
+    async def read(self, n: int = 65536):
         while not self._eof and len(self._buf) < n:
             try:
-                self._buf.extend(await self._gen.__anext__())
+                chunk = await self._gen.__anext__()
             except StopAsyncIteration:
                 self._eof = True
+                break
+            if chunk and not self._buf and len(chunk) <= n:
+                return chunk  # zero-copy pass-through
+            self._buf.extend(chunk)
         out = bytes(self._buf[:n])
         del self._buf[:n]
         return out
+
+    async def aclose(self) -> None:
+        aclose = getattr(self._gen, "aclose", None)
+        if aclose is not None:
+            await aclose()
 
 
 async def handle_upload_part_copy(ctx, req: Request) -> Response:
@@ -178,7 +192,7 @@ async def handle_upload_part_copy(ctx, req: Request) -> Response:
     from ...model.helper import GarageHelper
     from .encryption import (check_key_for_meta, copy_source_sse_key,
                              request_sse_key)
-    from .get import _stream_blocks, parse_range
+    from .get import parse_range
 
     q = req.query
     try:
@@ -258,6 +272,10 @@ async def handle_upload_part_copy(ctx, req: Request) -> Response:
         except Exception:
             pass
         raise
+    finally:
+        # an aborted copy must cancel the source's readahead prefetches
+        # now, not at GC time
+        await source.aclose()
 
     done = MultipartUpload.new(mpu.upload_id, mpu.timestamp,
                                ctx.bucket_id, ctx.key)

@@ -27,8 +27,18 @@ from ...utils.data import gen_uuid
 from ..http import Request, Response
 from .xml import S3Error, bad_request
 
-PUT_BLOCKS_MAX_PARALLEL = 3  # ref: put.rs:42
+# default concurrent block writes in the put pipeline (ref: put.rs:42);
+# the live value comes from `[s3_api] put_blocks_max_parallel`
+# (config.s3_put_blocks_max_parallel), runtime-tunable via admin
+# POST /v1/s3/tuning so the bench can sweep it
+PUT_BLOCKS_MAX_PARALLEL = 3
 _MULTICORE = (os.cpu_count() or 1) > 1
+
+
+def put_parallelism(garage) -> int:
+    v = getattr(garage.config, "s3_put_blocks_max_parallel",
+                PUT_BLOCKS_MAX_PARALLEL)
+    return max(1, int(v or PUT_BLOCKS_MAX_PARALLEL))
 
 
 class Chunker:
@@ -50,7 +60,7 @@ class Chunker:
         # whole decoded client chunks, ignoring the requested size)
 
     async def next(self) -> Optional[bytes]:
-        chunks: list[bytes] = []
+        chunks: list = []
         have = 0
         if self._rest:
             chunks.append(self._rest)
@@ -67,11 +77,19 @@ class Chunker:
             return None
         whole = chunks[0] if len(chunks) == 1 else b"".join(chunks)
         if have > self.block_size:
-            self._rest = whole[self.block_size:]
-            whole = whole[:self.block_size]
+            # memoryview carry: the overshoot (an AwsChunkedReader can
+            # return a many-MiB client chunk) is carried as a zero-copy
+            # view over `whole`; the old bytes-slice pair copied both
+            # halves of every oversized chunk. The view is materialized
+            # exactly once, when it lands in a returned block below.
+            mv = memoryview(whole)
+            self._rest = mv[self.block_size:]
+            whole = mv[:self.block_size]
         if self.shape is not None:
             await self.shape(len(whole))
-        return whole
+        # downstream (hashing, encryption, the block RPC) expects real
+        # bytes; a view materializes here — ONE copy per block total
+        return whole if isinstance(whole, bytes) else bytes(whole)
 
 
 def extract_metadata_headers(req: Request) -> dict:
@@ -293,7 +311,8 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
     be stored/exposed — randomized here, structurally, whenever
     sse_key is set (ref: encryption.rs:210-222), so no call site can
     forget and leak the plaintext digest."""
-    sem = asyncio.Semaphore(PUT_BLOCKS_MAX_PARALLEL)
+    max_parallel = put_parallelism(garage)
+    sem = asyncio.Semaphore(max_parallel)
     tasks: list[asyncio.Task] = []
     offset = 0
     first_hash = None
@@ -372,7 +391,7 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
                 put_one(stored, offset, plain_len, h)))
             offset += plain_len
             # backpressure: don't build an unbounded task list
-            while len(tasks) > PUT_BLOCKS_MAX_PARALLEL:
+            while len(tasks) > max_parallel:
                 done, _ = await asyncio.wait(
                     tasks, return_when=asyncio.FIRST_COMPLETED)
                 for t in done:
@@ -529,12 +548,17 @@ async def handle_copy(ctx, req: Request) -> Response:
         headers = (extract_metadata_headers(req) if replace_meta
                    else {k: v for k, v in src_meta.headers.items()
                          if not k.startswith("x-garage-ssec-")})
-        uuid, ts, etag, _ = await save_stream(
-            helper_g, ctx.bucket_id, ctx.key, headers, source,
-            sse_key=dst_sse, content_length=src_meta.size,
-            quotas=(ctx.bucket.params.quotas.value or {})
-            if ctx.bucket is not None and ctx.bucket.params is not None
-            else None)
+        try:
+            uuid, ts, etag, _ = await save_stream(
+                helper_g, ctx.bucket_id, ctx.key, headers, source,
+                sse_key=dst_sse, content_length=src_meta.size,
+                quotas=(ctx.bucket.params.quotas.value or {})
+                if ctx.bucket is not None and ctx.bucket.params is not None
+                else None)
+        finally:
+            # an aborted copy must cancel the source's readahead
+            # prefetches now, not at GC time
+            await source.aclose()
         from .xml import xml, xml_response
 
         return xml_response(xml("CopyObjectResult",

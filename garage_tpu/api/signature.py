@@ -9,13 +9,21 @@ unsigned-with-trailer) so handlers see plain payload bytes.
 
 from __future__ import annotations
 
+import asyncio
 import datetime
 import hashlib
 import hmac
+import os
 from typing import Optional
 from urllib.parse import quote, unquote
 
 from .http import BodyReader, HttpError, Request
+
+# sha256 releases the GIL; chunks at/above this size hash in a worker
+# thread, overlapping with the next read instead of stalling the event
+# loop (same threshold discipline as the put pipeline's md5 offload)
+_HASH_OFFLOAD_MIN = 64 * 1024
+_MULTICORE = (os.cpu_count() or 1) > 1
 
 SERVICE = "s3"
 ALGORITHM = "AWS4-HMAC-SHA256"
@@ -225,17 +233,32 @@ async def _verify_presigned(req: Request, region: str, lookup_secret,
 
 
 class SignedPayloadReader:
-    """Whole-body sha256 check for x-amz-content-sha256=<hex> requests."""
+    """Whole-body sha256 check for x-amz-content-sha256=<hex> requests.
+
+    MiB-scale chunks hash in a worker thread, and the previous chunk's
+    hash runs WHILE the next chunk is read off the socket, so on
+    multicore the verification cost overlaps I/O instead of serializing
+    with it. Updates stay strictly ordered: the pending hash is awaited
+    before the next one is scheduled."""
 
     def __init__(self, inner: BodyReader, expect_hex: str):
         self.inner = inner
         self.h = hashlib.sha256()
         self.expect = expect_hex
+        self._hash_task: Optional[asyncio.Task] = None
 
     async def read(self, n: int = 65536) -> bytes:
-        chunk = await self.inner.read(n)
+        if self._hash_task is not None:
+            task, self._hash_task = self._hash_task, None
+            chunk, _ = await asyncio.gather(self.inner.read(n), task)
+        else:
+            chunk = await self.inner.read(n)
         if chunk:
-            self.h.update(chunk)
+            if _MULTICORE and len(chunk) >= _HASH_OFFLOAD_MIN:
+                self._hash_task = asyncio.create_task(
+                    asyncio.to_thread(self.h.update, chunk))
+            else:
+                self.h.update(chunk)
         elif self.h.hexdigest() != self.expect:
             raise HttpError(400, "payload checksum mismatch")
         return chunk
@@ -251,6 +274,9 @@ class SignedPayloadReader:
                 raise HttpError(413)
 
     async def drain(self):
+        if self._hash_task is not None:
+            task, self._hash_task = self._hash_task, None
+            await task
         await self.inner.drain()
 
 
@@ -262,6 +288,16 @@ class AwsChunkedReader:
     chunk signature = HMAC(sk, "AWS4-HMAC-SHA256-PAYLOAD" \n date \n
                       scope \n previous-sig \n sha256("") \n sha256(data))
     ref: streaming.rs.
+
+    Verification is PIPELINED: a returned chunk's sha256 runs in a
+    worker thread while the caller processes it and the next chunk is
+    read; the signature check settles at the start of the next read()
+    (the HMAC chain needs chunk order anyway). A forged chunk therefore
+    raises 403 one read later than the strictly-serial decoder did —
+    still before the body ever completes, so nothing a handler stores
+    can be finalized from a forged stream (the request aborts and the
+    upload is tombstoned), but MiB-scale hashing no longer serializes
+    with socket reads.
     """
 
     def __init__(self, inner: BodyReader, verified: VerifiedRequest,
@@ -277,6 +313,9 @@ class AwsChunkedReader:
         self.prev_sig = verified.signature
         self._buf = bytearray()
         self._done = False
+        # previously returned chunk awaiting verification:
+        # (data, sig, hash_task | None)
+        self._pending: Optional[tuple] = None
         self._checksummer = None
         if trailer_algo is not None:
             from .checksum import Checksummer
@@ -304,12 +343,42 @@ class AwsChunkedReader:
         del self._buf[:n]
         return out
 
-    def _chunk_string_to_sign(self, data: bytes) -> str:
+    def _chunk_string_to_sign(self, data_sha_hex: str) -> str:
         scope = f"{self.v.scope_date}/{self.region}/{SERVICE}/aws4_request"
         return "\n".join([
             "AWS4-HMAC-SHA256-PAYLOAD", self.amz_date, scope, self.prev_sig,
-            _sha256(b""), _sha256(data),
+            _sha256(b""), data_sha_hex,
         ])
+
+    def _start_hash(self, data: bytes):
+        if _MULTICORE and len(data) >= _HASH_OFFLOAD_MIN:
+            return asyncio.create_task(
+                asyncio.to_thread(lambda: _sha256(data)))
+        return None
+
+    def _verify_chunk_sig(self, data_sha_hex: str, sig: str) -> None:
+        """Check one chunk's signature and advance the HMAC chain."""
+        expect = hmac.new(self.v.signing_key,
+                          self._chunk_string_to_sign(data_sha_hex).encode(),
+                          hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(expect, sig):
+            raise HttpError(403, "chunk signature mismatch")
+        self.prev_sig = expect
+
+    async def _settle(self) -> None:
+        """Finish the previous chunk: await its off-thread sha256,
+        verify its signature (advancing the HMAC chain), feed the
+        trailing checksummer. Chunk order is preserved because at most
+        one chunk is ever pending."""
+        if self._pending is None:
+            return
+        data, sig, task = self._pending
+        self._pending = None
+        sha_hex = (await task) if task is not None else _sha256(data)
+        if self.signed:
+            self._verify_chunk_sig(sha_hex, sig)
+        if self._checksummer is not None:
+            self._checksummer.update(data)
 
     async def read(self, n: int = 1 << 30) -> bytes:
         """Returns one decoded chunk (ignores n except as a hint)."""
@@ -324,17 +393,16 @@ class AwsChunkedReader:
         sig = None
         if ext.startswith(b"chunk-signature="):
             sig = ext[len(b"chunk-signature="):].decode()
+        if self.signed and sig is None:
+            raise HttpError(403, "missing chunk signature")
         data = await self._read_exact(size)
-        if self.signed:
-            if sig is None:
-                raise HttpError(403, "missing chunk signature")
-            expect = hmac.new(self.v.signing_key,
-                              self._chunk_string_to_sign(data).encode(),
-                              hashlib.sha256).hexdigest()
-            if not hmac.compare_digest(expect, sig):
-                raise HttpError(403, "chunk signature mismatch")
-            self.prev_sig = expect
+        # the previous chunk's hash has been running while we read;
+        # settle it now — prev_sig must advance before this chunk's
+        # signature can be checked
+        await self._settle()
         if size == 0:
+            if self.signed:
+                self._verify_chunk_sig(_sha256(b""), sig)
             # trailer section follows the final chunk header directly
             # (ref: streaming.rs parse_next — no data CRLF here)
             if self.trailer:
@@ -345,8 +413,7 @@ class AwsChunkedReader:
             self._done = True
             return b""
         await self._read_exact(2)  # CRLF after data
-        if self._checksummer is not None:
-            self._checksummer.update(data)
+        self._pending = (data, sig, self._start_hash(data))
         return data
 
     async def _verify_trailer(self) -> None:
@@ -405,6 +472,14 @@ class AwsChunkedReader:
                 raise HttpError(413)
 
     async def drain(self):
+        # settle a pending off-thread hash so no task outlives the
+        # request (the verdict no longer matters: the body is being
+        # discarded, not stored)
+        if self._pending is not None:
+            _data, _sig, task = self._pending
+            self._pending = None
+            if task is not None:
+                await task
         await self.inner.drain()
 
 

@@ -355,27 +355,44 @@ class BlockManager:
             # verification happens inside: a decode is retried against
             # every distinct packed_len candidate before giving up
             return await self._get_erasure(hash32)
-        packed = await self._get_replicate(hash32)
-        blk = DataBlock.unpack(packed)
-        blk.verify(hash32)
-        return blk.plain_bytes()
+        packed, verified = await self._get_replicate(hash32)
 
-    async def _get_replicate(self, hash32: bytes) -> bytes:
+        def unpack_verify() -> bytes:
+            blk = DataBlock.unpack(packed)
+            if not verified:
+                blk.verify(hash32)
+            return blk.plain_bytes()
+
+        # MiB-scale decompress+hash release the GIL: run them in a
+        # worker thread so the GET readahead pipeline's prefetches
+        # genuinely overlap instead of serializing on the event loop
+        if len(packed) >= 64 * 1024:
+            return await asyncio.to_thread(unpack_verify)
+        return unpack_verify()
+
+    async def _get_replicate(self, hash32: bytes) -> tuple[bytes, bool]:
+        """-> (packed block, already_content_verified). Local reads
+        verify inside read_local — re-hashing the same MiB in
+        rpc_get_block doubled the CPU cost of every local GET block."""
         me = self.system.id
         errs = []
         for node in self.system.layout_helper.block_read_nodes_of(hash32):
             try:
                 if node == me:
-                    local = self.read_local(hash32)
+                    # off the event loop: a cold-cache disk read plus
+                    # the content verify would stall every other
+                    # request for milliseconds per block
+                    local = await asyncio.to_thread(self.read_local,
+                                                    hash32)
                     if local is not None:
-                        return local
+                        return local, True
                     continue
                 resp, _ = await self.endpoint.call(
                     node, {"op": "get", "hash": hash32, "part": None},
                     PRIO_NORMAL, timeout=60.0,
                 )
                 if resp.get("data") is not None:
-                    return resp["data"]
+                    return resp["data"], False
             except Exception as e:
                 errs.append(e)
         raise MissingBlock(hash32)
@@ -406,7 +423,7 @@ class BlockManager:
             if got is None:
                 continue
             gathered_any = True
-            parts, candidates = got
+            parts, candidates, _lens = got
             for packed_len in candidates:
                 try:
                     blk = DataBlock.unpack(
@@ -429,7 +446,9 @@ class BlockManager:
         """Fetch parts concurrently until `need` distinct indices are in
         hand; over-request nothing (systematic shards first, then the
         rest on failure). -> (parts, packed_len candidates ranked by
-        vote count, majority first) or None."""
+        vote count majority first, per-index header packed_len) or
+        None. The per-index map lets deep scrub see WHICH holder's
+        header disagrees with the majority (header rot repair)."""
         me = self.system.id
 
         async def fetch(node, idx):
@@ -455,7 +474,7 @@ class BlockManager:
                 return None
 
         parts: dict[int, bytes] = {}
-        lens: list[int] = []
+        lens_by_idx: dict[int, int] = {}
         order = list(enumerate(placement))  # systematic first by design
         i = 0
         pending: dict[asyncio.Task, int] = {}
@@ -474,9 +493,10 @@ class BlockManager:
                 r = t.result()
                 if r is not None:
                     parts[idx] = r[0]
-                    lens.append(r[1])
+                    lens_by_idx[idx] = r[1]
         if len(parts) < need:
             return None
+        lens = list(lens_by_idx.values())
         # MAJORITY packed_len, not last-arrival: the shard header's
         # length field is outside the shard checksum, so one rotted or
         # forged header must not poison the whole decode (deep-scrub
@@ -490,7 +510,7 @@ class BlockManager:
         # them in order.
         ranked = sorted(set(lens),
                         key=lambda v: (-lens.count(v), -v))
-        return parts, ranked
+        return parts, ranked, lens_by_idx
 
     # ==== refcount hooks (called from block_ref table trigger) ==========
 

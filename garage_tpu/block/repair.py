@@ -74,6 +74,7 @@ class ScrubWorker(Worker):
         self.deep = True
         self.deep_checked = 0  # stripes parity-checked as leader
         self.deep_repaired = 0  # flagged stripes fully repaired
+        self.header_repaired = 0  # shards rewritten for header rot
 
     def _due(self) -> bool:
         return (time.time() - self.state.last_completed
@@ -255,11 +256,11 @@ class ScrubWorker(Worker):
         # slow holder costs the batch max(latency), not the sum
         gathered = await asyncio.gather(
             *[m._gather_parts(h, p, m.codec.width) for h, p in leaders])
-        stripes, metas, flagged = [], [], []
+        stripes, metas, flagged, clean = [], [], [], []
         for (h, placement), got in zip(leaders, gathered):
             if got is None:
                 continue
-            parts, len_candidates = got
+            parts, len_candidates, lens_by_idx = got
             packed_len = len_candidates[0]  # majority vote
             self.deep_checked += 1
             stripe = [parts[i] for i in range(m.codec.width)]
@@ -269,27 +270,79 @@ class ScrubWorker(Worker):
                 # repair — stacking them would crash parity_check and a
                 # deterministic raise here would wedge the scrub cursor
                 # on this batch forever
-                flagged.append((h, parts, packed_len, placement))
+                flagged.append((h, parts, packed_len, placement,
+                                lens_by_idx))
                 continue
             stripes.append(stripe)
-            metas.append((h, parts, packed_len, placement))
+            metas.append((h, parts, packed_len, placement, lens_by_idx))
         if stripes:
             oks = await m.feeder.parity_check(stripes)
             flagged.extend(meta for ok, meta in zip(oks, metas) if not ok)
+            clean = [meta for ok, meta in zip(oks, metas) if ok]
         bad = 0
-        for h, parts, packed_len, placement in flagged:
+        for h, parts, packed_len, placement, lens in flagged:
             bad += 1
             repaired = await self._repair_stripe(h, parts, packed_len,
-                                                 placement)
+                                                 placement, lens)
             self.deep_repaired += bool(repaired)
             log.warning("deep scrub: stripe %s inconsistent (%s)",
                         h.hex()[:16],
                         "repaired" if repaired else "NOT repaired")
+        # header-rot pass over parity-CLEAN stripes (ADVICE r5): the
+        # packed_len field sits outside the shard checksum, so a rotted
+        # header passes every local check AND the cross-shard parity
+        # check (parity covers payload bytes only) — yet it poisons any
+        # future decode that lands on the wrong length. Rewrite each
+        # disagreeing shard (same payload, corrected header) on its
+        # holder. Flagged stripes are excluded on purpose: their
+        # payloads are suspect, and _repair_stripe's re-encode already
+        # regenerates correct headers for everything it pushes.
+        for h, parts, packed_len, placement, lens in clean:
+            bad_idx = [i for i, v in lens.items() if v != packed_len]
+            if not bad_idx:
+                continue
+            votes = sum(1 for v in lens.values() if v == packed_len)
+            if votes * 2 <= len(lens):
+                # no strict majority: rewriting could spread the rotted
+                # value instead of fixing it — leave for the read
+                # path's try-every-candidate logic and the operator
+                log.warning("deep scrub: stripe %s packed_len vote tied "
+                            "(%s); headers left untouched",
+                            h.hex()[:16], sorted(set(lens.values())))
+                continue
+            self.header_repaired += await self._repair_headers(
+                h, parts, packed_len, placement, bad_idx)
         return bad
 
+    async def _repair_headers(self, hash32: bytes, parts: dict[int, bytes],
+                              packed_len: int, placement: list[bytes],
+                              bad_idx: list[int]) -> int:
+        """Push a rewritten shard (held payload, majority packed_len
+        header) to every holder whose header disagreed; -> shards
+        fixed."""
+        from ..net.message import PRIO_BACKGROUND
+        from .manager import pack_shard
+
+        fixed = 0
+        for i in bad_idx:
+            try:
+                await self.manager.endpoint.call(
+                    placement[i],
+                    {"op": "put", "hash": hash32, "part": i,
+                     "data": pack_shard(parts[i], packed_len)},
+                    PRIO_BACKGROUND, timeout=60.0)
+                fixed += 1
+                log.warning("deep scrub: rewrote rotted header of shard "
+                            "%d of %s (packed_len -> %d)", i,
+                            hash32.hex()[:16], packed_len)
+            except Exception as e:
+                log.warning("deep scrub: header rewrite of shard %d of "
+                            "%s failed (%s)", i, hash32.hex()[:16], e)
+        return fixed
+
     async def _repair_stripe(self, hash32: bytes, parts: dict[int, bytes],
-                             packed_len: int, placement: list[bytes]
-                             ) -> bool:
+                             packed_len: int, placement: list[bytes],
+                             lens: dict[int, int] | None = None) -> bool:
         """Find + fix the corrupt shard(s) of a parity-inconsistent
         stripe. Ground truth is the block's content address: a decode
         from a candidate k-subset is right iff the unpacked block
@@ -362,7 +415,12 @@ class ScrubWorker(Worker):
         fixed = True
         for i, node in enumerate(placement[:w]):
             raw = bytes(framed[i])
-            if unpack_shard(raw)[0] == parts[i]:
+            good_payload, good_len = unpack_shard(raw)
+            if good_payload == parts[i] and (
+                    lens is None or lens.get(i) == good_len):
+                # payload AND header both right on this holder; a
+                # payload-identical shard with a rotted header must
+                # still be pushed or the rot survives the repair
                 continue
             try:
                 await m.endpoint.call(
@@ -393,6 +451,8 @@ class ScrubWorker(Worker):
         if self.manager.erasure and self.deep:
             cursor += (f" deep:{self.deep_checked}"
                        f"/{self.deep_repaired} repaired")
+            if self.header_repaired:
+                cursor += f" hdr:{self.header_repaired}"
         return WorkerInfo(
             name=self.name,
             progress=cursor,

@@ -182,7 +182,7 @@ class BlockResyncManager:
         """Needed but absent: get it (ref: resync.rs:462-505)."""
         m = self.manager
         if not m.erasure:
-            packed = await m._get_replicate(hash32)
+            packed, _verified = await m._get_replicate(hash32)
             m.write_local(hash32, packed)
             m.metrics["resync_recv"] += 1
             return
@@ -247,7 +247,7 @@ class BlockResyncManager:
         got = await m._gather_parts(hash32, placement, m.codec.read_need)
         if got is None:
             return None
-        parts, len_candidates = got
+        parts, len_candidates, _lens = got
         packed_len = len_candidates[0]  # majority vote
         if idx in parts:
             return pack_shard(parts[idx], packed_len)

@@ -211,6 +211,12 @@ class QosLimits:
 SCOPE_CACHE_MAX = 1024
 
 
+# bounded per-entity shed maps: beyond this many distinct keys/buckets
+# new entities aggregate under "(other)" — an attacker spraying key ids
+# must not grow operator-facing state without bound
+SHED_ENTITY_MAX = 256
+
+
 @dataclass
 class QosCounters:
     admitted: int = 0
@@ -219,14 +225,32 @@ class QosCounters:
     queued_seconds: float = 0.0
     shaped_bytes: int = 0
     shed_by_scope: dict = field(default_factory=dict)
+    # WHO is being shed, not just how much (ROADMAP "503 retry
+    # ergonomics"): per-key and per-bucket shed counts, surfaced top-N
+    # through GET /v1/qos. Only scoped sheds are attributable — global
+    # sheds happen before identity is resolved, by design.
+    shed_by_key: dict = field(default_factory=dict)
+    shed_by_bucket: dict = field(default_factory=dict)
 
-    def to_dict(self) -> dict:
+    def count_entity(self, table: dict, name: str) -> None:
+        if name not in table and len(table) >= SHED_ENTITY_MAX:
+            name = "(other)"
+        table[name] = table.get(name, 0) + 1
+
+    @staticmethod
+    def _top(table: dict, n: int) -> list[list]:
+        return [[k, v] for k, v in sorted(table.items(),
+                                          key=lambda kv: -kv[1])[:n]]
+
+    def to_dict(self, top_n: int = 10) -> dict:
         return {
             "admitted": self.admitted, "shed": self.shed,
             "queued_waits": self.queued_waits,
             "queued_seconds": round(self.queued_seconds, 6),
             "shaped_bytes": self.shaped_bytes,
             "shed_by_scope": dict(self.shed_by_scope),
+            "top_shed_keys": self._top(self.shed_by_key, top_n),
+            "top_shed_buckets": self._top(self.shed_by_bucket, top_n),
         }
 
 
@@ -354,6 +378,15 @@ class QosEngine:
                     raise
         except SlowDown as e:
             self._record_shed(e.scope)
+            # attribute the shed to the identity it hit: both entities
+            # recorded when known — "this key is being shed on this
+            # bucket" is exactly what the operator is debugging
+            if key_id is not None:
+                self.counters.count_entity(self.counters.shed_by_key,
+                                           key_id)
+            if bucket is not None:
+                self.counters.count_entity(self.counters.shed_by_bucket,
+                                           bucket)
             raise
 
     def _scope_bucket(self, cache: dict, key: str,

@@ -101,6 +101,15 @@ class Config:
     s3_api_bind_addr: Optional[str] = None
     s3_region: str = "garage"
     root_domain: str = ".s3.garage"
+    # [s3_api] data-plane tuning (no reference analogue; see README
+    # "S3 data-plane tuning"). get_readahead_blocks: how many blocks the
+    # GET path prefetches beyond the one currently streaming to the
+    # client (0 = strictly sequential, the pre-readahead behavior).
+    # put_blocks_max_parallel: concurrent block writes in the PUT
+    # pipeline (ref: put.rs:42 used a hard-coded 3). Both are runtime
+    # read/writable via admin `GET/POST /v1/s3/tuning` for bench sweeps.
+    s3_get_readahead_blocks: int = 3
+    s3_put_blocks_max_parallel: int = 3
     k2v_api_bind_addr: Optional[str] = None
     admin_api_bind_addr: Optional[str] = None
     admin_token: Optional[str] = None

@@ -502,6 +502,64 @@ def test_deep_scrub_repairs_wrong_length_shard(tmp_path):
     run(main())
 
 
+def test_deep_scrub_repairs_rotted_header(tmp_path):
+    """Header rot (ADVICE r5): the shard header's packed_len sits
+    OUTSIDE the shard checksum, so a rotted header passes local
+    validation AND the cross-shard parity check (parity covers payload
+    bytes only) — invisible to every scrub pass before this one. Deep
+    scrub must compare each shard's header against the stripe majority
+    and push a rewritten shard (same payload, corrected header) to the
+    disagreeing holder."""
+    async def main():
+        from garage_tpu.block import ScrubWorker
+
+        net, systems, managers, tasks = await make_block_cluster(
+            tmp_path, n=6, rf=3, erasure=(4, 2)
+        )
+        try:
+            data = os.urandom(180_000)
+            h = blake2sum(data)
+            await managers[0].rpc_put_block(h, data)
+            for _ in range(100):
+                held = sorted(i for m in managers for i in m.local_parts(h))
+                if held == [0, 1, 2, 3, 4, 5]:
+                    break
+                await asyncio.sleep(0.02)
+            assert held == [0, 1, 2, 3, 4, 5]
+
+            layout = systems[0].layout_helper.current()
+            placement = shard_nodes_of(layout, h, 6)
+            leader = next(m for m in managers
+                          if m.system.id == placement[0])
+
+            # rot shard 2's header: SAME payload, forged packed_len —
+            # local checksum scrub and the parity kernel both pass
+            victim = next(m for m in managers if 2 in m.local_parts(h))
+            raw = victim.read_local_shard(h, 2)
+            payload, true_len = unpack_shard(raw)
+            victim.write_local_shard(h, 2, pack_shard(payload, 999_999))
+            assert victim.read_local_shard(h, 2) is not None  # passes local
+
+            sw = ScrubWorker(leader)
+            bad = await sw.scrub_batch([h])
+            # payload is intact, so this is NOT a content corruption...
+            assert bad == 0
+            # ...but the header was rewritten back to the majority value
+            assert sw.header_repaired == 1
+            fixed_payload, fixed_len = unpack_shard(
+                victim.read_local_shard(h, 2))
+            assert fixed_payload == payload
+            assert fixed_len == true_len
+            # clean second pass: nothing left to repair
+            assert await sw.scrub_batch([h]) == 0
+            assert sw.header_repaired == 1
+            assert await managers[1].rpc_get_block(h) == data
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
 def test_deep_scrub_repairs_data_plus_parity_double_corruption(tmp_path):
     """RS(4,2) tolerates two losses; deep scrub localizes a double
     corruption of one DATA and one PARITY shard: the data exclusion

@@ -175,6 +175,42 @@ def test_engine_per_key_isolation():
     run(main())
 
 
+def test_shed_visibility_per_key_and_bucket():
+    """Operators need to see WHO is being shed (ROADMAP '503 retry
+    ergonomics'): scoped sheds are attributed to the key and bucket
+    they hit, surfaced top-N-sorted through state() -> GET /v1/qos."""
+    async def main():
+        clk = [0.0]
+        eng = QosEngine(QosLimits(per_key_rps=1.0, max_wait_s=0.0),
+                        clock=lambda: clk[0])
+        for key, bucket, n in (("hot", "logs", 5), ("warm", "logs", 2),
+                               ("cold", "media", 1)):
+            await eng.admit_scoped(key_id=key, bucket=bucket)  # burst token
+            for _ in range(n):
+                with pytest.raises(SlowDown):
+                    await eng.admit_scoped(key_id=key, bucket=bucket)
+        c = eng.counters.to_dict()
+        assert c["top_shed_keys"] == [["hot", 5], ["warm", 2], ["cold", 1]]
+        assert c["top_shed_buckets"] == [["logs", 7], ["media", 1]]
+        assert eng.state()["counters"]["top_shed_keys"][0] == ["hot", 5]
+
+    run(main())
+
+
+def test_shed_entity_map_is_bounded():
+    """An attacker spraying distinct key ids must not grow the shed
+    attribution maps without bound: past the cap, new entities
+    aggregate under '(other)'."""
+    from garage_tpu.qos.limiter import SHED_ENTITY_MAX, QosCounters
+
+    c = QosCounters()
+    for i in range(SHED_ENTITY_MAX + 50):
+        c.count_entity(c.shed_by_key, f"key{i}")
+    assert len(c.shed_by_key) <= SHED_ENTITY_MAX + 1
+    assert c.shed_by_key["(other)"] == 50
+    assert sum(c.shed_by_key.values()) == SHED_ENTITY_MAX + 50
+
+
 # ---- governor ------------------------------------------------------------
 
 

@@ -335,6 +335,62 @@ def test_range_get(client):
     assert status == 416
 
 
+def test_multi_range_rejected_416(client):
+    """bytes=a-b,c-d: this server serves single ranges only; silently
+    answering with just the first range hands the client a body it
+    didn't ask for, so the whole spec is rejected."""
+    body = os.urandom(50_000)
+    client.request("PUT", "/conformance/mrange", body=body)
+    status, hdrs, _ = client.request(
+        "GET", "/conformance/mrange",
+        headers={"range": "bytes=0-0,5-9"})
+    assert status == 416
+    assert hdrs["content-range"] == f"bytes */{len(body)}"
+    # a single range with a trailing comma is still one range
+    status, _, got = client.request(
+        "GET", "/conformance/mrange", headers={"range": "bytes=0-4,"})
+    assert status == 206 and got == body[:5]
+
+
+def test_get_readahead_runtime_toggle(server, client):
+    """Admin /v1/s3/tuning flips the GET readahead depth at runtime;
+    multi-block reads must be byte-identical at every setting (the
+    bench sweeps this knob the same way)."""
+    body = os.urandom(300_000)  # ~5 blocks at the 64 KiB test block size
+    client.request("PUT", "/conformance/rahead", body=body)
+    st, got = _admin(server, "GET", "/v1/s3/tuning")
+    assert st == 200
+    assert got["get_readahead_blocks"] == 3  # config default
+    assert got["put_blocks_max_parallel"] == 3
+    try:
+        for depth in (0, 1, 3):
+            st, got = _admin(server, "POST", "/v1/s3/tuning",
+                             body={"get_readahead_blocks": depth})
+            assert st == 200 and got["get_readahead_blocks"] == depth
+            st, _, data = client.request("GET", "/conformance/rahead")
+            assert st == 200 and data == body
+            st, _, data = client.request(
+                "GET", "/conformance/rahead",
+                headers={"range": "bytes=70000-250000"})
+            assert st == 206 and data == body[70000:250001]
+        st, _ = _admin(server, "POST", "/v1/s3/tuning",
+                       body={"put_blocks_max_parallel": 0})
+        assert st == 400
+        st, _ = _admin(server, "POST", "/v1/s3/tuning",
+                       body={"bogus_knob": 1})
+        assert st == 400
+        # atomic: a rejected update must not partially apply
+        st, _ = _admin(server, "POST", "/v1/s3/tuning",
+                       body={"get_readahead_blocks": 9,
+                             "put_blocks_max_parallel": 0})
+        assert st == 400
+        st, got = _admin(server, "GET", "/v1/s3/tuning")
+        assert got["get_readahead_blocks"] == 3  # untouched by the 400
+    finally:
+        _admin(server, "POST", "/v1/s3/tuning",
+               body={"get_readahead_blocks": 3})
+
+
 def test_conditional_get(client):
     client.request("PUT", "/conformance/cond", body=b"conditional")
     status, hdrs, _ = client.request("GET", "/conformance/cond")

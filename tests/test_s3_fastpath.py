@@ -1,0 +1,471 @@
+"""S3 data-plane fast path: GET readahead pipeline, overlapped SigV4
+hashing, zero-copy chunker carry, and single-range enforcement.
+
+These are unit-level tests against fakes (no forked server): the
+readahead pipeline's ordering/cancellation contract is about task
+scheduling, which a conformance GET can't observe.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac
+import types
+
+import pytest
+
+from garage_tpu.api.s3.get import _plan_blocks, _stream_blocks, parse_range
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---- fakes ---------------------------------------------------------------
+
+
+class FakeBlockManager:
+    """rpc_get_block with per-hash delay/failure injection and
+    concurrency accounting."""
+
+    def __init__(self, store: dict, delays: dict | None = None,
+                 fail: set | None = None):
+        self.store = store
+        self.delays = delays or {}
+        self.fail = fail or set()
+        self.inflight = 0
+        self.max_inflight = 0
+        self.started: list[bytes] = []
+        self.cancelled = 0
+
+    async def rpc_get_block(self, h: bytes) -> bytes:
+        self.started.append(h)
+        self.inflight += 1
+        self.max_inflight = max(self.max_inflight, self.inflight)
+        try:
+            await asyncio.sleep(self.delays.get(h, 0.001))
+            if h in self.fail:
+                raise RuntimeError("all holders failed")
+            return self.store[h]
+        except asyncio.CancelledError:
+            self.cancelled += 1
+            raise
+        finally:
+            self.inflight -= 1
+
+
+def make_garage(bm: FakeBlockManager, readahead: int = 3):
+    return types.SimpleNamespace(
+        config=types.SimpleNamespace(s3_get_readahead_blocks=readahead),
+        block_manager=bm)
+
+
+def make_blocks(n: int, size: int = 100):
+    store = {bytes([i]) * 4: bytes([i]) * size for i in range(n)}
+    blocks = [((1, i * size), (bytes([i]) * 4, size)) for i in range(n)]
+    return store, blocks
+
+
+async def collect(gen) -> bytes:
+    return b"".join([bytes(c) async for c in gen])
+
+
+# ---- readahead pipeline --------------------------------------------------
+
+
+def test_readahead_preserves_order_under_skewed_latency():
+    """A slow FIRST block must not let faster later blocks jump the
+    queue, and later blocks must actually overlap it."""
+    async def main():
+        store, blocks = make_blocks(8)
+        bm = FakeBlockManager(store, delays={b"\x00" * 4: 0.1})
+        out = await collect(_stream_blocks(make_garage(bm), blocks, 0, 800))
+        assert out == b"".join(store[bytes([i]) * 4] for i in range(8))
+        assert bm.max_inflight > 1  # genuine readahead happened
+        # window never exceeds current + readahead depth
+        assert bm.max_inflight <= 4
+
+    run(main())
+
+
+def test_readahead_zero_is_strictly_sequential():
+    async def main():
+        store, blocks = make_blocks(6)
+        bm = FakeBlockManager(store)
+        out = await collect(
+            _stream_blocks(make_garage(bm, readahead=0), blocks, 0, 600))
+        assert out == b"".join(store[bytes([i]) * 4] for i in range(6))
+        assert bm.max_inflight == 1
+
+    run(main())
+
+
+def test_readahead_failed_block_fails_stream_and_leaks_nothing():
+    async def main():
+        store, blocks = make_blocks(8)
+        bm = FakeBlockManager(store, fail={b"\x03" * 4})
+        got = []
+        with pytest.raises(RuntimeError):
+            async for c in _stream_blocks(make_garage(bm), blocks, 0, 800):
+                got.append(bytes(c))
+        # blocks before the failure arrived, in order
+        assert got == [store[bytes([i]) * 4] for i in range(3)]
+        await asyncio.sleep(0.05)
+        assert bm.inflight == 0  # prefetches past the failure cancelled
+
+    run(main())
+
+
+def test_readahead_client_disconnect_cancels_prefetches():
+    """aclose (what http.write_response does when the client goes away)
+    must cancel every in-flight prefetch promptly — no orphaned tasks
+    keeping block fetches alive after the connection died."""
+    async def main():
+        store, blocks = make_blocks(8)
+        delays = {h: 5.0 for h in store}
+        delays[b"\x00" * 4] = 0.0
+        bm = FakeBlockManager(store, delays=delays)
+        gen = _stream_blocks(make_garage(bm), blocks, 0, 800)
+        first = await gen.__anext__()
+        assert bytes(first) == store[b"\x00" * 4]
+        assert bm.inflight == 3  # readahead window in flight
+        await gen.aclose()
+        assert bm.inflight == 0
+        assert bm.cancelled == 3
+        # nothing else still running on the loop for this stream
+        assert not [t for t in asyncio.all_tasks()
+                    if t is not asyncio.current_task()]
+
+    run(main())
+
+
+def test_readahead_consumer_task_cancel_cancels_current_fetch():
+    """Cancelling the consuming TASK mid-await (connection task torn
+    down) must also cancel the block fetch being awaited — it is popped
+    from the window only after it completes, so the generator's finally
+    can still reach it."""
+    async def main():
+        store, blocks = make_blocks(8)
+        bm = FakeBlockManager(store, delays={h: 5.0 for h in store})
+
+        async def consume():
+            async for _ in _stream_blocks(make_garage(bm), blocks, 0, 800):
+                pass
+
+        t = asyncio.create_task(consume())
+        await asyncio.sleep(0.05)
+        assert bm.inflight == 4
+        t.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await t
+        assert bm.inflight == 0
+        assert bm.cancelled == 4
+
+    run(main())
+
+
+def test_readahead_range_starting_mid_block():
+    async def main():
+        store, blocks = make_blocks(8)
+        whole = b"".join(store[bytes([i]) * 4] for i in range(8))
+        bm = FakeBlockManager(store)
+        out = await collect(_stream_blocks(make_garage(bm), blocks,
+                                           150, 420))
+        assert out == whole[150:420]
+        assert len(bm.started) == 4  # blocks 1..4 only — no over-fetch
+
+    run(main())
+
+
+def test_readahead_ssec_decrypt_ordering():
+    """With SSE-C, decrypt happens inside prefetch tasks that finish out
+    of order; the plaintext must still stream in block order."""
+    class XorKey:
+        def decrypt_block(self, data):
+            return bytes(b ^ 0x5A for b in data)
+
+    async def main():
+        key = XorKey()
+        plain, blocks = make_blocks(6)
+        store = {h: key.decrypt_block(v) for h, v in plain.items()}  # "cipher"
+        delays = {bytes([i]) * 4: 0.05 - i * 0.008 for i in range(6)}
+        bm = FakeBlockManager(store, delays=delays)
+        out = await collect(_stream_blocks(make_garage(bm), blocks,
+                                           0, 600, sse_key=key))
+        assert out == b"".join(plain[bytes([i]) * 4] for i in range(6))
+
+    run(main())
+
+
+def test_plan_blocks_slices():
+    _, blocks = make_blocks(3, size=10)
+    assert _plan_blocks(blocks, 0, 30) == [
+        (b"\x00" * 4, 0, 10), (b"\x01" * 4, 0, 10), (b"\x02" * 4, 0, 10)]
+    assert _plan_blocks(blocks, 12, 18) == [(b"\x01" * 4, 2, 8)]
+    assert _plan_blocks(blocks, 5, 25) == [
+        (b"\x00" * 4, 5, 10), (b"\x01" * 4, 0, 10), (b"\x02" * 4, 0, 5)]
+    assert _plan_blocks(blocks, 30, 30) == []
+
+
+# ---- parse_range single-range enforcement --------------------------------
+
+
+def test_parse_range_single_ranges_still_work():
+    assert parse_range("bytes=0-99", 1000) == (0, 100)
+    assert parse_range("bytes=500-", 1000) == (500, 1000)
+    assert parse_range("bytes=-200", 1000) == (800, 1000)
+    assert parse_range("bytes=0-4,", 1000) == (0, 5)  # trailing comma
+
+
+def test_parse_range_multi_range_rejected():
+    """bytes=0-0,5-9 used to silently serve only the first range — a
+    multipart/byteranges consumer would misparse the body. Reject the
+    whole spec (-> 416 upstream) instead."""
+    assert parse_range("bytes=0-0,5-9", 1000) is None
+    assert parse_range("bytes=0-4,10-14,20-24", 1000) is None
+    assert parse_range("bytes=-5,0-1", 1000) is None
+
+
+# ---- overlapped SigV4 hashing --------------------------------------------
+
+
+class ListBody:
+    """BodyReader stand-in yielding preset chunks."""
+
+    def __init__(self, chunks):
+        self.chunks = list(chunks)
+
+    async def read(self, n: int = 65536) -> bytes:
+        if not self.chunks:
+            return b""
+        return self.chunks.pop(0)
+
+    async def drain(self):
+        self.chunks = []
+
+
+def test_signed_payload_reader_offloaded_hash_verifies():
+    from garage_tpu.api.signature import SignedPayloadReader
+
+    async def main():
+        import os
+
+        # chunks above AND below the offload threshold, interleaved
+        chunks = [os.urandom(200_000), b"small", os.urandom(70_000),
+                  b"x" * 10]
+        body = b"".join(chunks)
+        r = SignedPayloadReader(ListBody(chunks),
+                               hashlib.sha256(body).hexdigest())
+        got = await r.read_all()
+        assert got == body
+
+    run(main())
+
+
+def test_signed_payload_reader_rejects_bad_hash():
+    from garage_tpu.api.http import HttpError
+    from garage_tpu.api.signature import SignedPayloadReader
+
+    async def main():
+        import os
+
+        chunks = [os.urandom(200_000), os.urandom(100_000)]
+        r = SignedPayloadReader(ListBody(chunks), "0" * 64)
+        with pytest.raises(HttpError) as ei:
+            await r.read_all()
+        assert ei.value.status == 400
+
+    run(main())
+
+
+def _chunked_wire(chunks, secret, region="garage", amz_date="20260803T000000Z",
+                  scope_date="20260803", corrupt_at=None):
+    """Build a signed aws-chunked body + the VerifiedRequest seed sig,
+    mirroring tests/s3util.py's independent signer."""
+    from garage_tpu.api.signature import VerifiedRequest, signing_key
+
+    sk = signing_key(secret, scope_date, region)
+    seed = "0" * 64
+    scope = f"{scope_date}/{region}/s3/aws4_request"
+    prev = seed
+    wire = b""
+    empty = hashlib.sha256(b"").hexdigest()
+    for i, c in enumerate(list(chunks) + [b""]):
+        sts = "\n".join(["AWS4-HMAC-SHA256-PAYLOAD", amz_date, scope, prev,
+                         empty, hashlib.sha256(c).hexdigest()])
+        sig = hmac.new(sk, sts.encode(), hashlib.sha256).hexdigest()
+        prev = sig
+        if corrupt_at is not None and i == corrupt_at:
+            sig = "f" * 64
+        wire += b"%x;chunk-signature=%s\r\n" % (len(c), sig.encode())
+        wire += c + b"\r\n" if c else b"\r\n"
+    v = VerifiedRequest("key", "STREAMING-AWS4-HMAC-SHA256-PAYLOAD",
+                        seed, scope_date, sk, False)
+    return wire, v, amz_date
+
+
+def test_aws_chunked_reader_pipelined_verification_accepts():
+    from garage_tpu.api.signature import AwsChunkedReader
+
+    async def main():
+        import os
+
+        chunks = [os.urandom(150_000), os.urandom(80_000), b"tail"]
+        wire, v, amz_date = _chunked_wire(chunks, "secret")
+        r = AwsChunkedReader(ListBody([wire]), v, "garage", amz_date,
+                             signed=True)
+        assert await r.read_all() == b"".join(chunks)
+
+    run(main())
+
+
+def test_aws_chunked_reader_forged_chunk_still_403s():
+    """Verification is deferred one read for overlap — but a forged
+    chunk MUST still fail the request before the body completes."""
+    from garage_tpu.api.http import HttpError
+    from garage_tpu.api.signature import AwsChunkedReader
+
+    async def main():
+        import os
+
+        for corrupt_at in (0, 1, 2):
+            chunks = [os.urandom(150_000), os.urandom(80_000), b"tail"]
+            wire, v, amz_date = _chunked_wire(chunks, "secret",
+                                              corrupt_at=corrupt_at)
+            r = AwsChunkedReader(ListBody([wire]), v, "garage", amz_date,
+                                 signed=True)
+            with pytest.raises(HttpError) as ei:
+                await r.read_all()
+            assert ei.value.status == 403
+
+    run(main())
+
+
+# ---- Chunker carry path --------------------------------------------------
+
+
+def test_chunker_memoryview_carry_reassembles():
+    """An oversize upstream chunk (aws-chunked clients pick their own
+    chunk size) is carried as a memoryview; every emitted block must be
+    real bytes of exactly block_size."""
+    from garage_tpu.api.s3.put import Chunker
+
+    async def main():
+        import os
+
+        big = os.urandom(1_000_000)  # ~3.8 blocks of 256 KiB
+        ch = Chunker(ListBody([big, b"xy"]), 256 * 1024)
+        out = []
+        while True:
+            b = await ch.next()
+            if b is None:
+                break
+            assert isinstance(b, bytes)
+            assert len(b) <= 256 * 1024
+            out.append(b)
+        assert b"".join(out) == big + b"xy"
+        assert all(len(b) == 256 * 1024 for b in out[:-1])
+
+    run(main())
+
+
+# ---- zero-copy HTTP writer -----------------------------------------------
+
+
+class FakeWriter:
+    def __init__(self):
+        self.writes: list[bytes] = []
+        self.drains = 0
+
+    def write(self, data):
+        self.writes.append(bytes(data))
+
+    async def drain(self):
+        self.drains += 1
+
+
+def test_write_response_coalesces_head_and_small_body():
+    from garage_tpu.api.http import Response, write_response
+
+    async def main():
+        w = FakeWriter()
+        await write_response(w, None, Response(200, [], b"hello"), True)
+        assert len(w.writes) == 1  # ONE transport write for the response
+        assert w.writes[0].endswith(b"\r\n\r\nhello")
+
+    run(main())
+
+
+def test_write_response_streams_memoryviews_with_bounded_drains():
+    from garage_tpu.api.http import Response, write_response
+
+    async def main():
+        blocks = [memoryview(bytes([i]) * 65536) for i in range(16)]
+
+        async def gen():
+            for b in blocks:
+                yield b
+
+        total = sum(len(b) for b in blocks)
+        resp = Response(200, [("content-length", str(total))], gen())
+        w = FakeWriter()
+        await write_response(w, None, resp, True)
+        body = b"".join(w.writes)
+        assert body.endswith(b"".join(bytes(b) for b in blocks))
+        # high-water draining: far fewer drains than chunks
+        assert w.drains < len(blocks)
+
+    run(main())
+
+
+def test_write_response_chunked_framing_intact():
+    from garage_tpu.api.http import Response, write_response
+
+    async def main():
+        async def gen():
+            yield b"abc"
+            yield memoryview(b"defg")
+
+        w = FakeWriter()
+        await write_response(w, None, Response(200, [], gen()), True)
+        raw = b"".join(w.writes)
+        head, _, body = raw.partition(b"\r\n\r\n")
+        assert b"transfer-encoding: chunked" in head
+        assert body == b"3\r\nabc\r\n4\r\ndefg\r\n0\r\n\r\n"
+
+    run(main())
+
+
+def test_write_response_closes_generator_on_write_failure():
+    """A client disconnect mid-stream must aclose the body generator
+    (which is what cancels the readahead pipeline)."""
+    from garage_tpu.api.http import Response, write_response
+
+    class ExplodingWriter(FakeWriter):
+        def __init__(self):
+            super().__init__()
+            self.n = 0
+
+        def write(self, data):
+            self.n += 1
+            if self.n > 1:
+                raise ConnectionError("peer reset")
+            super().write(data)
+
+    closed = {"v": False}
+
+    async def gen():
+        try:
+            for i in range(10):
+                yield b"x" * 70000
+        finally:
+            closed["v"] = True
+
+    async def main():
+        resp = Response(200, [("content-length", str(700000))], gen())
+        with pytest.raises(ConnectionError):
+            await write_response(ExplodingWriter(), None, resp, True)
+        assert closed["v"]
+
+    run(main())
