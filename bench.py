@@ -477,6 +477,25 @@ def bench_s3_put(nobj: int, obj_mib: int = 4, device: bool = False) -> dict:
         cli = S3Client("127.0.0.1", srv.s3_port, srv.key_id, srv.secret)
         st, _, body = cli.request("PUT", "/bench")
         assert st == 200, body
+
+        import json as _json
+
+        def admin_tuning(spec: dict | None = None) -> dict:
+            """POST (spec given) or GET the live /v1/s3/tuning knobs."""
+            rq = urllib.request.Request(
+                f"http://127.0.0.1:{srv.admin_port}/v1/s3/tuning",
+                data=(_json.dumps(spec).encode()
+                      if spec is not None else None),
+                method="POST" if spec is not None else "GET",
+                headers={"authorization": "Bearer test-admin-token"})
+            with urllib.request.urlopen(rq, timeout=10) as r:
+                return _json.loads(r.read().decode())
+
+        # cache OFF for every cold segment: s3_put/get/range/readahead
+        # numbers must keep measuring the store path (and stay
+        # comparable with pre-cache rounds); the hot-cache segment
+        # below re-enables it explicitly
+        admin_tuning({"read_cache_max_bytes": 0})
         size = obj_mib << 20
         data = np.random.default_rng(7).integers(
             0, 256, size, dtype=np.uint8).tobytes()
@@ -535,16 +554,6 @@ def bench_s3_put(nobj: int, obj_mib: int = 4, device: bool = False) -> dict:
                "s3_get_gbps": round(best_get, 3)}
         if not device:
             # ---- range reads + readahead sweep (ISSUE 2) -------------
-            import json as _json
-
-            def admin_tuning(spec: dict) -> dict:
-                rq = urllib.request.Request(
-                    f"http://127.0.0.1:{srv.admin_port}/v1/s3/tuning",
-                    data=_json.dumps(spec).encode(), method="POST",
-                    headers={"authorization": "Bearer test-admin-token"})
-                with urllib.request.urlopen(rq, timeout=10) as r:
-                    return _json.loads(r.read().decode())
-
             lo, hi = size // 4, size // 4 + size // 2  # mid-object,
             # starts mid-block: exercises the partial-block slice path
 
@@ -586,6 +595,45 @@ def bench_s3_put(nobj: int, obj_mib: int = 4, device: bool = False) -> dict:
                         max(sweep.values()) / sweep["0"], 2)
             finally:
                 admin_tuning({"get_readahead_blocks": 3})
+
+            # ---- hot-block read cache (ISSUE 3) ----------------------
+            # cache on/off sweep under the SAME harness: 8 client
+            # threads (the 4-thread s3_get leg above can bottleneck on
+            # the Python client; hot-vs-cold is about the SERVER's
+            # per-GET work, so drive it harder), cache sized to hold
+            # the working set twice over, one warming pass to fill
+            # probation, timed re-reads promote + hit; then the
+            # identical loop with the cache off for the cold leg.
+            def timed_get_pass(reps=3):
+                best = 0.0
+                with concurrent.futures.ThreadPoolExecutor(8) as p:
+                    for _rep in range(reps):
+                        t0 = time.perf_counter()
+                        list(p.map(get, range(nobj)))
+                        dt = time.perf_counter() - t0
+                        best = max(best, nobj * size / dt / 1e9)
+                return best
+
+            try:
+                admin_tuning({"read_cache_max_bytes": 2 * nobj * size})
+                with concurrent.futures.ThreadPoolExecutor(8) as p:
+                    list(p.map(get, range(nobj)))  # warm: miss-fill
+                s0 = admin_tuning()["read_cache"]
+                best_hot = timed_get_pass()
+                s1 = admin_tuning()["read_cache"]
+                admin_tuning({"read_cache_max_bytes": 0})  # sweep: off
+                best_cold = timed_get_pass()
+                dh = s1["hits"] - s0["hits"]
+                dm = s1["misses"] - s0["misses"]
+                out["s3_get_hot_gbps"] = round(best_hot, 3)
+                out["s3_get_cold_gbps"] = round(best_cold, 3)
+                out["cache_hit_rate"] = round(dh / max(dh + dm, 1), 3)
+                if best_cold:
+                    out["s3_get_hot_vs_cold"] = round(
+                        best_hot / best_cold, 2)
+            finally:
+                # leave it off for the multipart leg (stays store-path)
+                admin_tuning({"read_cache_max_bytes": 0})
         if not device:
             # multipart leg (BASELINE rows 3/4: big-part uploads):
             # 4 concurrent 8 MiB UploadParts + Complete, best of 2

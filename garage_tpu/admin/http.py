@@ -151,13 +151,20 @@ class AdminHttpServer:
             # server restart per setting. Writes touch plain ints read
             # fresh on every request — safe on a live node.
             cfg = self.garage.config
+            cache = self.garage.block_manager.cache
             if m == "POST":
                 spec = await body_json() or {}
                 # validate EVERYTHING before the first setattr — a 400
                 # must never leave half the update applied on a live
                 # node (same rule as the bucket-update handler below)
                 bounds = {"get_readahead_blocks": (0, 64),
-                          "put_blocks_max_parallel": (1, 64)}
+                          "put_blocks_max_parallel": (1, 64),
+                          # hot-block read cache (block/cache.py):
+                          # size + admission knobs, live-resizable so
+                          # bench sweeps flip the cache on/off without
+                          # a server restart (0 = disabled)
+                          "read_cache_max_bytes": (0, 1 << 40),
+                          "read_cache_probation_pct": (1, 90)}
                 validated = {}
                 for k, raw in spec.items():
                     if k not in bounds:
@@ -168,7 +175,13 @@ class AdminHttpServer:
                         raise BadRequest(f"{k} must be in [{lo}, {hi}]")
                     validated[k] = v
                 for k, v in validated.items():
-                    setattr(cfg, "s3_" + k, v)
+                    if k == "read_cache_max_bytes":
+                        cfg.block_read_cache_max_bytes = v
+                        cache.configure(max_bytes=v)
+                    elif k == "read_cache_probation_pct":
+                        cache.configure(probation_pct=v)
+                    else:
+                        setattr(cfg, "s3_" + k, v)
             elif m != "GET":
                 return None
             from ..api.http import DRAIN_HIGH_WATER
@@ -177,6 +190,9 @@ class AdminHttpServer:
                 "get_readahead_blocks": cfg.s3_get_readahead_blocks,
                 "put_blocks_max_parallel": cfg.s3_put_blocks_max_parallel,
                 "drain_high_water": DRAIN_HIGH_WATER,
+                "read_cache_max_bytes": cache.max_bytes,
+                "read_cache_probation_pct": cache.probation_pct,
+                "read_cache": cache.stats(),
             })
 
         if path == "/v1/qos" and m == "GET":
@@ -511,6 +527,11 @@ class AdminHttpServer:
               "Number of blocks in the resync queue")
         gauge("block_resync_errored_blocks",
               g.block_manager.resync.errors_len())
+        # hot-block read cache (block/cache.py): cache_hits/misses/
+        # evictions/bytes + admission counters
+        out.append("# TYPE cache_hits counter")
+        for k, v in g.block_manager.cache.stats().items():
+            gauge(f"cache_{k}", v)
         sw = g.block_manager.scrub_worker
         if sw is not None:
             out.append("# HELP block_scrub_corruptions "

@@ -338,17 +338,24 @@ async def _stream_blocks(garage, blocks, start: int, end: int,
     plan = _plan_blocks(blocks, start, end)
     depth = getattr(garage.config, "s3_get_readahead_blocks", 3)
 
+    # SSE-C blocks are excluded from the hot-block read cache: the
+    # payload is ciphertext the node can only decrypt while the
+    # client's key is in hand — never keep it in RAM past the request
+    cacheable = sse_key is None
+
     if depth <= 0:
         # strictly sequential fallback switch
         for h, lo, hi in plan:
-            data = await garage.block_manager.rpc_get_block(h)
+            data = await garage.block_manager.rpc_get_block(
+                h, cacheable=cacheable)
             if sse_key is not None:
                 data = sse_key.decrypt_block(data)
             yield _slice(data, lo, hi)
         return
 
     async def fetch(h):
-        data = await garage.block_manager.rpc_get_block(h)
+        data = await garage.block_manager.rpc_get_block(
+            h, cacheable=cacheable)
         if sse_key is not None:
             # AES-GCM releases the GIL; MiB-scale blocks decrypt in a
             # worker thread so the loop keeps serving other requests.

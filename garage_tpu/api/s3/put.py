@@ -344,8 +344,11 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
             ])
             queued_vkeys.add(vk)
             queued_bkeys.add(bk)
+            # SSE-C blocks are never cached (cacheable=False): the
+            # stored payload is ciphertext tied to the client's key
             await garage.block_manager.rpc_put_block(
-                h, blk, compress=False if sse_key is not None else None)
+                h, blk, compress=False if sse_key is not None else None,
+                cacheable=sse_key is None)
 
     from ...utils.tracing import span
 

@@ -13,6 +13,7 @@ stripes" whose GF(2^8) Reed-Solomon math runs as batched XLA/Pallas ops
 """
 
 from .block import DataBlock, COMPRESSION_ZLIB, COMPRESSION_ZSTD  # noqa: F401
+from .cache import BlockCache  # noqa: F401
 from .codec import BlockCodec, ReplicateCodec, ErasureCodec  # noqa: F401
 from .layout import DataLayout  # noqa: F401
 from .rc import BlockRc  # noqa: F401

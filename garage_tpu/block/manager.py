@@ -159,7 +159,8 @@ class BlockManager:
                  codec: Optional[BlockCodec] = None,
                  compression: bool = True, fsync: bool = False,
                  device_mode: str = "auto",
-                 ram_buffer_max: int = 256 * 1024 * 1024):
+                 ram_buffer_max: int = 256 * 1024 * 1024,
+                 read_cache_max_bytes: Optional[int] = None):
         self.system = system
         self.db = db
         self.data_layout = data_layout
@@ -187,6 +188,20 @@ class BlockManager:
         # util/config.rs:272-274 block_ram_buffer_max). Slot unit = one
         # byte; putters acquire len(packed) before fan-out.
         self._ram_sem = _ByteSemaphore(ram_buffer_max)
+        # hot-block read cache (block/cache.py): decoded payloads keyed
+        # by content hash, sized off block_ram_buffer_max unless the
+        # `[block] read_cache_max_bytes` knob says otherwise (0 = off)
+        from .cache import BlockCache
+
+        if read_cache_max_bytes is None:
+            read_cache_max_bytes = ram_buffer_max // 4
+        self.cache = BlockCache(read_cache_max_bytes)
+        # optional async hook (Garage wires qos.shape_bytes): every
+        # foreground block read — hit or miss — charges the qos bytes
+        # budget, so GET/copy traffic is paced evenly whether it is
+        # served from RAM or from the store (background resync/scrub
+        # reads don't come through rpc_get_block and stay uncharged)
+        self.read_qos_charge = None
         self.endpoint = system.netapp.endpoint("garage_tpu/block").set_handler(
             self._handle
         )
@@ -265,7 +280,8 @@ class BlockManager:
         return await self.feeder.hash_with_md5(data, md5acc)
 
     async def rpc_put_block(self, hash32: bytes, data: bytes,
-                            compress: Optional[bool] = None) -> None:
+                            compress: Optional[bool] = None,
+                            cacheable: bool = True) -> None:
         from ..utils.tracing import span
 
         await self._ram_sem.acquire(len(data))
@@ -287,6 +303,13 @@ class BlockManager:
                     # packed buffer (same trick as the erasure prefix)
                     await self._put_replicate(hash32, blk.compression,
                                               blk.bytes)
+            # write-through: freshly written blocks are the hottest
+            # reads (read-after-write). `data` is exactly the decoded
+            # payload rpc_get_block returns. SSE-C callers pass
+            # cacheable=False — never cache payloads the node cannot
+            # re-derive without the client's key.
+            if cacheable:
+                self.cache.insert(hash32, data)
         finally:
             self._ram_sem.release(len(data))
 
@@ -350,7 +373,30 @@ class BlockManager:
 
     # ==== cluster read path (ref: manager.rs:243-363) ===================
 
-    async def rpc_get_block(self, hash32: bytes) -> bytes:
+    async def rpc_get_block(self, hash32: bytes,
+                            cacheable: bool = True) -> bytes:
+        """Decoded block payload. A read-cache hit returns without any
+        block RPC — in erasure mode that means the whole shard gather +
+        RS decode + verify is skipped. `cacheable=False` (SSE-C) both
+        bypasses the lookup and suppresses the miss fill."""
+        charge = self.read_qos_charge
+        if cacheable:
+            data = self.cache.get(hash32)
+            if data is not None:
+                if charge is not None:
+                    await charge(len(data))
+                return data
+        data = await self._get_uncached(hash32)
+        if cacheable:
+            self.cache.insert(hash32, data)
+        if charge is not None:
+            # charged symmetrically with the hit path above: a byte
+            # budget that only priced one of RAM/store reads would
+            # invert the cache's advantage (or let hot sets ride free)
+            await charge(len(data))
+        return data
+
+    async def _get_uncached(self, hash32: bytes) -> bytes:
         if self.erasure:
             # verification happens inside: a decode is retried against
             # every distinct packed_len candidate before giving up
@@ -520,10 +566,15 @@ class BlockManager:
 
     def block_decref(self, tx, hash32: bytes) -> None:
         if self.rc.block_decref(tx, hash32):
-            tx.on_commit(
-                lambda: self.resync.push_at(hash32,
-                                            time.time() + self.rc.gc_delay)
-            )
+            def on_unreferenced():
+                # the block just became deletable: drop its cached
+                # payload now — a ghost must not pin RAM for gc_delay
+                cache = getattr(self, "cache", None)
+                if cache is not None:
+                    cache.discard(hash32)
+                self.resync.push_at(hash32, time.time() + self.rc.gc_delay)
+
+            tx.on_commit(on_unreferenced)
 
     # ==== local file store (ref: manager.rs:709-805) ====================
 
@@ -673,6 +724,9 @@ class BlockManager:
         return placement.index(me) not in self.local_parts(hash32)
 
     def delete_local(self, hash32: bytes) -> None:
+        cache = getattr(self, "cache", None)
+        if cache is not None:
+            cache.discard(hash32)
         for d in self.data_layout.candidate_dirs(hash32):
             if not os.path.isdir(d):
                 continue

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import time
 from typing import Optional
 
@@ -83,6 +84,10 @@ class BlockResyncManager:
         e = self.errors.get(hash32)
         count = self._parse_err(e)[0] + 1 if e else 1
         delay = RESYNC_RETRY_DELAY * (2 ** min(count - 1, 6))
+        # ±25% jitter: one node outage queues thousands of blocks in
+        # the same second, and deterministic doubling would march them
+        # all into synchronized retry storms against the recovering peer
+        delay *= 1.0 + random.uniform(-0.25, 0.25)
         next_ms = int((time.time() + delay) * 1000)
         self.errors.insert(
             hash32, count.to_bytes(4, "big") + next_ms.to_bytes(8, "big")

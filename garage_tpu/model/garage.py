@@ -132,6 +132,7 @@ class Garage:
             fsync=config.data_fsync,
             device_mode="auto" if config.tpu.enable else "off",
             ram_buffer_max=config.block_ram_buffer_max,
+            read_cache_max_bytes=config.block_read_cache_max_bytes,
         )
 
         # ---- tables (ref: garage.rs:178-248) ---------------------------
@@ -194,6 +195,12 @@ class Garage:
             max_concurrent=qc.max_concurrent, max_queue=qc.max_queue,
             max_wait_s=qc.max_wait_s,
         ))
+        # foreground block-read bytes (cache hit AND store miss alike)
+        # consume the qos bytes budget (shape_bytes never sheds, it
+        # just paces): GET/copy traffic is priced evenly wherever it is
+        # served from, and a hot set cannot ride the cache past the
+        # configured byte rate
+        self.block_manager.read_qos_charge = self.qos.shape_bytes
         self.qos_governor = None  # spawned in spawn_workers
 
         # one global lock serializing bucket/key/alias mutations

@@ -75,6 +75,11 @@ class Config:
     data_fsync: bool = False
     block_size: int = 1024 * 1024  # ref default 1 MiB (util/config.rs:269-271)
     block_ram_buffer_max: int = 256 * 1024 * 1024
+    # [block] read_cache_max_bytes: budget of the node-local hot-block
+    # read cache (block/cache.py). None = default to
+    # block_ram_buffer_max // 4; 0 disables. Runtime-tunable via admin
+    # POST /v1/s3/tuning (README "Hot-block read cache").
+    block_read_cache_max_bytes: Optional[int] = None
     compression_level: Optional[int] = 1  # zstd level; None disables
     replication_factor: int = 1
     consistency_mode: str = "consistent"  # consistent|degraded|dangerous
@@ -283,11 +288,11 @@ def config_from_dict(raw: dict) -> Config:
             cfg.tpu = TpuConfig(**val)
         elif key == "qos" and isinstance(val, dict):
             cfg.qos = QosConfig(**val)
-        elif key in ("s3_api", "k2v_api", "admin", "web",
+        elif key in ("s3_api", "k2v_api", "admin", "web", "block",
                      "consul_discovery", "kubernetes_discovery"):
             # nested sections like the reference layout
             prefix = {"s3_api": "s3_", "k2v_api": "k2v_",
-                      "admin": "admin_", "web": "web_",
+                      "admin": "admin_", "web": "web_", "block": "block_",
                       "consul_discovery": "consul_",
                       "kubernetes_discovery": "kubernetes_"}[key]
             for k2, v2 in val.items():
@@ -301,9 +306,15 @@ def config_from_dict(raw: dict) -> Config:
                         attr = cand
                         break
                 if attr:
+                    if attr in ("block_size", "block_ram_buffer_max",
+                                "block_read_cache_max_bytes") \
+                            and isinstance(v2, str):
+                        v2 = parse_capacity(v2)
                     setattr(cfg, attr, v2)
         elif key in simple_fields:
-            if key == "block_size" and isinstance(val, str):
+            if key in ("block_size", "block_ram_buffer_max",
+                       "block_read_cache_max_bytes") \
+                    and isinstance(val, str):
                 val = parse_capacity(val)
             setattr(cfg, key, val)
         # unknown keys ignored (forward compat)

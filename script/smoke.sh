@@ -208,4 +208,15 @@ retry bash -c 'curl -sfm 20 -H "Authorization: Bearer smoke-admin-token" \
     "http://127.0.0.1:'"$ADM1"'/metrics" | grep -q cluster_healthy' \
     || die "metrics missing"
 
+say "admin: hot-block read cache counters exported"
+CACHE_METRICS=$(curl -sfm 20 -H "Authorization: Bearer smoke-admin-token" \
+    "http://127.0.0.1:$ADM1/metrics" | grep '^cache_' || true)
+for counter in cache_hits cache_misses cache_evictions cache_bytes; do
+    echo "$CACHE_METRICS" | grep -q "^$counter" \
+        || die "cache counter $counter missing from /metrics"
+done
+# the GETs above ran against node 1's cache: the counters must be live
+echo "$CACHE_METRICS" | grep -Eq '^cache_(hits|misses) [1-9]' \
+    || die "cache counters never moved ($CACHE_METRICS)"
+
 say "ALL SMOKE TESTS PASSED"

@@ -322,6 +322,62 @@ def test_erasure_put_get_and_degraded_read(tmp_path):
     run(main())
 
 
+def test_erasure_read_survives_forged_len_whose_decode_raises(tmp_path):
+    """_get_erasure's packed_len fallthrough, exception-class coverage:
+    a forged length can make the DECODE ITSELF blow up (packed_len=0 →
+    join_stripe yields b"" → DataBlock.unpack raises IndexError), not
+    just fail the content check. Forge the header on a MAJORITY of the
+    gathered shards so the bad candidate is genuinely tried first (the
+    length field sits outside the shard checksum, so local validation
+    still passes) — the read must fall through to the minority
+    candidate and recover the block."""
+    async def main():
+        net, systems, managers, tasks = await make_block_cluster(
+            tmp_path, n=6, rf=3, erasure=(4, 2)
+        )
+        try:
+            data = os.urandom(150_000)
+            h = blake2sum(data)
+            await managers[0].rpc_put_block(h, data)
+            for _ in range(100):
+                held = sorted(i for m in managers for i in m.local_parts(h))
+                if held == [0, 1, 2, 3, 4, 5]:
+                    break
+                await asyncio.sleep(0.02)
+            assert held == [0, 1, 2, 3, 4, 5]
+
+            # the gather fetches systematic shards 0..3 first: forging
+            # 0, 1 and 2 makes packed_len=0 the 3-vote majority against
+            # shard 3's lone true header
+            for idx in (0, 1, 2):
+                victim = next(m for m in managers
+                              if idx in m.local_parts(h))
+                payload, _plen = unpack_shard(
+                    victim.read_local_shard(h, idx))
+                victim.write_local_shard(h, idx, pack_shard(payload, 0))
+                # forged header still passes local validation
+                assert victim.read_local_shard(h, idx) is not None
+
+            reader = managers[1]
+            reader.cache.clear()  # force the real gather+decode path
+            decodes: list[int] = []
+            orig_decode = reader.codec.decode
+
+            def counting_decode(parts, plain_len):
+                decodes.append(plain_len)
+                return orig_decode(parts, plain_len)
+
+            reader.codec.decode = counting_decode
+            got = await reader.rpc_get_block(h)
+            assert got == data
+            # the majority (forged) candidate really was tried first
+            assert decodes[0] == 0 and len(decodes) >= 2
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
 def test_erasure_resync_rebuilds_lost_shard(tmp_path):
     async def main():
         net, systems, managers, tasks = await make_block_cluster(

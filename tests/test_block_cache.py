@@ -1,0 +1,277 @@
+"""Hot-block read cache: SLRU unit behavior (byte budget, scan-resistant
+admission, promotion/demotion) and BlockManager integration — a
+cache-hit GET must perform ZERO block RPCs and ZERO RS decodes,
+write-through on PUT, purge on decref/delete_local, SSE-C exclusion via
+the cacheable flag."""
+
+import asyncio
+import os
+
+from garage_tpu.block import BlockCache
+from test_block import make_block_cluster, stop_all
+from garage_tpu.utils.data import blake2sum
+
+
+def run(coro, timeout=120.0):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def h(i: int) -> bytes:
+    return i.to_bytes(32, "big")
+
+
+# ---- unit: the SLRU itself ----------------------------------------------
+
+
+def test_cache_byte_budget_evicts_lru_first():
+    c = BlockCache(1000, probation_pct=50)
+    for i in range(10):
+        c.insert(h(i), bytes(100))  # exactly at budget
+    assert c.bytes_used == 1000 and c.entries == 10
+    c.insert(h(10), bytes(100))  # one over: oldest probation entry goes
+    assert c.bytes_used == 1000
+    assert c.get(h(0)) is None  # LRU evicted
+    assert c.get(h(10)) is not None
+    assert c.evictions == 1
+
+
+def test_cache_hit_promotes_and_protected_is_capped():
+    c = BlockCache(1000, probation_pct=50)  # protected cap 500
+    for i in range(6):
+        c.insert(h(i), bytes(100))
+    for i in range(6):
+        assert c.get(h(i)) is not None  # promote all 6 (600 B > cap)
+    s = c.stats()
+    # demotion keeps the protected segment within its cap; nothing lost
+    assert s["protected_bytes"] <= 500
+    assert c.entries == 6 and c.bytes_used == 600
+    assert s["hits"] == 6 and s["misses"] == 0
+
+
+def test_cache_scan_resistance_protects_hot_set():
+    """A long one-touch scan (every hash seen once) must churn through
+    probation without displacing the promoted hot set."""
+    c = BlockCache(8000, probation_pct=20)  # protected cap 6400
+    hot = {h(i): bytes([i]) * 600 for i in range(4)}
+    for k, v in hot.items():
+        c.insert(k, v)
+    for k in hot:
+        assert c.get(k) is not None  # second touch: promoted
+    for j in range(100, 200):  # 100 one-touch fills, 50 KiB >> budget
+        c.insert(h(j), bytes(500))
+    for k, v in hot.items():
+        assert c.get(k) == v  # hot set survived the scan
+    assert c.bytes_used <= 8000
+    assert c.evictions > 0
+
+
+def test_cache_oversize_entry_rejected():
+    c = BlockCache(800)  # max entry = 100
+    c.insert(h(1), bytes(200))
+    assert c.entries == 0 and c.stats()["rejected"] == 1
+    c.insert(h(2), bytes(100))
+    assert c.entries == 1
+
+
+def test_cache_configure_shrink_evicts_and_zero_disables():
+    c = BlockCache(1000, probation_pct=50)
+    for i in range(8):
+        c.insert(h(i), bytes(100))
+    c.configure(max_bytes=300)
+    assert c.bytes_used <= 300
+    c.configure(max_bytes=0)
+    assert c.bytes_used == 0
+    hits0, misses0 = c.hits, c.misses
+    c.insert(h(1), bytes(10))  # disabled: no-ops, no stat movement
+    assert c.get(h(1)) is None
+    assert c.entries == 0 and (c.hits, c.misses) == (hits0, misses0)
+
+
+def test_cache_discard_both_segments():
+    c = BlockCache(1000, probation_pct=50)
+    c.insert(h(1), bytes(50))  # stays probationary
+    c.insert(h(2), bytes(50))
+    assert c.get(h(2)) is not None  # promoted
+    c.discard(h(1))
+    c.discard(h(2))
+    assert c.entries == 0 and c.bytes_used == 0
+
+
+def test_cache_memoryview_input_materialized():
+    c = BlockCache(1000)
+    c.insert(h(1), memoryview(b"x" * 64))
+    got = c.get(h(1))
+    assert isinstance(got, bytes) and got == b"x" * 64
+
+
+# ---- integration: the BlockManager seam ---------------------------------
+
+
+def test_erasure_cache_hit_zero_rpcs_zero_decodes(tmp_path):
+    """The acceptance property: a cache-hit read performs no block RPC,
+    no shard gather, and no RS decode — instrumented counters on the
+    endpoint, the gather, and the codec all stay at zero."""
+    async def main():
+        net, systems, managers, tasks = await make_block_cluster(
+            tmp_path, n=6, rf=3, erasure=(4, 2)
+        )
+        try:
+            data = os.urandom(200_000)
+            hash32 = blake2sum(data)
+            await managers[0].rpc_put_block(hash32, data)
+
+            m = managers[1]  # a node whose cache the put did NOT fill
+            calls = {"rpc": 0, "gather": 0, "decode": 0}
+            orig_call = m.endpoint.call
+            orig_gather = m._gather_parts
+            orig_decode = m.codec.decode
+
+            async def counting_call(*a, **kw):
+                calls["rpc"] += 1
+                return await orig_call(*a, **kw)
+
+            async def counting_gather(*a, **kw):
+                calls["gather"] += 1
+                return await orig_gather(*a, **kw)
+
+            def counting_decode(*a, **kw):
+                calls["decode"] += 1
+                return orig_decode(*a, **kw)
+
+            m.endpoint.call = counting_call
+            m._gather_parts = counting_gather
+            m.codec.decode = counting_decode
+
+            got = await m.rpc_get_block(hash32)  # miss: the real path
+            assert got == data
+            assert calls["gather"] == 1 and calls["decode"] >= 1
+            assert m.cache.stats()["misses"] >= 1
+
+            calls.update(rpc=0, gather=0, decode=0)
+            got = await m.rpc_get_block(hash32)  # hit
+            assert got == data
+            assert calls == {"rpc": 0, "gather": 0, "decode": 0}
+            assert m.cache.stats()["hits"] >= 1
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
+def test_put_write_through_serves_reads_without_store(tmp_path):
+    async def main():
+        net, systems, managers, tasks = await make_block_cluster(tmp_path)
+        try:
+            data = os.urandom(50_000)
+            hash32 = blake2sum(data)
+            m = managers[0]
+            await m.rpc_put_block(hash32, data)
+            # write-through put the decoded payload in probation
+            reads0 = m.metrics["bytes_read"]
+            calls = {"rpc": 0}
+            orig_call = m.endpoint.call
+
+            async def counting_call(*a, **kw):
+                calls["rpc"] += 1
+                return await orig_call(*a, **kw)
+
+            m.endpoint.call = counting_call
+            assert await m.rpc_get_block(hash32) == data
+            assert calls["rpc"] == 0  # no RPC…
+            assert m.metrics["bytes_read"] == reads0  # …and no disk read
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
+def test_cacheable_false_never_populates(tmp_path):
+    """The SSE-C contract at the manager seam: neither a put nor a get
+    with cacheable=False leaves the payload in RAM."""
+    async def main():
+        net, systems, managers, tasks = await make_block_cluster(tmp_path)
+        try:
+            data = os.urandom(40_000)
+            hash32 = blake2sum(data)
+            m = managers[0]
+            await m.rpc_put_block(hash32, data, cacheable=False)
+            assert m.cache.entries == 0
+            assert await m.rpc_get_block(hash32, cacheable=False) == data
+            assert m.cache.entries == 0
+            # and a cacheable read of other content still works
+            assert await m.rpc_get_block(hash32) == data
+            assert m.cache.entries == 1
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
+def test_decref_to_zero_purges_cache(tmp_path):
+    """A block whose refcount drops to zero must not keep a ghost
+    pinned in cache RAM for the whole gc_delay."""
+    async def main():
+        net, systems, managers, tasks = await make_block_cluster(tmp_path)
+        try:
+            data = os.urandom(30_000)
+            hash32 = blake2sum(data)
+            m = managers[0]
+            await m.rpc_put_block(hash32, data)
+            assert m.cache.entries == 1
+            m.db.transaction(lambda tx: m.block_incref(tx, hash32))
+            m.db.transaction(lambda tx: m.block_incref(tx, hash32))
+            m.db.transaction(lambda tx: m.block_decref(tx, hash32))
+            assert m.cache.entries == 1  # still referenced: stays hot
+            m.db.transaction(lambda tx: m.block_decref(tx, hash32))
+            assert m.cache.entries == 0  # became deletable: purged
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
+def test_delete_local_purges_cache(tmp_path):
+    async def main():
+        net, systems, managers, tasks = await make_block_cluster(tmp_path)
+        try:
+            data = os.urandom(30_000)
+            hash32 = blake2sum(data)
+            m = managers[0]
+            await m.rpc_put_block(hash32, data)
+            assert m.cache.entries == 1
+            m.delete_local(hash32)
+            assert m.cache.entries == 0
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
+def test_qos_read_charge_symmetric_on_hit_and_miss(tmp_path):
+    """Foreground reads charge the qos bytes budget identically whether
+    served from the cache or the store — an asymmetric charge would
+    throttle hot reads below cold ones (or let hot sets ride free).
+    PUTs don't charge here (they're priced at admission)."""
+    async def main():
+        net, systems, managers, tasks = await make_block_cluster(tmp_path)
+        try:
+            data = os.urandom(20_000)
+            hash32 = blake2sum(data)
+            m = managers[0]
+            charged: list[int] = []
+
+            async def charge(n):
+                charged.append(n)
+
+            m.read_qos_charge = charge
+            await m.rpc_put_block(hash32, data)
+            assert charged == []  # write path never read-charges
+            m.cache.clear()
+            assert await m.rpc_get_block(hash32) == data  # miss
+            assert charged == [len(data)]
+            assert await m.rpc_get_block(hash32) == data  # hit
+            assert charged == [len(data), len(data)]
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())

@@ -391,6 +391,74 @@ def test_get_readahead_runtime_toggle(server, client):
                body={"get_readahead_blocks": 3})
 
 
+def test_read_cache_runtime_toggle(server, client):
+    """Admin /v1/s3/tuning resizes/disables the hot-block read cache at
+    runtime; GETs must stay byte-identical in every state, hits must
+    move on warm reads, and a 0 budget must fully disable."""
+    body = os.urandom(200_000)
+    client.request("PUT", "/conformance/cached", body=body)
+    st, got = _admin(server, "GET", "/v1/s3/tuning")
+    assert st == 200
+    default_max = got["read_cache_max_bytes"]
+    assert default_max > 0  # sized off block_ram_buffer_max by default
+    try:
+        h0 = got["read_cache"]["hits"]
+        st, _, data = client.request("GET", "/conformance/cached")
+        assert st == 200 and data == body
+        st, got = _admin(server, "GET", "/v1/s3/tuning")
+        # PUT write-through made the first GET a cache hit
+        assert got["read_cache"]["hits"] > h0
+        # disable: reads still correct, counters frozen
+        st, got = _admin(server, "POST", "/v1/s3/tuning",
+                         body={"read_cache_max_bytes": 0})
+        assert st == 200 and got["read_cache_max_bytes"] == 0
+        assert got["read_cache"]["bytes"] == 0  # disabled = cleared
+        frozen = got["read_cache"]["hits"]
+        st, _, data = client.request("GET", "/conformance/cached")
+        assert st == 200 and data == body
+        st, got = _admin(server, "GET", "/v1/s3/tuning")
+        assert got["read_cache"]["hits"] == frozen
+        # admission knob bounds are validated
+        st, _ = _admin(server, "POST", "/v1/s3/tuning",
+                       body={"read_cache_probation_pct": 95})
+        assert st == 400
+        st, _ = _admin(server, "POST", "/v1/s3/tuning",
+                       body={"read_cache_max_bytes": -1})
+        assert st == 400
+        # re-enable: a cold read fills, a warm read hits again
+        st, _ = _admin(server, "POST", "/v1/s3/tuning",
+                       body={"read_cache_max_bytes": default_max,
+                             "read_cache_probation_pct": 20})
+        assert st == 200
+        client.request("GET", "/conformance/cached")
+        st, got = _admin(server, "GET", "/v1/s3/tuning")
+        h1 = got["read_cache"]["hits"]
+        st, _, data = client.request("GET", "/conformance/cached")
+        assert data == body
+        st, got = _admin(server, "GET", "/v1/s3/tuning")
+        assert got["read_cache"]["hits"] > h1
+    finally:
+        _admin(server, "POST", "/v1/s3/tuning",
+               body={"read_cache_max_bytes": default_max})
+
+
+@requires_crypto
+def test_ssec_objects_never_enter_read_cache(server, client):
+    """SSE-C payloads are excluded from the hot-block cache on both the
+    PUT write-through and the GET miss-fill paths."""
+    st, got = _admin(server, "GET", "/v1/s3/tuning")
+    inserts0 = got["read_cache"]["inserts"]
+    data = os.urandom(150_000)
+    st, _, _ = client.request("PUT", "/conformance/uncachedsecret",
+                              body=data, headers=_sse_headers())
+    assert st == 200
+    st, _, got_body = client.request("GET", "/conformance/uncachedsecret",
+                                     headers=_sse_headers())
+    assert st == 200 and got_body == data
+    st, got = _admin(server, "GET", "/v1/s3/tuning")
+    assert got["read_cache"]["inserts"] == inserts0
+
+
 def test_conditional_get(client):
     client.request("PUT", "/conformance/cond", body=b"conditional")
     status, hdrs, _ = client.request("GET", "/conformance/cond")

@@ -37,10 +37,12 @@ class FakeBlockManager:
         self.inflight = 0
         self.max_inflight = 0
         self.started: list[bytes] = []
+        self.cacheable_flags: list[bool] = []
         self.cancelled = 0
 
-    async def rpc_get_block(self, h: bytes) -> bytes:
+    async def rpc_get_block(self, h: bytes, cacheable: bool = True) -> bytes:
         self.started.append(h)
+        self.cacheable_flags.append(cacheable)
         self.inflight += 1
         self.max_inflight = max(self.max_inflight, self.inflight)
         try:
@@ -194,6 +196,35 @@ def test_readahead_ssec_decrypt_ordering():
         out = await collect(_stream_blocks(make_garage(bm), blocks,
                                            0, 600, sse_key=key))
         assert out == b"".join(plain[bytes([i]) * 4] for i in range(6))
+        # SSE-C blocks must never enter the hot-block read cache: every
+        # fetch opted out
+        assert bm.cacheable_flags == [False] * 6
+
+    run(main())
+
+
+def test_stream_blocks_cache_opt_in_matches_encryption():
+    """Plaintext GETs read (and fill) the hot-block cache; SSE-C GETs
+    bypass it — on both the readahead and the sequential (depth 0)
+    paths."""
+    class XorKey:
+        def decrypt_block(self, data):
+            return bytes(b ^ 0x5A for b in data)
+
+    async def main():
+        for depth in (3, 0):
+            store, blocks = make_blocks(4)
+            bm = FakeBlockManager(store)
+            await collect(_stream_blocks(make_garage(bm, readahead=depth),
+                                         blocks, 0, 400))
+            assert bm.cacheable_flags == [True] * 4
+
+            key = XorKey()
+            cipher = {h: key.decrypt_block(v) for h, v in store.items()}
+            bm2 = FakeBlockManager(cipher)
+            await collect(_stream_blocks(make_garage(bm2, readahead=depth),
+                                         blocks, 0, 400, sse_key=key))
+            assert bm2.cacheable_flags == [False] * 4
 
     run(main())
 
