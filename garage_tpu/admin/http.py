@@ -195,6 +195,66 @@ class AdminHttpServer:
                 "read_cache": cache.stats(),
             })
 
+        if path == "/v1/chaos":
+            # fault injection control plane (garage_tpu/chaos/): GET
+            # reports armed faults + fired counts; POST arms/updates.
+            # Body: {"enabled": bool, "seed": int, "clear": bool,
+            #        "faults": [{kind, prob, count, node, peer,
+            #                    endpoint, hash_prefix, delay_s,
+            #                    rate_bps}, ...]}
+            from ..chaos import injector as chaos_inj
+
+            ctl = chaos_inj.controller()
+            if m == "POST":
+                spec = await body_json() or {}
+                allowed = {"kind", "prob", "count", "node", "peer",
+                           "endpoint", "hash_prefix", "delay_s",
+                           "rate_bps"}
+                # validate EVERYTHING before the first mutation — a 400
+                # must never leave the live controller half-updated
+                # (cleared, reseeded, or with only some faults armed)
+                new_faults = []
+                for f in spec.get("faults", []):
+                    bad = set(f) - allowed
+                    if bad:
+                        raise BadRequest(
+                            f"unknown fault field(s): {sorted(bad)}")
+                    if f.get("kind") not in chaos_inj.ALL_KINDS:
+                        raise BadRequest(
+                            f"unknown fault kind {f.get('kind')!r} "
+                            f"(kinds: {', '.join(chaos_inj.ALL_KINDS)})")
+                    fs = chaos_inj.FaultSpec(
+                        kind=f["kind"],
+                        prob=float(f.get("prob", 1.0)),
+                        count=(int(f["count"])
+                               if f.get("count") is not None else None),
+                        node=str(f.get("node", "")),
+                        peer=str(f.get("peer", "")),
+                        endpoint=str(f.get("endpoint", "")),
+                        hash_prefix=str(f.get("hash_prefix", "")),
+                        delay_s=float(f.get("delay_s", 0.05)),
+                        rate_bps=float(f.get("rate_bps", 1 << 20)))
+                    if not 0.0 <= fs.prob <= 1.0:
+                        raise BadRequest("prob must be in [0, 1]")
+                    new_faults.append(fs)
+                seed = int(spec["seed"]) if "seed" in spec else None
+                if spec.get("clear"):
+                    ctl.clear()
+                if seed is not None:
+                    ctl.reseed(seed)
+                for fs in new_faults:
+                    ctl.add(fs)
+                if "enabled" in spec:
+                    if spec["enabled"]:
+                        chaos_inj.arm()
+                    else:
+                        chaos_inj.disarm(clear=False)
+                elif new_faults:
+                    chaos_inj.arm()  # arming faults implies enabling
+            elif m != "GET":
+                return None
+            return _json(ctl.state())
+
         if path == "/v1/qos" and m == "GET":
             return _json(self._qos_state())
         if path == "/v1/qos" and m == "POST":
@@ -584,9 +644,39 @@ class AdminHttpServer:
         if gov is not None:
             gauge("qos_governor_pressure_current",
                   round(gov.pressure, 4))
+            gauge("qos_governor_queue_depth", gov.last_queue_depth)
             if gov.ewma is not None:
                 gauge("qos_governor_ewma_latency_seconds",
                       round(gov.ewma, 6))
+
+        # chaos fault injection (garage_tpu/chaos/) — always exported,
+        # so dashboards/smoke can assert the plane exists even at zero
+        from ..chaos import injector as chaos_inj
+
+        ctl = chaos_inj.controller()
+        gauge("chaos_enabled", 1 if chaos_inj.ACTIVE is not None else 0,
+              "Whether fault injection is armed")
+        gauge("chaos_faults_armed", len(ctl.faults))
+        gauge("chaos_fired_total", ctl.total_fired,
+              "Total injected faults that actually fired")
+
+        # self-healing rpc: hedge + breaker counters and per-peer
+        # breaker state (0 closed, 1 half-open, 2 open)
+        health = g.system.peering.health
+        hs = health.stats()
+        gauge("rpc_hedging_enabled", 1 if hs["hedging_enabled"] else 0)
+        gauge("rpc_hedge_launched_total", hs["hedges_launched"],
+              "Backup requests launched by hedged reads")
+        gauge("rpc_hedge_wins_total", hs["hedge_wins"])
+        gauge("rpc_breaker_open_total", hs["breaker_opens"],
+              "Circuit breaker open transitions")
+        gauge("rpc_breaker_close_total", hs["breaker_closes"])
+        _brk_num = {"closed": 0, "half_open": 1, "open": 2}
+        for nid, st in health.peer_state().items():
+            gauge("rpc_breaker_state", _brk_num[st["breaker"]], node=nid)
+            if st["p99_s"] is not None:
+                gauge("rpc_peer_p99_seconds", round(st["p99_s"], 6),
+                      node=nid)
 
         # op counters/durations from the process-wide registry
         # (rpc/table/api/block series; ref: rpc/metrics.rs etc.)

@@ -26,9 +26,16 @@ import os
 import time
 from typing import Optional
 
+from ..chaos import injector as _chaos
 from ..net.message import PRIO_BACKGROUND, PRIO_NORMAL
-from ..rpc.rpc_helper import RequestStrategy, RpcHelper
+from ..rpc.rpc_helper import (
+    MAX_HEDGES_PER_CALL,
+    RequestStrategy,
+    RpcHelper,
+    _consume_task_result,
+)
 from ..utils.data import blake2sum
+from ..utils.metrics import registry
 from ..utils.error import CorruptData, MissingBlock, QuorumError, RpcError
 from .block import BLOCK_SUFFIXES, DataBlock, comp_of_path
 from .codec import BlockCodec, ErasureCodec, ReplicateCodec, shard_nodes_of
@@ -141,6 +148,15 @@ class _ByteSemaphore:
                 if fut.done() and not fut.cancelled():
                     self.release(n)
             raise
+
+    def queue_depth(self) -> int:
+        """Writers currently parked behind the byte budget — a pressure
+        signal that reacts BEFORE request latency does (the qos
+        governor samples it alongside its latency EWMA)."""
+        return len(self._waiters)
+
+    def waiting_bytes(self) -> int:
+        return sum(n for n, _ in self._waiters)
 
     def release(self, n: int) -> None:
         self.in_use -= n
@@ -419,28 +435,94 @@ class BlockManager:
     async def _get_replicate(self, hash32: bytes) -> tuple[bytes, bool]:
         """-> (packed block, already_content_verified). Local reads
         verify inside read_local — re-hashing the same MiB in
-        rpc_get_block doubled the CPU cost of every local GET block."""
+        rpc_get_block doubled the CPU cost of every local GET block.
+
+        Remote failover is HEDGED: when the current holder hasn't
+        answered within its observed p95, the next candidate (breaker-
+        and ping-ranked) is asked in parallel instead of waiting out
+        the full timeout — a hung holder costs one hedge delay, not
+        30-60 s (Dean & Barroso, "The Tail at Scale")."""
         me = self.system.id
-        errs = []
-        for node in self.system.layout_helper.block_read_nodes_of(hash32):
+        nodes = self.system.layout_helper.block_read_nodes_of(hash32)
+        errs: list[Exception] = []
+        if me in nodes:
             try:
-                if node == me:
-                    # off the event loop: a cold-cache disk read plus
-                    # the content verify would stall every other
-                    # request for milliseconds per block
-                    local = await asyncio.to_thread(self.read_local,
-                                                    hash32)
-                    if local is not None:
-                        return local, True
-                    continue
-                resp, _ = await self.endpoint.call(
-                    node, {"op": "get", "hash": hash32, "part": None},
-                    PRIO_NORMAL, timeout=60.0,
-                )
-                if resp.get("data") is not None:
-                    return resp["data"], False
-            except Exception as e:
+                # off the event loop: a cold-cache disk read plus the
+                # content verify would stall every other request for
+                # milliseconds per block
+                local = await asyncio.to_thread(self.read_local, hash32)
+                if local is not None:
+                    return local, True
+            except OSError as e:
+                # injected/real local EIO: degrade to the remote holders
                 errs.append(e)
+        remote = self.rpc.request_order([n for n in nodes if n != me])
+        health = self.rpc.health()
+        hedging = health is not None and health.hedging_enabled
+        pending: dict[asyncio.Task, tuple[bytes, bool]] = {}
+        i = 0
+        hedges = 0
+
+        def launch(hedged: bool = False):
+            nonlocal i
+            node = remote[i]
+            i += 1
+            t = asyncio.create_task(self.rpc.call(
+                self.endpoint, node,
+                {"op": "get", "hash": hash32, "part": None},
+                PRIO_NORMAL, timeout=60.0,
+            ))
+            pending[t] = (node, hedged)
+
+        if remote:
+            launch()
+        try:
+            while pending:
+                can_hedge = hedging and i < len(remote) \
+                    and hedges < MAX_HEDGES_PER_CALL
+                done, _ = await asyncio.wait(
+                    pending.keys(), return_when=asyncio.FIRST_COMPLETED,
+                    timeout=(health.hedge_delay(
+                        n for n, _ in pending.values())
+                        if can_hedge else None),
+                )
+                if not done:
+                    if health.try_take_hedge():
+                        hedges += 1
+                        registry().inc("rpc_hedge_launched",
+                                       endpoint="block_get")
+                        launch(hedged=True)
+                    else:
+                        hedging = False  # rate cap hit: plain waits
+                    continue
+                # drain EVERY completed task before returning: a loser
+                # that failed in the same wait round must have its
+                # exception retrieved, or asyncio logs an orphan
+                won = None
+                for t in done:
+                    _node, was_hedged = pending.pop(t)
+                    try:
+                        resp = t.result()
+                        if won is None and resp.get("data") is not None:
+                            won = resp["data"]
+                            if was_hedged:
+                                health.record_hedge_win()
+                                registry().inc("rpc_hedge_win",
+                                               endpoint="block_get")
+                    except Exception as e:
+                        errs.append(e)
+                if won is not None:
+                    return won, False
+                # every holder in this round failed or had no copy:
+                # move down the list
+                if i < len(remote):
+                    launch()
+        finally:
+            for t in pending:
+                # a task that finished between the wait and this
+                # cleanup still needs its exception consumed
+                t.add_done_callback(_consume_task_result)
+                t.cancel()
         raise MissingBlock(hash32)
 
     async def _get_erasure(self, hash32: bytes) -> bytes:
@@ -509,8 +591,13 @@ class BlockManager:
                     if raw is None:
                         return None
                     return unpack_shard(raw)
-                resp, _ = await self.endpoint.call(
-                    node, {"op": "get", "hash": hash32, "part": idx},
+                # self.rpc.call (not endpoint.call): the helper records
+                # per-peer health and applies the adaptive timeout, so
+                # a hung holder stops costing the full flat timeout
+                # once its p99 is known
+                resp = await self.rpc.call(
+                    self.endpoint, node,
+                    {"op": "get", "hash": hash32, "part": idx},
                     PRIO_NORMAL, timeout=60.0,
                 )
                 if resp.get("data") is None:
@@ -519,27 +606,66 @@ class BlockManager:
             except Exception:
                 return None
 
+        health = self.rpc.health()
+        hedging = health is not None and health.hedging_enabled
         parts: dict[int, bytes] = {}
         lens_by_idx: dict[int, int] = {}
         order = list(enumerate(placement))  # systematic first by design
         i = 0
-        pending: dict[asyncio.Task, int] = {}
-        while len(parts) < need and (pending or i < len(order)):
-            while i < len(order) and len(pending) < need - len(parts):
-                idx, node = order[i]
-                pending[asyncio.create_task(fetch(node, idx))] = idx
-                i += 1
-            if not pending:
-                break
-            done, _ = await asyncio.wait(
-                pending.keys(), return_when=asyncio.FIRST_COMPLETED
-            )
-            for t in done:
-                idx = pending.pop(t)
-                r = t.result()
-                if r is not None:
-                    parts[idx] = r[0]
-                    lens_by_idx[idx] = r[1]
+        hedges = 0
+        pending: dict[asyncio.Task, tuple[int, bool]] = {}
+        try:
+            while len(parts) < need and (pending or i < len(order)):
+                while i < len(order) and len(pending) < need - len(parts):
+                    idx, node = order[i]
+                    pending[asyncio.create_task(fetch(node, idx))] = \
+                        (idx, False)
+                    i += 1
+                if not pending:
+                    break
+                can_hedge = hedging and i < len(order) \
+                    and hedges < MAX_HEDGES_PER_CALL
+                done, _ = await asyncio.wait(
+                    pending.keys(),
+                    return_when=asyncio.FIRST_COMPLETED,
+                    timeout=(health.hedge_delay(
+                        placement[idx] for idx, _ in pending.values())
+                        if can_hedge else None),
+                )
+                if not done:
+                    # every in-flight shard fetch is past its holder's
+                    # observed p95: hedge the next candidate shard
+                    # instead of waiting out a hung holder (exceeds
+                    # the need-len(parts) concurrency cap by design)
+                    if health.try_take_hedge():
+                        hedges += 1
+                        registry().inc("rpc_hedge_launched",
+                                       endpoint="block_get_shard")
+                        idx, node = order[i]
+                        pending[asyncio.create_task(fetch(node, idx))] \
+                            = (idx, True)
+                        i += 1
+                    else:
+                        hedging = False
+                    continue
+                for t in done:
+                    idx, was_hedged = pending.pop(t)
+                    r = t.result()
+                    if r is not None:
+                        parts[idx] = r[0]
+                        lens_by_idx[idx] = r[1]
+                        if was_hedged:
+                            health.record_hedge_win()
+                            registry().inc("rpc_hedge_win",
+                                           endpoint="block_get_shard")
+        finally:
+            # cancel stragglers (hedges included) on every exit path —
+            # a client disconnect cancels this coroutine at the wait
+            # above, and the in-flight MiB-scale fetches must not keep
+            # running for nobody; fetch() swallows its own errors so
+            # nothing logs
+            for t in pending:
+                t.cancel()
         if len(parts) < need:
             return None
         lens = list(lens_by_idx.values())
@@ -575,6 +701,13 @@ class BlockManager:
                 self.resync.push_at(hash32, time.time() + self.rc.gc_delay)
 
             tx.on_commit(on_unreferenced)
+
+    @property
+    def _chaos_node(self) -> bytes:
+        """Local node id for chaos fault scoping (bare test managers
+        built via __new__ have no system)."""
+        s = getattr(self, "system", None)
+        return getattr(s, "id", b"") or b""
 
     # ==== local file store (ref: manager.rs:709-805) ====================
 
@@ -640,6 +773,10 @@ class BlockManager:
         suffix = SUFFIX_OF.get(comp)
         if suffix is None:
             raise CorruptData(hash32)
+        if _chaos.ACTIVE is not None:
+            # chaos seam (disk write): EIO or torn write
+            payload = _chaos.ACTIVE.disk_write(self._chaos_node, hash32,
+                                               payload)
         path = self.data_layout.block_path(hash32, suffix)
         self._write_file(path, payload)
         # drop other-compression variants if present (ref: manager.rs
@@ -659,6 +796,11 @@ class BlockManager:
             return None
         with open(p, "rb") as f:
             raw = f.read()
+        if _chaos.ACTIVE is not None:
+            # chaos seam (disk read): EIO or single-bit rot, scoped by
+            # local node id + hash prefix; rot is caught by the content
+            # verify below exactly like real media decay would be
+            raw = _chaos.ACTIVE.disk_read(self._chaos_node, hash32, raw)
         self.metrics["bytes_read"] += len(raw)
         blk = DataBlock(comp_of_path(p), raw)
         try:
@@ -670,6 +812,10 @@ class BlockManager:
 
     def write_local_shard(self, hash32: bytes, part: int, raw: bytes) -> None:
         validate_shard(raw)  # checksum before storing (no payload copy)
+        if _chaos.ACTIVE is not None:
+            # chaos seam (disk write), after validation: a torn image
+            # lands on disk and the next read's checksum catches it
+            raw = _chaos.ACTIVE.disk_write(self._chaos_node, hash32, raw)
         self._write_file(self.data_layout.block_path(hash32, f".s{part}"), raw)
 
     def read_local_shard(self, hash32: bytes, part: int) -> Optional[bytes]:
@@ -678,6 +824,11 @@ class BlockManager:
             return None
         with open(p, "rb") as f:
             raw = f.read()
+        if _chaos.ACTIVE is not None:
+            # chaos seam (disk read): a rotted shard fails the checksum
+            # check below -> quarantine + resync, and the erasure read
+            # falls through to the remaining shards (degraded decode)
+            raw = _chaos.ACTIVE.disk_read(self._chaos_node, hash32, raw)
         self.metrics["bytes_read"] += len(raw)
         try:
             unpack_shard(raw)

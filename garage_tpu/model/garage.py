@@ -203,6 +203,23 @@ class Garage:
         self.block_manager.read_qos_charge = self.qos.shape_bytes
         self.qos_governor = None  # spawned in spawn_workers
 
+        # ---- self-healing rpc knobs ([rpc] section) --------------------
+        self.system.peering.health.configure(
+            hedging=config.rpc_hedging,
+            hedge_rate=config.rpc_hedge_rate,
+            adaptive_timeout=config.rpc_adaptive_timeout,
+        )
+
+        # ---- fault injection ([chaos] section) -------------------------
+        # boot-time arming for chaos experiments / CI; runtime control
+        # stays available through admin GET/POST /v1/chaos either way
+        if config.chaos.enable:
+            from ..chaos import FaultSpec, arm
+
+            chaos = arm(seed=config.chaos.seed)
+            for spec in config.chaos.faults:
+                chaos.add(FaultSpec(**dict(spec)))
+
         # one global lock serializing bucket/key/alias mutations
         # (ref: garage.rs:61 bucket_lock + helper/locked.rs)
         self.bucket_lock = asyncio.Lock()

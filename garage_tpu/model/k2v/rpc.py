@@ -277,12 +277,18 @@ class K2VRpcHandler:
     # ---- local application --------------------------------------------
 
     async def _call_any(self, who: list[bytes], payload) -> None:
-        """try_call_many with quorum 1 (ref: rpc.rs insert)."""
+        """try_call_many with quorum 1 (ref: rpc.rs insert).
+
+        hedge=False: this is a WRITE — a hedge against a slow-but-alive
+        node would apply the insert under two node ids and surface
+        duplicate DVVS siblings. Failover on error (at-least-once)
+        stays, as in the reference."""
         from ...rpc.rpc_helper import RequestStrategy
 
         await self.item_table.rpc.try_call_many(
             self.endpoint, who, payload,
-            RequestStrategy(quorum=1, prio=PRIO_NORMAL, timeout=30.0),
+            RequestStrategy(quorum=1, prio=PRIO_NORMAL, timeout=30.0,
+                            hedge=False),
         )
 
     def _local_insert(self, bucket_id: bytes, pk: str, sk: str,

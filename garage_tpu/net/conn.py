@@ -65,6 +65,7 @@ except ModuleNotFoundError:
     Ed25519PrivateKey = Ed25519PublicKey = None
     X25519PrivateKey = X25519PublicKey = ChaCha20Poly1305 = None
 
+from ..chaos import injector as _chaos
 from ..utils.error import RpcError
 from .message import PRIO_HIGH, pack, unpack
 from .stream import ByteStream
@@ -616,6 +617,15 @@ class Conn:
                 item.pos = 0
         return parts, n
 
+    async def _chaos_net(self, direction: str, nbytes: int) -> bool:
+        """Chaos seam (net): delay/drop/disconnect/slow-drip scoped by
+        the remote peer id. True = proceed, False = drop the frame.
+        No-op fast path when chaos is disarmed."""
+        if _chaos.ACTIVE is None:
+            return True
+        return await _chaos.ACTIVE.net_frame(direction, b"",
+                                             self.peer_id, nbytes)
+
     async def _send_one_chunk(self, item: _SendItem) -> None:
         if item.kind == "cancel":
             self._ctl_items.remove(item)
@@ -632,7 +642,8 @@ class Conn:
             parts, n = self._next_body_parts(item, self.chan.max_chunk)
             item.body_done = item.buf_idx >= len(item.body)
             flags = flags_base | (0 if item.body_done else F_CONT)
-            await self.chan.send_frame(item.req_id, flags | n, parts)
+            if await self._chaos_net("send", n):
+                await self.chan.send_frame(item.req_id, flags | n, parts)
             if item.body_done and item.stream is None:
                 self._finish_item(item)
             return
@@ -655,8 +666,9 @@ class Conn:
             item.next_chunk = None
             item.chunk_state = "none"
         item.window -= len(send_now)
-        await self.chan.send_frame(
-            item.req_id, F_STREAM | F_CONT | len(send_now), [send_now])
+        if await self._chaos_net("send", len(send_now)):
+            await self.chan.send_frame(
+                item.req_id, F_STREAM | F_CONT | len(send_now), [send_now])
 
     def _finish_item(self, item: _SendItem) -> None:
         self._send_items.pop(item.req_id, None)
@@ -669,6 +681,9 @@ class Conn:
         try:
             while True:
                 req_id, field, parts = await self.chan.recv_frame()
+                if _chaos.ACTIVE is not None and not await self._chaos_net(
+                        "recv", sum(len(p) for p in parts)):
+                    continue  # frame lost on the (simulated) wire
                 if field == CANCEL:
                     self._handle_cancel(req_id)
                 elif field == CREDIT:

@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 from typing import Awaitable, Callable, Optional
 
+from ..chaos import injector as _chaos
 from ..utils.error import RpcError
 from .stream import ByteStream
 
@@ -64,6 +65,11 @@ class Endpoint:
                               endpoint=self.path,
                               bg="1" if prio >= PRIO_BACKGROUND else "0"):
             try:
+                # chaos seam (rpc): error/hang injection scoped by
+                # endpoint path + target node; one attribute load and a
+                # None check when disarmed
+                if _chaos.ACTIVE is not None:
+                    await _chaos.ACTIVE.rpc_call(self.path, node, timeout)
                 async with span("rpc.call", endpoint=self.path,
                                 node=node[:4].hex()):
                     return await self.netapp.call(

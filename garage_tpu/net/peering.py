@@ -19,6 +19,7 @@ from enum import Enum
 from typing import Optional
 
 from ..utils.data import blake2sum
+from ..utils.metrics import registry
 from .message import PRIO_HIGH
 from .netapp import NetApp
 
@@ -29,6 +30,271 @@ PING_TIMEOUT = 10.0
 FAILED_PING_THRESHOLD = 4
 CONN_RETRY_INTERVAL = 30.0
 CONN_MAX_RETRIES = 10
+
+# ---- per-peer RPC health (consumed by rpc/rpc_helper.py) ----------------
+#
+# Dean & Barroso, "The Tail at Scale" (CACM 2013): at scale the slow
+# outliers dominate user-visible latency, and the fix is to stop
+# treating every peer as equally healthy. This tracker is the shared
+# observation point: every RpcHelper call records its outcome here
+# (RpcHelper instances are per-subsystem, PeeringManager is per-node),
+# and three consumers read it back —
+#   * request_order deprioritizes peers whose circuit breaker is open,
+#   * per-call timeouts derive from the peer's observed p99 instead of
+#     the flat 30 s default,
+#   * hedged reads fire a backup request after the peer's observed p95
+#     instead of waiting for an error.
+
+HEALTH_WINDOW = 128        # latency samples kept per peer (ring)
+HEALTH_MIN_SAMPLES = 8     # below this, flat defaults stay in force
+ERR_ALPHA = 0.2            # EWMA step for the error-rate estimate
+BREAKER_FAILURES = 5       # consecutive failures that open the breaker
+BREAKER_COOLDOWN = 5.0     # open -> half-open after this many seconds
+BREAKER_HALF_OPEN_PROBES = 2  # in-flight probe budget while half-open
+ADAPTIVE_MULT = 4.0        # adaptive timeout = clamp(p99 * this)
+ADAPTIVE_MIN_S = 1.0       # never time out faster than this
+HEDGE_DELAY_MIN = 0.01
+HEDGE_DELAY_MAX = 5.0
+HEDGE_DELAY_DEFAULT = 0.25  # hedge delay before any samples exist
+HEDGE_BUCKET_CAP = 16.0    # burst budget of the global hedge limiter
+
+
+class PeerHealth:
+    """One peer's health: EWMA error rate + a fixed-size latency ring
+    (order statistics over 128 floats are exact and cheap — a real
+    quantile sketch buys nothing at this window size) + breaker state."""
+
+    __slots__ = ("err_ewma", "lat", "_idx", "samples", "consec_failures",
+                 "breaker", "opened_at", "probes_in_flight")
+
+    def __init__(self):
+        self.err_ewma = 0.0
+        self.lat: list[float] = []
+        self._idx = 0
+        self.samples = 0
+        self.consec_failures = 0
+        self.breaker = "closed"  # closed | open | half_open
+        self.opened_at = 0.0
+        self.probes_in_flight = 0
+
+    def observe_latency(self, dt: float) -> None:
+        if len(self.lat) < HEALTH_WINDOW:
+            self.lat.append(dt)
+        else:
+            self.lat[self._idx] = dt
+            self._idx = (self._idx + 1) % HEALTH_WINDOW
+        self.samples += 1
+
+    def quantile(self, q: float) -> Optional[float]:
+        if not self.lat:
+            return None
+        s = sorted(self.lat)
+        return s[min(len(s) - 1, int(q * len(s)))]
+
+
+class PeerHealthTracker:
+    """Cluster-wide health map + the three-state circuit breaker and
+    the global hedge budget. All methods are event-loop-synchronous."""
+
+    def __init__(self):
+        self.peers: dict[bytes, PeerHealth] = {}
+        self.hedging_enabled = True
+        self.adaptive_timeout_enabled = True
+        self.hedge_rate = 8.0  # sustained hedges/s across all calls
+        self._hedge_tokens = HEDGE_BUCKET_CAP
+        self._hedge_t = time.monotonic()
+        self.hedges_launched = 0
+        self.hedge_wins = 0
+        self.breaker_opens = 0
+        self.breaker_closes = 0
+
+    def configure(self, hedging: Optional[bool] = None,
+                  hedge_rate: Optional[float] = None,
+                  adaptive_timeout: Optional[bool] = None) -> None:
+        if hedging is not None:
+            self.hedging_enabled = bool(hedging)
+        if hedge_rate is not None:
+            self.hedge_rate = max(0.0, float(hedge_rate))
+        if adaptive_timeout is not None:
+            self.adaptive_timeout_enabled = bool(adaptive_timeout)
+
+    def reset(self) -> None:
+        """Drop all observations (bench A/B legs must not inherit the
+        previous leg's breakers and quantiles)."""
+        self.peers.clear()
+        self._hedge_tokens = HEDGE_BUCKET_CAP
+        self._hedge_t = time.monotonic()
+
+    def _peer(self, node: bytes) -> PeerHealth:
+        p = self.peers.get(node)
+        if p is None:
+            p = self.peers[node] = PeerHealth()
+        return p
+
+    # ---- outcome recording --------------------------------------------
+
+    def record_success(self, node: bytes,
+                       latency: Optional[float] = None) -> None:
+        p = self._peer(node)
+        p.err_ewma *= 1.0 - ERR_ALPHA
+        p.consec_failures = 0
+        if p.probes_in_flight > 0:
+            p.probes_in_flight -= 1
+        if latency is not None:
+            p.observe_latency(latency)
+        if p.breaker != "closed":
+            p.breaker = "closed"
+            p.probes_in_flight = 0
+            self.breaker_closes += 1
+            registry().inc("rpc_breaker_transition", to="closed")
+
+    def record_failure(self, node: bytes,
+                       latency: Optional[float] = None) -> None:
+        p = self._peer(node)
+        p.err_ewma = (1.0 - ERR_ALPHA) * p.err_ewma + ERR_ALPHA
+        p.consec_failures += 1
+        if p.probes_in_flight > 0:
+            p.probes_in_flight -= 1
+        if latency is not None:
+            # timed-out calls land here with their full elapsed time:
+            # failures must push the observed tail UP so the adaptive
+            # timeout backs off instead of spiraling tighter
+            p.observe_latency(latency)
+        if p.breaker == "half_open" or (
+                p.breaker == "closed"
+                and p.consec_failures >= BREAKER_FAILURES):
+            p.breaker = "open"
+            p.opened_at = time.monotonic()
+            p.probes_in_flight = 0
+            self.breaker_opens += 1
+            registry().inc("rpc_breaker_transition", to="open")
+
+    def record_ping_ok(self, node: bytes) -> None:
+        """A successful ping: no latency sample (ping RTTs are not
+        data-RPC latencies), but it clears the consecutive-failure
+        count and closes a half-open breaker — on an idle cluster no
+        data call will ever come along to probe a recovered peer, and
+        it must not sit deprioritized forever. A peer that answers
+        pings but hangs data RPCs re-opens after the next failures."""
+        p = self.peers.get(node)
+        if p is None:
+            return
+        p.consec_failures = 0
+        if self.breaker_state(node) == "half_open":
+            p.breaker = "closed"
+            p.probes_in_flight = 0
+            self.breaker_closes += 1
+            registry().inc("rpc_breaker_transition", to="closed")
+
+    # ---- breaker reads -------------------------------------------------
+
+    def breaker_state(self, node: bytes,
+                      now: Optional[float] = None) -> str:
+        p = self.peers.get(node)
+        if p is None:
+            return "closed"
+        if p.breaker == "open":
+            if (now if now is not None else time.monotonic()) \
+                    - p.opened_at >= BREAKER_COOLDOWN:
+                p.breaker = "half_open"
+                p.probes_in_flight = 0
+                registry().inc("rpc_breaker_transition", to="half_open")
+        return p.breaker
+
+    def breaker_rank(self, node: bytes,
+                     now: Optional[float] = None) -> int:
+        """Ordering penalty for request_order: 0 closed, 1 half-open
+        with probe budget left, 2 half-open exhausted, 3 open."""
+        st = self.breaker_state(node, now)
+        if st == "closed":
+            return 0
+        if st == "half_open":
+            p = self.peers[node]
+            return 1 if p.probes_in_flight < BREAKER_HALF_OPEN_PROBES \
+                else 2
+        return 3
+
+    def note_launch(self, node: bytes) -> None:
+        """Count a call launched at a half-open peer against its probe
+        budget (budget-exhausted peers rank behind healthy ones)."""
+        p = self.peers.get(node)
+        if p is not None and p.breaker == "half_open":
+            p.probes_in_flight += 1
+
+    # ---- derived knobs -------------------------------------------------
+
+    def call_timeout(self, node: bytes,
+                     flat: Optional[float]) -> Optional[float]:
+        """Adaptive per-call timeout: clamp(p99 * 4) once the peer has
+        enough samples; the caller's flat value is both the default and
+        the ceiling (adaptation only ever tightens)."""
+        if flat is None or not self.adaptive_timeout_enabled:
+            return flat
+        p = self.peers.get(node)
+        if p is None or p.samples < HEALTH_MIN_SAMPLES:
+            return flat
+        q = p.quantile(0.99)
+        if q is None:
+            return flat
+        return min(flat, max(ADAPTIVE_MIN_S, q * ADAPTIVE_MULT))
+
+    def hedge_delay(self, nodes) -> float:
+        """How long to wait on the in-flight request(s) before launching
+        a backup: the worst observed p95 among them, lightly padded."""
+        worst = None
+        for n in nodes:
+            p = self.peers.get(n)
+            if p is None or p.samples < HEALTH_MIN_SAMPLES:
+                continue
+            q = p.quantile(0.95)
+            if q is not None and (worst is None or q > worst):
+                worst = q
+        if worst is None:
+            return HEDGE_DELAY_DEFAULT
+        return min(HEDGE_DELAY_MAX, max(HEDGE_DELAY_MIN, worst * 1.5))
+
+    def try_take_hedge(self) -> bool:
+        """Global hedge-rate cap (token bucket): hedging bounds tail
+        latency at a few percent extra load, but only if something
+        bounds the hedges themselves."""
+        now = time.monotonic()
+        self._hedge_tokens = min(
+            HEDGE_BUCKET_CAP,
+            self._hedge_tokens + (now - self._hedge_t) * self.hedge_rate)
+        self._hedge_t = now
+        if self._hedge_tokens >= 1.0:
+            self._hedge_tokens -= 1.0
+            self.hedges_launched += 1
+            return True
+        return False
+
+    def record_hedge_win(self) -> None:
+        self.hedge_wins += 1
+
+    # ---- observability -------------------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            "hedges_launched": self.hedges_launched,
+            "hedge_wins": self.hedge_wins,
+            "breaker_opens": self.breaker_opens,
+            "breaker_closes": self.breaker_closes,
+            "hedging_enabled": self.hedging_enabled,
+            "adaptive_timeout_enabled": self.adaptive_timeout_enabled,
+        }
+
+    def peer_state(self) -> dict:
+        out = {}
+        for node, p in self.peers.items():
+            out[node.hex()[:16]] = {
+                "breaker": self.breaker_state(node),
+                "error_rate": round(p.err_ewma, 4),
+                "samples": p.samples,
+                "p50_s": p.quantile(0.50),
+                "p95_s": p.quantile(0.95),
+                "p99_s": p.quantile(0.99),
+            }
+        return out
 
 
 class PeerConnState(Enum):
@@ -83,6 +349,10 @@ class PeeringManager:
         self.ping_interval = ping_interval
         self.ping_timeout = ping_timeout
         self.retry_interval = retry_interval
+        # shared per-peer rpc health (breakers, latency quantiles);
+        # PeeringManager is the one per-node object every RpcHelper
+        # can reach through system.peering
+        self.health = PeerHealthTracker()
         self.peers: dict[bytes, _Peer] = {
             netapp.id: _Peer(netapp.id, netapp.public_addr, PeerConnState.OURSELF)
         }
@@ -165,10 +435,15 @@ class PeeringManager:
                 peer.id, {"hash": self._peer_list_hash()}, PRIO_HIGH, timeout=self.ping_timeout
             )
             peer.record_ping(time.monotonic() - t0)
+            self.health.record_ping_ok(peer.id)
             if resp.get("hash") != self._peer_list_hash():
                 await self._pull_peer_list(peer.id)
         except Exception:
             peer.failed_pings += 1
+            # a failed ping is a health failure too (no latency sample:
+            # ping RTTs are not data-RPC latencies) — enough failed
+            # pings open the breaker even with no data traffic flowing
+            self.health.record_failure(peer.id)
             if peer.failed_pings >= FAILED_PING_THRESHOLD:
                 log.info("peer %s failed %d pings, disconnecting", peer.id[:4].hex(), peer.failed_pings)
                 conn = self.netapp.conns.get(peer.id)

@@ -58,23 +58,39 @@ class GovernorWorker(Worker):
     # pressure decay per idle interval (no foreground samples at all)
     IDLE_DECAY = 0.15
     EWMA_ALPHA = 0.3
+    # pressure push per queued writer at the block byte-semaphore (a
+    # LEADING signal: writers park there before any latency sample can
+    # show the overload), capped at one MAX_STEP per interval
+    QUEUE_GAIN = 0.1
+    QUEUE_REF_DEPTH = 5  # depth at which the queue signal saturates
 
     def __init__(self, garage, interval: float = 2.0,
                  target_latency: float = 0.05,
                  scrub_range: tuple[float, float] = (1.0, 30.0),
                  resync_range: tuple[float, float] = (0.0, 2.0),
-                 sample_fn: Optional[Callable[[], tuple[int, float]]] = None):
+                 sample_fn: Optional[Callable[[], tuple[int, float]]] = None,
+                 queue_depth_fn: Optional[Callable[[], int]] = None):
         self.garage = garage
         self.interval = interval
         self.target_latency = target_latency
         self.scrub_range = scrub_range
         self.resync_range = resync_range
         self.sample_fn = sample_fn or foreground_latency_totals
+        self.queue_depth_fn = queue_depth_fn
         self.enabled = True
         self.pressure = 0.0
         self.ewma: Optional[float] = None
+        self.last_queue_depth = 0
         self._last: Optional[tuple[int, float]] = None
         self.adjustments = 0
+
+    def _queue_depth(self) -> int:
+        """Writers parked at the block manager's byte-semaphore."""
+        if self.queue_depth_fn is not None:
+            return self.queue_depth_fn()
+        bm = getattr(self.garage, "block_manager", None)
+        sem = getattr(bm, "_ram_sem", None)
+        return sem.queue_depth() if sem is not None else 0
 
     # ---- control step (synchronous, unit-testable) ---------------------
 
@@ -96,6 +112,15 @@ class GovernorWorker(Worker):
         else:
             # cluster is foreground-idle: let background work sprint
             self.pressure = max(0.0, self.pressure - self.IDLE_DECAY)
+        # queue-depth signal (ROADMAP "governor signal breadth"): byte-
+        # semaphore waiters mean the write path is ALREADY saturated,
+        # even if the latency EWMA hasn't caught up — push background
+        # work back before users feel it
+        self.last_queue_depth = depth = self._queue_depth()
+        if depth > 0:
+            move = min(self.MAX_STEP,
+                       self.QUEUE_GAIN * min(depth, self.QUEUE_REF_DEPTH))
+            self.pressure = min(1.0, self.pressure + move)
         self._apply()
 
     def _apply(self) -> None:
@@ -147,6 +172,7 @@ class GovernorWorker(Worker):
             "pressure": round(self.pressure, 4),
             "ewma_latency_s": (round(self.ewma, 6)
                                if self.ewma is not None else None),
+            "queue_depth": self.last_queue_depth,
             "target_latency_s": self.target_latency,
             "scrub_range": list(self.scrub_range),
             "resync_range": list(self.resync_range),

@@ -1,4 +1,4 @@
-"""RpcHelper: quorum call orchestration.
+"""RpcHelper: quorum call orchestration, self-healing.
 
 Ref parity: src/rpc/rpc_helper.rs:160-766. The transport-agnostic quorum
 engine:
@@ -11,17 +11,38 @@ engine:
   transitions; succeeds when EVERY set reaches its write quorum;
   remaining requests continue in the background.
 - `QuorumSetResultTracker`: the bookkeeping shared by both.
+
+Beyond the reference, every call feeds the shared per-peer health
+tracker (net/peering.py PeerHealthTracker) and reads it back:
+
+- **Adaptive timeouts**: a peer with enough samples gets
+  clamp(p99 * 4) instead of the flat default (the flat value stays the
+  ceiling, and the default when no samples exist).
+- **Circuit breakers**: request_order ranks peers whose breaker is
+  open/exhausted behind healthy ones, so a known-broken peer stops
+  being everyone's first choice; half-open peers get a bounded probe
+  budget to prove recovery.
+- **Hedged reads** (Dean & Barroso, CACM 2013): with
+  send_all_at_once=False, if no in-flight request completes within the
+  peers' observed p95, a backup request is launched at the next-ranked
+  node instead of waiting out an error or timeout. First success wins,
+  losers are cancelled, and a global token bucket caps the hedge rate.
+- **Named errors**: every transport failure is wrapped so the surfaced
+  message carries the peer id and endpoint (`QuorumError.errors`
+  entries included) — a bare `TimeoutError` gives operators nothing.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from ..net.message import PRIO_NORMAL
 from ..utils.error import QuorumError, RpcError
+from ..utils.metrics import registry
 from .system import System
 
 
@@ -35,6 +56,22 @@ def _consume_task_result(t: asyncio.Task) -> None:
 log = logging.getLogger("garage_tpu.rpc.helper")
 
 DEFAULT_TIMEOUT = 30.0
+# at most this many hedges augment one try_call_many call (the global
+# token bucket in PeerHealthTracker caps the cluster-wide rate on top)
+MAX_HEDGES_PER_CALL = 2
+
+
+def named_rpc_error(e: Exception, node: bytes, endpoint_path: str) -> RpcError:
+    """Wrap a transport/handler error so the surfaced message names the
+    peer and endpoint. The original exception rides along as __cause__
+    and the structured fields as attributes."""
+    who = node.hex()[:8] if node else "?"
+    err = RpcError(
+        f"{endpoint_path} -> node {who}: {type(e).__name__}: {e}")
+    err.node = node
+    err.endpoint = endpoint_path
+    err.__cause__ = e
+    return err
 
 
 @dataclass
@@ -46,6 +83,10 @@ class RequestStrategy:
     timeout: float = DEFAULT_TIMEOUT
     send_all_at_once: bool = False
     interrupt_stragglers: bool = True  # reads cancel; writes let them finish
+    # None = the cluster-wide default (PeerHealthTracker.hedging_enabled);
+    # True/False forces it for this call (bench A/B, writes that must
+    # never duplicate)
+    hedge: Optional[bool] = None
 
 
 class QuorumSetResultTracker:
@@ -105,23 +146,34 @@ class RpcHelper:
         self.system = system
         self.netapp = system.netapp
 
+    def health(self):
+        """The shared PeerHealthTracker, or None on bare test stubs."""
+        peering = getattr(self.system, "peering", None)
+        return getattr(peering, "health", None)
+
     # ---- node ordering (ref: rpc_helper.rs:621-660) --------------------
 
     def request_order(self, nodes: list[bytes]) -> list[bytes]:
-        """self first, then same-zone, then by ping."""
+        """self first; then breaker state (open/exhausted peers last),
+        same-zone, ping."""
         my_zone = None
         role = self.system.layout_helper.current().node_role(self.netapp.id)
         if role is not None:
             my_zone = role.zone
+        health = self.health()
+        now = time.monotonic()
 
         def key(n: bytes):
             if n == self.netapp.id:
-                return (0, 0.0)
+                return (0, 0, 0, 0.0)
             role = self.system.layout_helper.current().node_role(n)
             same_zone = role is not None and my_zone is not None and role.zone == my_zone
             ping = self.system.peering.ping_avg(n)
             connected = self.system.is_up(n)
+            brk = health.breaker_rank(n, now) if health is not None else 0
             return (
+                1,
+                brk,
                 1 if (same_zone and connected) else (2 if connected else 3),
                 ping if ping is not None else 1.0,
             )
@@ -129,6 +181,39 @@ class RpcHelper:
         return sorted(nodes, key=key)
 
     # ---- single call ---------------------------------------------------
+
+    async def _tracked_call(
+        self,
+        endpoint,
+        node: bytes,
+        payload,
+        prio: int,
+        timeout: Optional[float],
+        stream=None,
+    ):
+        """endpoint.call with the self-healing bookkeeping: adaptive
+        per-peer timeout, half-open probe accounting, success/failure
+        recording, and peer+endpoint-named errors. Returns the raw
+        (resp, reply_stream) pair."""
+        health = self.health()
+        if health is not None:
+            timeout = health.call_timeout(node, timeout)
+            health.note_launch(node)
+        t0 = time.monotonic()
+        try:
+            resp, rstream = await endpoint.call(
+                node, payload, prio, stream=stream, timeout=timeout
+            )
+        except asyncio.CancelledError:
+            # a cancelled hedge loser is not a peer failure
+            raise
+        except Exception as e:
+            if health is not None:
+                health.record_failure(node, time.monotonic() - t0)
+            raise named_rpc_error(e, node, endpoint.path) from e
+        if health is not None:
+            health.record_success(node, time.monotonic() - t0)
+        return resp, rstream
 
     async def call(
         self,
@@ -139,8 +224,8 @@ class RpcHelper:
         timeout: float = DEFAULT_TIMEOUT,
         stream=None,
     ):
-        resp, rstream = await endpoint.call(
-            node, payload, prio, stream=stream, timeout=timeout
+        resp, rstream = await self._tracked_call(
+            endpoint, node, payload, prio, timeout, stream=stream
         )
         return (resp, rstream) if rstream is not None else resp
 
@@ -154,25 +239,37 @@ class RpcHelper:
         strategy: RequestStrategy,
         make_payload: Optional[Callable[[bytes], Any]] = None,
     ) -> list:
-        """Returns >= quorum successful responses or raises QuorumError."""
+        """Returns >= quorum successful responses or raises QuorumError.
+
+        With send_all_at_once=False the adaptive send is HEDGED: when no
+        in-flight request completes within the peers' observed p95, the
+        next-ranked node gets a backup request immediately — a hung peer
+        costs one hedge delay, not its whole timeout. First success
+        wins; with interrupt_stragglers the losers are cancelled."""
         quorum = strategy.quorum
         if quorum > len(nodes):
             raise QuorumError(quorum, 1, 0, len(nodes), ["not enough nodes"])
         order = self.request_order(list(nodes))
+        health = self.health()
+        hedging = (strategy.hedge if strategy.hedge is not None
+                   else (health is not None and health.hedging_enabled)) \
+            and not strategy.send_all_at_once and health is not None
         successes: list = []
         errors: list[Exception] = []
-        pending: dict[asyncio.Task, bytes] = {}
+        pending: dict[asyncio.Task, tuple[bytes, bool]] = {}
         next_i = 0
+        hedges = 0
 
-        def launch_one():
+        def launch_one(hedged: bool = False):
             nonlocal next_i
             node = order[next_i]
             next_i += 1
             pl = make_payload(node) if make_payload else payload
             t = asyncio.create_task(
-                endpoint.call(node, pl, strategy.prio, timeout=strategy.timeout)
+                self._tracked_call(endpoint, node, pl, strategy.prio,
+                                   strategy.timeout)
             )
-            pending[t] = node
+            pending[t] = (node, hedged)
 
         n_initial = len(order) if strategy.send_all_at_once else min(quorum, len(order))
         for _ in range(n_initial):
@@ -183,14 +280,34 @@ class RpcHelper:
                     raise QuorumError(
                         quorum, 1, len(successes), len(nodes), [str(e) for e in errors]
                     )
+                can_hedge = (hedging and next_i < len(order)
+                             and hedges < MAX_HEDGES_PER_CALL)
                 done, _ = await asyncio.wait(
-                    pending.keys(), return_when=asyncio.FIRST_COMPLETED
+                    pending.keys(), return_when=asyncio.FIRST_COMPLETED,
+                    timeout=(health.hedge_delay(n for n, _ in pending.values())
+                             if can_hedge else None),
                 )
+                if not done:
+                    # hedge-delay elapsed with everything still in
+                    # flight: back up on the next-ranked node (if the
+                    # global rate cap still has budget)
+                    if health.try_take_hedge():
+                        hedges += 1
+                        registry().inc("rpc_hedge_launched",
+                                       endpoint=endpoint.path)
+                        launch_one(hedged=True)
+                    else:
+                        hedging = False  # budget empty: plain waits
+                    continue
                 for t in done:
-                    node = pending.pop(t)
+                    node, hedged = pending.pop(t)
                     try:
                         resp, _stream = t.result()
                         successes.append((node, resp))
+                        if hedged:
+                            health.record_hedge_win()
+                            registry().inc("rpc_hedge_win",
+                                           endpoint=endpoint.path)
                     except Exception as e:
                         errors.append(e)
                         if next_i < len(order):
@@ -199,6 +316,10 @@ class RpcHelper:
         finally:
             for t in pending:
                 if strategy.interrupt_stragglers:
+                    # consume first: a task that completed with an
+                    # error between the last wait and this cleanup is
+                    # immune to cancel and would log "never retrieved"
+                    t.add_done_callback(_consume_task_result)
                     t.cancel()
                 else:
                     # left running so replicas converge; swallow the result
@@ -230,8 +351,15 @@ class RpcHelper:
             # future no task will ever resolve
             raise tracker.quorum_error()
         result = asyncio.get_event_loop().create_future()
+        health = self.health()
+
+        def node_of(key) -> bytes:
+            # quorum keys are node ids, or (node, shard_index) tuples on
+            # the erasure path
+            return key[0] if isinstance(key, tuple) else key
 
         async def one(key):
+            t0 = time.monotonic()
             try:
                 if make_call is not None:
                     resp, _ = await make_call(key)
@@ -242,8 +370,19 @@ class RpcHelper:
                         key, pl, strategy.prio, stream=st,
                         timeout=strategy.timeout
                     )
+                if health is not None:
+                    health.record_success(node_of(key),
+                                          time.monotonic() - t0)
                 tracker.success(key, resp)
+            except asyncio.CancelledError:
+                raise
             except Exception as e:
+                if health is not None:
+                    health.record_failure(node_of(key),
+                                          time.monotonic() - t0)
+                if not isinstance(e, RpcError) \
+                        or not hasattr(e, "node"):
+                    e = named_rpc_error(e, node_of(key), endpoint.path)
                 tracker.failure(key, e)
             if not result.done():
                 if tracker.all_quorums_ok():

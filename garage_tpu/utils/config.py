@@ -67,6 +67,25 @@ class QosConfig:
 
 
 @dataclass
+class ChaosConfig:
+    """[chaos] deterministic fault injection (garage_tpu/chaos/; no
+    reference analogue). Disabled by default — the seams are single
+    pointer-compare no-ops until armed. `faults` is a list of inline
+    tables matching chaos.FaultSpec fields, e.g.
+
+        [chaos]
+        enable = true
+        seed = 42
+        faults = [ {kind = "rpc_error", peer = "ab12", prob = 0.1} ]
+
+    Runtime arm/disarm/inspect via admin `GET/POST /v1/chaos`."""
+
+    enable: bool = False
+    seed: int = 0
+    faults: list = field(default_factory=list)
+
+
+@dataclass
 class Config:
     # ref: util/config.rs:13-258
     metadata_dir: str = ""
@@ -93,6 +112,13 @@ class Config:
     rpc_secret_file: Optional[str] = None
     rpc_bind_addr: str = "127.0.0.1:3901"
     rpc_public_addr: Optional[str] = None
+    # [rpc] self-healing knobs (rpc/rpc_helper.py + net/peering.py
+    # PeerHealthTracker; README "Fault injection & self-healing RPC"):
+    # hedged reads on/off, the cluster-wide hedge rate cap (token
+    # bucket, hedges/s), and p99-derived adaptive per-call timeouts
+    rpc_hedging: bool = True
+    rpc_hedge_rate: float = 8.0
+    rpc_adaptive_timeout: bool = True
     bootstrap_peers: list[str] = field(default_factory=list)
     # external discovery (ref: rpc/consul.rs, rpc/kubernetes.rs);
     # TOML sections [consul_discovery] / [kubernetes_discovery]
@@ -132,6 +158,7 @@ class Config:
 
     tpu: TpuConfig = field(default_factory=TpuConfig)
     qos: QosConfig = field(default_factory=QosConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
 
     @property
     def data_dirs(self) -> list[DataDir]:
@@ -280,7 +307,7 @@ def read_config(path: str) -> Config:
 def config_from_dict(raw: dict) -> Config:
     cfg = Config()
     simple_fields = {f.name for f in dataclasses.fields(Config)} \
-        - {"data_dir", "tpu", "qos"}
+        - {"data_dir", "tpu", "qos", "chaos"}
     for key, val in raw.items():
         if key == "data_dir":
             cfg.data_dir = _parse_data_dir(val)
@@ -288,11 +315,14 @@ def config_from_dict(raw: dict) -> Config:
             cfg.tpu = TpuConfig(**val)
         elif key == "qos" and isinstance(val, dict):
             cfg.qos = QosConfig(**val)
-        elif key in ("s3_api", "k2v_api", "admin", "web", "block",
+        elif key == "chaos" and isinstance(val, dict):
+            cfg.chaos = ChaosConfig(**val)
+        elif key in ("s3_api", "k2v_api", "admin", "web", "block", "rpc",
                      "consul_discovery", "kubernetes_discovery"):
             # nested sections like the reference layout
             prefix = {"s3_api": "s3_", "k2v_api": "k2v_",
                       "admin": "admin_", "web": "web_", "block": "block_",
+                      "rpc": "rpc_",
                       "consul_discovery": "consul_",
                       "kubernetes_discovery": "kubernetes_"}[key]
             for k2, v2 in val.items():

@@ -219,4 +219,47 @@ done
 echo "$CACHE_METRICS" | grep -Eq '^cache_(hits|misses) [1-9]' \
     || die "cache counters never moved ($CACHE_METRICS)"
 
+say "chaos: dead peer injected, writes+reads still reach quorum"
+# from node 1's point of view, every RPC to node 3 now fails — the
+# runtime equivalent of node 3 dropping dead mid-traffic
+NODE3_ID=$(cli2 3 status | awk '/^node id:/{print $3}')
+curl -sf -X POST -H "Authorization: Bearer smoke-admin-token" \
+    -d "{\"seed\": 7, \"faults\": [{\"kind\": \"rpc_error\", \
+\"peer\": \"${NODE3_ID:0:8}\", \"count\": 200}]}" \
+    "http://127.0.0.1:$ADM1/v1/chaos" >/dev/null || die "chaos arm failed"
+head -c 100000 /dev/urandom > "$TMP/objchaos"
+curl -sf -X PUT --data-binary "@$TMP/objchaos" \
+    "$(presign PUT /smoke/objchaos)" >/dev/null \
+    || die "PUT with a dead peer failed (write quorum is 2/3)"
+curl -sf "$(presign GET /smoke/objchaos)" -o "$TMP/objchaos.back" \
+    || die "GET with a dead peer failed"
+cmp "$TMP/objchaos" "$TMP/objchaos.back" \
+    || die "GET under chaos returned different bytes"
+# the faults must have actually fired (a chaos test that injects
+# nothing proves nothing) ...
+curl -sf -H "Authorization: Bearer smoke-admin-token" \
+    "http://127.0.0.1:$ADM1/v1/chaos" \
+    | "$PY" -c 'import json,sys; st=json.load(sys.stdin); \
+assert st["enabled"] and st["total_fired"] >= 1, st' \
+    || die "chaos faults never fired"
+# ... and the chaos + self-healing rpc planes are in /metrics
+CHAOS_METRICS=$(curl -sfm 20 -H "Authorization: Bearer smoke-admin-token" \
+    "http://127.0.0.1:$ADM1/metrics")
+echo "$CHAOS_METRICS" | grep -q '^chaos_enabled 1' \
+    || die "chaos_enabled missing/wrong in /metrics"
+echo "$CHAOS_METRICS" | grep -Eq '^chaos_fired_total [1-9]' \
+    || die "chaos_fired_total never moved"
+for m in rpc_hedge_launched_total rpc_hedge_wins_total \
+         rpc_breaker_open_total rpc_hedging_enabled; do
+    echo "$CHAOS_METRICS" | grep -q "^$m" \
+        || die "self-healing metric $m missing from /metrics"
+done
+# disarm + clear: the node goes back to the no-op fast path
+curl -sf -X POST -H "Authorization: Bearer smoke-admin-token" \
+    -d '{"enabled": false, "clear": true}' \
+    "http://127.0.0.1:$ADM1/v1/chaos" >/dev/null || die "chaos disarm failed"
+curl -sf -H "Authorization: Bearer smoke-admin-token" \
+    "http://127.0.0.1:$ADM1/metrics" | grep -q '^chaos_enabled 0' \
+    || die "chaos did not disarm"
+
 say "ALL SMOKE TESTS PASSED"

@@ -274,6 +274,70 @@ def test_governor_throttles_scrub_under_latency():
     assert gov.pressure == pytest.approx(0.0)
 
 
+def test_governor_reacts_to_queue_depth_before_latency():
+    """ROADMAP 'governor signal breadth': writers parked at the block
+    byte-semaphore push pressure up even while the latency EWMA still
+    looks healthy (the queue is the leading indicator)."""
+    g = _FakeGarage()
+    samples = {"count": 0, "total": 0.0}
+    depth = {"n": 0}
+    gov = GovernorWorker(g, target_latency=0.05,
+                         sample_fn=lambda: (samples["count"],
+                                            samples["total"]),
+                         queue_depth_fn=lambda: depth["n"])
+    gov.step()  # baseline
+    # healthy latency, NO queue: pressure stays at zero
+    for _ in range(5):
+        samples["count"] += 10
+        samples["total"] += 10 * 0.001
+        gov.step()
+    assert gov.pressure == pytest.approx(0.0)
+    # healthy latency but writers piling up at the byte-semaphore
+    depth["n"] = 8
+    for _ in range(4):
+        samples["count"] += 10
+        samples["total"] += 10 * 0.001
+        gov.step()
+    assert gov.pressure > 0.5
+    assert gov.last_queue_depth == 8
+    assert gov.state()["queue_depth"] == 8
+    # queue drains -> the healthy-latency bleed-off takes it back down
+    depth["n"] = 0
+    for _ in range(60):
+        samples["count"] += 10
+        samples["total"] += 10 * 0.001
+        gov.step()
+    assert gov.pressure == pytest.approx(0.0)
+
+
+def test_byte_semaphore_queue_depth_surface():
+    """The governor's queue signal reads _ByteSemaphore.queue_depth():
+    parked waiters are visible, granted ones are not."""
+
+    async def main():
+        from garage_tpu.block.manager import _ByteSemaphore
+
+        sem = _ByteSemaphore(100)
+        await sem.acquire(80)
+        assert sem.queue_depth() == 0
+        t1 = asyncio.create_task(sem.acquire(50))
+        t2 = asyncio.create_task(sem.acquire(60))
+        await asyncio.sleep(0)
+        assert sem.queue_depth() == 2
+        assert sem.waiting_bytes() == 110
+        sem.release(80)
+        await asyncio.sleep(0)
+        assert sem.queue_depth() == 1  # FIFO: 50 granted, 60 waits
+        sem.release(50)
+        await asyncio.sleep(0)
+        assert sem.queue_depth() == 0
+        await t1
+        await t2
+        sem.release(60)
+
+    asyncio.run(asyncio.wait_for(main(), 10))
+
+
 def test_governor_respects_manual_hold():
     g = _FakeGarage()
     g.block_manager.scrub_worker.state.tranquility_manual = True

@@ -198,6 +198,121 @@ def test_quorum_set_tracker_counts():
     assert err.quorum == 2 and err.ok == 2
 
 
+def test_quorum_set_tracker_shared_node_failure_breaks_both_sets():
+    """Overlapping sets: the ONE node both sets depend on fails — both
+    quorums become unreachable after a single failure, and the error
+    accounting must say so (not wait for more failures)."""
+    a, b, c = b"a" * 32, b"b" * 32, b"c" * 32
+    t = QuorumSetResultTracker([[a, b], [b, c]], 2)
+    t.failure(b, RuntimeError("shared node down"))
+    # each set is 2-wide with quorum 2: one failure > len - quorum = 0
+    assert t.too_many_failures()
+    assert not t.all_quorums_ok()
+    assert t.set_counts() == [(0, 1), (0, 1)]
+    # successes on the remaining nodes cannot rescue either set
+    t.success(a, {})
+    t.success(c, {})
+    assert t.set_counts() == [(1, 1), (1, 1)]
+    assert not t.all_quorums_ok() and t.too_many_failures()
+    err = t.quorum_error()
+    assert err.ok == 2 and err.total == 3 and len(err.errors) == 1
+
+
+def test_quorum_set_tracker_disjoint_sets_isolated():
+    """A failure confined to one set must not break the other."""
+    a, b, c, d = b"a" * 32, b"b" * 32, b"c" * 32, b"d" * 32
+    t = QuorumSetResultTracker([[a, b], [c, d]], 1)
+    t.failure(a, RuntimeError("x"))
+    t.success(b, {})
+    t.success(c, {})
+    assert t.set_counts() == [(1, 1), (1, 0)]
+    assert t.all_quorums_ok()
+    assert not t.too_many_failures()
+
+
+def test_try_write_many_sets_cancellation_no_orphaned_tasks(tmp_path):
+    """Cancelling a caller mid-write must cancel the per-node tasks and
+    leave no 'exception was never retrieved' warnings behind."""
+
+    async def main():
+        net, systems, tasks = await make_cluster(tmp_path, 3)
+        unhandled = []
+        loop = asyncio.get_running_loop()
+        loop.set_exception_handler(
+            lambda lp, ctx: unhandled.append(ctx.get("message", "")))
+        try:
+            release = asyncio.Event()
+            for s in systems:
+                async def h(frm, payload, stream):
+                    await release.wait()
+                    raise ValueError("late failure after caller left")
+                s.netapp.endpoint("test/cancel").set_handler(h)
+            helper = RpcHelper(systems[0])
+            ep = systems[0].netapp.endpoint("test/cancel")
+            ids = [s.id for s in systems]
+            rs = RequestStrategy(quorum=2, timeout=10)
+            writer = asyncio.create_task(helper.try_write_many_sets(
+                ep, [[ids[0], ids[1]], [ids[1], ids[2]]], {}, rs))
+            await asyncio.sleep(0.2)  # let the per-node tasks launch
+            writer.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await writer
+            release.set()  # handlers fail AFTER the caller is gone
+            await asyncio.sleep(0.2)
+            stray = [t for t in asyncio.all_tasks()
+                     if "rpc_helper" in repr(t)
+                     and ("try_write_many_sets" in repr(t)
+                          or "one()" in repr(t))]
+            assert not stray, f"orphaned write tasks: {stray}"
+            import gc
+
+            gc.collect()
+            await asyncio.sleep(0.05)
+            assert not any("never retrieved" in m for m in unhandled), \
+                unhandled
+        finally:
+            loop.set_exception_handler(None)
+            await stop_cluster(systems, tasks)
+
+    run(main())
+
+
+def test_try_call_many_hedges_around_slow_node(tmp_path):
+    """No chaos needed: a merely-slow (not failing) node in the initial
+    quorum set must not hold a read to its own pace — the hedge fires
+    at the observed p95 and the next node answers."""
+
+    async def main():
+        net, systems, tasks = await make_cluster(tmp_path, 3)
+        try:
+            apply_flat_layout(systems)
+            helper = RpcHelper(systems[0])
+            nodes = [s.id for s in systems]
+            # whoever ranks second sits in the initial quorum-2 send
+            # set next to self — make THAT node the slow one
+            slow = helper.request_order(list(nodes))[1]
+
+            for s in systems:
+                def mk(s=s):
+                    async def h(frm, payload, stream):
+                        if s.id == slow:
+                            await asyncio.sleep(8.0)
+                        return {"node": s.id}
+                    return h
+                s.netapp.endpoint("test/slow").set_handler(mk())
+            ep = systems[0].netapp.endpoint("test/slow")
+            t0 = asyncio.get_event_loop().time()
+            resp = await helper.try_call_many(
+                ep, nodes, {}, RequestStrategy(quorum=2, timeout=30.0))
+            dt = asyncio.get_event_loop().time() - t0
+            assert len(resp) == 2
+            assert dt < 5.0, f"slow node dictated the read: {dt:.1f}s"
+        finally:
+            await stop_cluster(systems, tasks)
+
+    run(main())
+
+
 def test_peer_list_persisted_across_restart(tmp_path):
     async def main():
         net, systems, tasks = await make_cluster(tmp_path, 2)

@@ -2470,13 +2470,24 @@ def test_otlp_trace_sink_from_forked_server(tmp_path_factory):
         cli.request("PUT", "/otlpb/k", body=b"traced")
         deadline = time.monotonic() + 30  # exporter flushes every 3 s
         # (wide margin: this box runs co-tenant probes/benches)
-        while time.monotonic() < deadline and not received:
+
+        def all_spans():
+            # scan EVERY batch received so far: under load the PUT's
+            # span can land in the second flush, after a first batch
+            # of boot-time spans
+            out = []
+            for path, payload in list(received):
+                assert path == "/v1/traces"
+                for rs in payload["resourceSpans"]:
+                    for ss in rs["scopeSpans"]:
+                        out.extend(ss["spans"])
+            return out
+
+        while time.monotonic() < deadline and not any(
+                s["name"] == "http.request" for s in all_spans()):
             time.sleep(0.5)
         assert received, "no OTLP batch arrived from the server"
-        path, payload = received[0]
-        assert path == "/v1/traces"
-        spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
-        assert any(s["name"] == "http.request" for s in spans)
+        assert any(s["name"] == "http.request" for s in all_spans())
     finally:
         srv.stop()
         col.shutdown()
