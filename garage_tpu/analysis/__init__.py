@@ -1,0 +1,59 @@
+"""garage-lint: project-invariant static analysis (stdlib-ast only).
+
+Run it:  python -m garage_tpu.analysis [--format json|text] [paths]
+
+Rules (each encodes an invariant an earlier PR established by hand):
+
+  GL01 blocking-call-in-async   blocking I/O / digest-of-data on the
+                                event loop (PR 2's fast-path class)
+  GL02 hedge-on-mutation        hedged or hedge-defaulting RPC on a
+                                write endpoint (PR 4's k2v pin)
+  GL03 ssec-cache-leak          SSE-C scope reaching the block cache
+                                seam without explicit cacheable=
+  GL04 orphan-task              create_task/ensure_future result dropped
+  GL05 swallowed-exception      except Exception: pass (Aspirator)
+  GL06 await-holding-lock       RPC awaited inside `async with lock:`
+  GL07 unregistered-metric      dynamic / off-scheme metric names
+  GL08 config-knob-drift        code<->utils/config.py key drift
+  GL00 (framework)              stale waivers, stale baseline entries,
+                                unparseable files — cannot be waived
+
+Waive a deliberate site inline, with a reason (checked for staleness):
+
+    risky()  # lint: ignore[GL05] abort path; partial state dropped
+"""
+
+from __future__ import annotations
+
+from .baseline import (DEFAULT_BASELINE, apply_baseline, load_baseline,
+                       save_baseline)
+from .core import META_RULE, FileContext, ProjectState, Rule, Violation
+from .rules_async import (AwaitHoldingLock, BlockingCallInAsync,
+                          OrphanTask, SwallowedException)
+from .rules_project import ConfigKnobDrift, UnregisteredMetric
+from .rules_rpc import HedgeOnMutation, SsecCacheLeak
+from .walker import analyze_paths, analyze_source
+
+RULE_CLASSES = [
+    BlockingCallInAsync,   # GL01
+    HedgeOnMutation,       # GL02
+    SsecCacheLeak,         # GL03
+    OrphanTask,            # GL04
+    SwallowedException,    # GL05
+    AwaitHoldingLock,      # GL06
+    UnregisteredMetric,    # GL07
+    ConfigKnobDrift,       # GL08
+]
+
+
+def default_rules() -> list[Rule]:
+    """Fresh rule instances (cross-file rules carry per-run state)."""
+    return [cls() for cls in RULE_CLASSES]
+
+
+__all__ = [
+    "analyze_paths", "analyze_source", "default_rules", "RULE_CLASSES",
+    "Violation", "Rule", "FileContext", "ProjectState", "META_RULE",
+    "DEFAULT_BASELINE", "load_baseline", "save_baseline",
+    "apply_baseline",
+]

@@ -1,0 +1,99 @@
+"""CLI: python -m garage_tpu.analysis [--format json|text] [paths]
+
+Exit codes: 0 clean (waived/baselined findings allowed), 1 active
+violations, 2 bad invocation. CI's lint job is exactly
+`python -m garage_tpu.analysis --format json`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import (DEFAULT_BASELINE, META_RULE, analyze_paths,
+               apply_baseline, default_rules, load_baseline,
+               save_baseline)
+
+
+def _repo_root() -> str:
+    # garage_tpu/analysis/__main__.py -> repo root two levels above
+    # the package
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m garage_tpu.analysis",
+        description="garage-lint: project-invariant static analysis")
+    parser.add_argument("paths", nargs="*",
+                        help="files/dirs to scan (default: the "
+                             "garage_tpu package)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline JSON path, or 'none' "
+                             f"(default: <repo>/{DEFAULT_BASELINE})")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="snapshot current active violations into "
+                             "the baseline file and exit 0")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids to run "
+                             "(default: all)")
+    args = parser.parse_args(argv)
+
+    root = _repo_root()
+    paths = args.paths or [os.path.join(root, "garage_tpu")]
+    rules = default_rules()
+    if args.rules:
+        want = {r.strip().upper() for r in args.rules.split(",")}
+        rules = [r for r in rules if r.id in want]
+        if not rules:
+            print(f"no such rules: {args.rules}", file=sys.stderr)
+            return 2
+
+    # GL08's reverse direction accepts README documentation as a knob's
+    # reason to exist
+    data = {}
+    readme = os.path.join(root, "README.md")
+    if os.path.exists(readme):
+        with open(readme, "r", encoding="utf-8") as f:
+            data["readme_text"] = f.read()
+
+    violations, project = analyze_paths(paths, rules, root=root,
+                                        data=data)
+
+    baseline_path = args.baseline
+    if baseline_path != "none":
+        baseline_path = baseline_path or os.path.join(root,
+                                                      DEFAULT_BASELINE)
+        if args.write_baseline:
+            n = save_baseline(baseline_path, violations)
+            print(f"wrote {n} baseline entries to {baseline_path}")
+            return 0
+        violations.extend(apply_baseline(violations,
+                                         load_baseline(baseline_path)))
+        violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+
+    active = [v for v in violations if v.active]
+    if args.format == "json":
+        print(json.dumps({
+            "violations": [v.to_dict() for v in active],
+            "waived": sum(1 for v in violations if v.waived),
+            "baselined": sum(1 for v in violations if v.baselined),
+            "files": len(project.files),
+        }, indent=2))
+    else:
+        for v in active:
+            print(v.render())
+        waived = sum(1 for v in violations if v.waived)
+        base = sum(1 for v in violations if v.baselined)
+        print(f"{len(project.files)} files, {len(active)} violations "
+              f"({waived} waived, {base} baselined)")
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

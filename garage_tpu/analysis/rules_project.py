@@ -1,0 +1,236 @@
+"""Project-contract rules: GL07 unregistered-metric, GL08
+config-knob-drift.
+
+GL07 keeps the metric namespace auditable: every name handed to the
+metrics registry must be a string LITERAL matching the
+`<subsystem>_<snake_case>` scheme (regex shared with the runtime check
+in utils/metrics.py, which rejects the same violations at registration
+time under GARAGE_METRICS_STRICT=1 — the static rule and the runtime
+agree by construction). A dynamically built name is flagged outright:
+unbounded name cardinality is a slow memory leak and makes dashboards
+unwriteable.
+
+GL08 is the only genuinely cross-file rule: it parses the config
+dataclasses out of utils/config.py during the normal pass and, in
+finish_project, reconciles them against every `cfg.X` / `config.X` /
+`cfg.<section>.Y` read in the tree — a knob read in code but absent
+from the defaults is a typo that silently yields AttributeError at
+runtime; a default that nothing reads and the README never mentions is
+dead weight (or a feature that quietly lost its wiring).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..utils.metrics import METRIC_NAME_RE
+from .core import (FileContext, ProjectState, Rule, Violation, call_name,
+                   chain_segments, is_const)
+
+# ---- GL07 --------------------------------------------------------------
+
+METRIC_METHODS = {"inc", "observe", "timer"}
+METRIC_RECEIVERS = {"registry", "metrics_registry"}
+
+
+class UnregisteredMetric(Rule):
+    id = "GL07"
+    name = "unregistered-metric"
+    summary = ("metric name is dynamic or breaks the "
+               "<subsystem>_<snake_case> scheme; the runtime strict "
+               "check (utils/metrics.py) enforces the same regex")
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        # the registry implementation itself passes names through
+        return (not ctx.is_test
+                and not ctx.rel_path.endswith("utils/metrics.py"))
+
+    def on_call(self, node: ast.Call, ctx: FileContext) -> None:
+        segs = chain_segments(node.func)
+        if len(segs) < 2 or segs[-1] not in METRIC_METHODS:
+            return
+        if not any(s in METRIC_RECEIVERS for s in segs[:-1]):
+            return
+        if not node.args:
+            return
+        name = node.args[0]
+        if not (is_const(name) and isinstance(name.value, str)):
+            ctx.report(self.id, node,
+                       f"dynamically constructed metric name passed to "
+                       f"`{segs[-1]}`; metric names must be string "
+                       "literals (bounded cardinality, greppable)")
+            return
+        if not METRIC_NAME_RE.match(name.value):
+            ctx.report(self.id, node,
+                       f"metric name {name.value!r} violates the "
+                       f"naming scheme {METRIC_NAME_RE.pattern!r}")
+
+
+# ---- GL08 --------------------------------------------------------------
+
+CONFIG_RECEIVERS = {"cfg", "config"}
+SECTION_ATTRS = {"tpu": "TpuConfig", "qos": "QosConfig",
+                 "chaos": "ChaosConfig"}
+CONFIG_CLASSES = ("Config", "TpuConfig", "QosConfig", "ChaosConfig",
+                  "DataDir")
+
+
+def _config_receiver(node: ast.AST) -> bool:
+    """`cfg` / `config` / `self.cfg` / `self.config` / `<x>.config`
+    where the FINAL segment is the config name (never e.g.
+    website_config)."""
+    if isinstance(node, ast.Name):
+        return node.id in CONFIG_RECEIVERS
+    if isinstance(node, ast.Attribute):
+        return node.attr in CONFIG_RECEIVERS
+    return False
+
+
+class ConfigKnobDrift(Rule):
+    id = "GL08"
+    name = "config-knob-drift"
+    summary = ("config key read in code but absent from utils/config.py "
+               "defaults, or a default that nothing reads and the "
+               "README never documents")
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        return not ctx.is_test
+
+    def __init__(self):
+        # (attr, ctx_rel, lineno, col, qualname) for top-level reads;
+        # section reads keyed by section name
+        self.top_reads: list[tuple] = []
+        self.section_reads: list[tuple] = []
+        self.config_ctx: FileContext | None = None
+        self.string_constants: set[str] = set()
+
+    def finish_file(self, ctx: FileContext) -> None:
+        """GL08 collects per-file in its own walk (it needs two
+        ordered passes — alias discovery, then reads — which the
+        shared single dispatch can't provide)."""
+        if ctx.rel_path.endswith("utils/config.py"):
+            self.config_ctx = ctx
+            is_schema = True
+        else:
+            is_schema = False
+        method_funcs: set[int] = set()
+        # names locally bound to a config SECTION:  qc = cfg.qos
+        aliases: dict[str, str] = {}
+        for sub in ast.walk(ctx.tree):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value,
+                                                            str):
+                self.string_constants.add(sub.value)
+            elif isinstance(sub, ast.Call):
+                # knobs are data, never called: `cfg.get(...)` is a
+                # dict named cfg, not a knob read
+                method_funcs.add(id(sub.func))
+                if not is_schema and call_name(sub) == "getattr" \
+                        and len(sub.args) >= 2 \
+                        and isinstance(sub.args[0], ast.Name) \
+                        and sub.args[0].id in CONFIG_RECEIVERS \
+                        and is_const(sub.args[1]) \
+                        and isinstance(sub.args[1].value, str):
+                    self.top_reads.append(
+                        (sub.args[1].value, ctx.rel_path, sub.lineno,
+                         sub.col_offset, "<getattr>"))
+            elif isinstance(sub, ast.Assign) \
+                    and isinstance(sub.value, ast.Attribute) \
+                    and sub.value.attr in SECTION_ATTRS \
+                    and _config_receiver(sub.value.value):
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        aliases[t.id] = sub.value.attr
+                    elif isinstance(t, ast.Attribute):
+                        # self.qos_cfg = cfg.qos — alias by attr name
+                        aliases[t.attr] = sub.value.attr
+        if is_schema:
+            return  # the schema module reads itself freely
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Attribute) \
+                    or isinstance(node.ctx, (ast.Store, ast.Del)) \
+                    or id(node) in method_funcs:
+                continue
+            at = (node.lineno, node.col_offset)
+            v = node.value
+            if _config_receiver(v):
+                self.top_reads.append((node.attr, ctx.rel_path, *at,
+                                       "<module>"))
+            elif isinstance(v, ast.Attribute) and v.attr in SECTION_ATTRS \
+                    and _config_receiver(v.value):
+                self.section_reads.append((v.attr, node.attr,
+                                           ctx.rel_path, *at, "<module>"))
+            elif isinstance(v, ast.Name) and v.id in aliases:
+                self.section_reads.append((aliases[v.id], node.attr,
+                                           ctx.rel_path, *at, "<module>"))
+            elif isinstance(v, ast.Attribute) and v.attr in aliases \
+                    and isinstance(v.value, ast.Name) \
+                    and v.value.id == "self":
+                self.section_reads.append((aliases[v.attr], node.attr,
+                                           ctx.rel_path, *at, "<module>"))
+
+    def finish_project(self, project: ProjectState) -> list[Violation]:
+        if self.config_ctx is None:
+            return []  # fixture runs without the schema in scope
+        schema = _parse_config_schema(self.config_ctx.tree)
+        readme = project.data.get("readme_text", "")
+        out: list[Violation] = []
+        top_fields, top_extra, field_lines = schema["Config"]
+        known_top = top_fields | top_extra | set(SECTION_ATTRS)
+        for attr, rel, line, col, qual in self.top_reads:
+            if attr.startswith("_") or attr in known_top:
+                continue
+            out.append(Violation(
+                rule=self.id, path=rel, line=line, col=col,
+                message=f"config key `{attr}` read here but not a "
+                        "Config field in utils/config.py (typo or "
+                        "missing default)", context=qual))
+        for section, attr, rel, line, col, qual in self.section_reads:
+            fields, extra, _ = schema[SECTION_ATTRS[section]]
+            if attr.startswith("_") or attr in fields | extra:
+                continue
+            out.append(Violation(
+                rule=self.id, path=rel, line=line, col=col,
+                message=f"config key `{section}.{attr}` read here but "
+                        f"not a {SECTION_ATTRS[section]} field in "
+                        "utils/config.py", context=qual))
+        # reverse direction: dead defaults
+        read_top = {a for a, *_ in self.top_reads}
+        read_sec = {(s, a) for s, a, *_ in self.section_reads}
+        for cls, prefix in [("Config", "")] + [
+                (c, s + ".") for s, c in SECTION_ATTRS.items()]:
+            fields, _, lines = schema[cls]
+            for f in sorted(fields):
+                used = (f in read_top if not prefix
+                        else (prefix[:-1], f) in read_sec)
+                if used or f in self.string_constants \
+                        or re.search(rf"\b{re.escape(f)}\b", readme):
+                    continue
+                out.append(Violation(
+                    rule=self.id, path=self.config_ctx.rel_path,
+                    line=lines.get(f, 1), col=0,
+                    message=f"config default `{prefix}{f}` is never "
+                            "read in code, as a string constant, or "
+                            "documented in README (dead knob?)",
+                    context=cls))
+        return out
+
+
+def _parse_config_schema(tree: ast.Module) -> dict:
+    """Per config class: ({fields}, {properties+methods}, {field: line})
+    straight from the dataclass AST."""
+    out = {c: (set(), set(), {}) for c in CONFIG_CLASSES}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ClassDef)
+                and node.name in CONFIG_CLASSES):
+            continue
+        fields, extra, lines = out[node.name]
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Name):
+                fields.add(stmt.target.id)
+                lines[stmt.target.id] = stmt.lineno
+            elif isinstance(stmt, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                extra.add(stmt.name)
+    return out

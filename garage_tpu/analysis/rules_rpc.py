@@ -1,0 +1,133 @@
+"""Hedge-safety and SSE-C cache rules: GL02 hedge-on-mutation, GL03
+ssec-cache-leak.
+
+GL02 generalizes PR 4's hand-pinned k2v `hedge=False`: a hedged RPC
+races a second copy of the request, so a non-idempotent (write/insert/
+delete) endpoint must never be called with hedging possible — a
+slow-but-alive node would apply the mutation twice (duplicate DVVS
+siblings was the concrete k2v failure). Two triggers:
+
+  (a) `RequestStrategy(..., hedge=True)` anywhere — explicitly forcing
+      hedges is only ever safe on idempotent reads and needs a waiver
+      saying so;
+  (b) a hedge-DEFAULTING `try_call_many` (no `hedge=` in its strategy)
+      in a mutation context: the enclosing function, or an `op` string
+      in the payload, matches write/insert/delete patterns.
+
+GL03 is syntactic-first (ROADMAP notes the dataflow upgrade): in
+api/s3/ and block/, any call through the block-manager cache seam
+(`rpc_get_block` / `rpc_put_block`) from a scope that has SSE-C state
+in hand (a name matching `sse`) must pass `cacheable=` explicitly —
+the PR 3 invariant is that SSE-C plaintext never outlives the request
+in the node-local read cache, and the explicit kwarg is the audit
+point.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import (FileContext, Rule, call_name, is_const, kwarg)
+
+MUTATION_NAME_RE = re.compile(
+    r"(^|_)(insert|write|put|delete|update|remove|push|apply|store|"
+    r"flush|merge)($|_)")
+MUTATION_OP_RE = re.compile(
+    r"^(insert|write|put|delete|update|remove|push|apply|store|flush)")
+
+
+def _strategy_of(node: ast.Call, ctx: FileContext) -> ast.Call | None:
+    """Resolve the RequestStrategy expression of a try_call_many call:
+    inline constructor (positional arg 3 / kw `strategy`) or a local
+    `name = RequestStrategy(...)` binding recorded by the walker."""
+    expr = kwarg(node, "strategy")
+    if expr is None and len(node.args) >= 4:
+        expr = node.args[3]
+    if isinstance(expr, ast.Call) and call_name(expr) == "RequestStrategy":
+        return expr
+    if isinstance(expr, ast.Name):
+        return ctx.func_meta.get("strategies", {}).get(expr.id)
+    return None
+
+
+def _payload_ops(node: ast.Call) -> list[str]:
+    """Constant `op` strings found anywhere in the call's payload
+    arguments (table RPCs ship {'op': 'insert_many', ...} dicts)."""
+    ops = []
+    for arg in list(node.args) + [k.value for k in node.keywords]:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Dict):
+                for k, v in zip(sub.keys, sub.values):
+                    if is_const(k) and k.value == "op" \
+                            and isinstance(v, ast.Constant) \
+                            and isinstance(v.value, str):
+                        ops.append(v.value)
+    return ops
+
+
+class HedgeOnMutation(Rule):
+    id = "GL02"
+    name = "hedge-on-mutation"
+    summary = ("hedge=True, or a hedge-defaulting try_call_many on a "
+               "write/insert/delete endpoint — a hedged mutation can "
+               "apply twice (the PR 4 k2v duplicate-siblings bug); "
+               "pin hedge=False on non-idempotent RPCs")
+
+    def on_call(self, node: ast.Call, ctx: FileContext) -> None:
+        name = call_name(node)
+        if name == "RequestStrategy":
+            if is_const(kwarg(node, "hedge"), True):
+                ctx.report(self.id, node,
+                           "RequestStrategy(hedge=True): forcing "
+                           "hedges is only safe on idempotent reads; "
+                           "waive with that justification or drop it")
+            return
+        if name != "try_call_many":
+            return
+        strategy = _strategy_of(node, ctx)
+        if strategy is not None and kwarg(strategy, "hedge") is not None:
+            return  # explicit pin (True already flagged above)
+        func_name = ctx.func_stack[-1][1] if ctx.func_stack else ""
+        mutating = bool(MUTATION_NAME_RE.search(func_name))
+        ops = _payload_ops(node)
+        mutating = mutating or any(MUTATION_OP_RE.match(o) for o in ops)
+        if mutating:
+            why = (f"op {ops!r}" if ops and any(
+                MUTATION_OP_RE.match(o) for o in ops)
+                else f"enclosing `{func_name}`")
+            ctx.report(self.id, node,
+                       "hedge-defaulting try_call_many in mutation "
+                       f"context ({why}); pass RequestStrategy("
+                       "hedge=False) — a hedged write can apply twice")
+
+
+GL03_DIRS = re.compile(r"(^|/)(api/s3|block)/")
+SSE_NAME_RE = re.compile(r"(^|_)sse", re.IGNORECASE)
+CACHE_SEAM = {"rpc_get_block", "rpc_put_block"}
+
+
+class SsecCacheLeak(Rule):
+    id = "GL03"
+    name = "ssec-cache-leak"
+    summary = ("block read/write through the cache seam from an SSE-C "
+               "scope without an explicit cacheable= — PR 3's "
+               "invariant is that SSE-C payloads never enter the "
+               "node-local read cache")
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        return (not ctx.is_test) and bool(GL03_DIRS.search(ctx.rel_path))
+
+    def on_call(self, node: ast.Call, ctx: FileContext) -> None:
+        if call_name(node) not in CACHE_SEAM:
+            return
+        meta = ctx.func_meta
+        names = meta.get("args", set()) | meta.get("assigned", set())
+        if not any(SSE_NAME_RE.search(n) for n in names):
+            return
+        if kwarg(node, "cacheable") is None:
+            ctx.report(self.id, node,
+                       f"`{call_name(node)}` in an SSE-C scope without "
+                       "explicit cacheable=; pass cacheable=(sse_key "
+                       "is None) so encrypted payloads never enter "
+                       "the read cache")

@@ -323,8 +323,9 @@ async def _aclose_body(body) -> None:
         return
     try:
         await aclose()
-    except Exception:
-        pass  # the response is already dead; nothing to salvage
+    except Exception as e:
+        # the response is already dead; nothing to salvage
+        log.debug("body aclose failed: %s", e)
 
 
 class HttpServer:
@@ -449,4 +450,4 @@ class HttpServer:
             try:
                 writer.close()
             except Exception:
-                pass
+                pass  # lint: ignore[GL05] socket already dead; close is best-effort

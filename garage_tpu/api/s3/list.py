@@ -8,11 +8,14 @@ prefix / delimiter / common-prefix folding and continuation tokens.
 from __future__ import annotations
 
 import base64
+import logging
 from typing import Optional
 
 from ..http import Request, Response
 from .get import http_date
 from .xml import S3Error, xml, xml_response
+
+log = logging.getLogger("garage_tpu.api.s3.list")
 
 PAGE = 1000
 
@@ -71,7 +74,11 @@ async def handle_list_buckets(helper, api_key) -> Response:
             continue
         try:
             b = await helper.get_existing_bucket(a.bucket_id)
-        except Exception:
+        except Exception as e:
+            # alias row pointing at a deleted/ghost bucket: skip it,
+            # but not silently (Aspirator/GL05)
+            log.debug("ListBuckets: alias %s -> %s unresolvable: %s",
+                      a.name, a.bucket_id.hex()[:8], e)
             continue
         created = b.params.creation_date if b.params else 0
         entries.append(xml("Bucket",

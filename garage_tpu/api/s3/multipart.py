@@ -11,6 +11,7 @@ Complete object; Abort tombstones.
 from __future__ import annotations
 
 import hashlib
+import logging
 
 from ...model.s3.block_ref_table import BlockRef
 from ...model.s3.mpu_table import MpuPart, MultipartUpload, MultipartUploadTable
@@ -23,6 +24,8 @@ from ...utils.data import gen_uuid
 from ..http import Request, Response
 from .put import Chunker, extract_metadata_headers, read_and_put_blocks
 from .xml import S3Error, xml, xml_response
+
+log = logging.getLogger("garage_tpu.api.s3.multipart")
 
 
 class _UploadMeta:
@@ -133,8 +136,9 @@ async def handle_put_part(ctx, req: Request) -> Response:
         try:
             await ctx.garage.version_table.insert(Version.new(
                 version_uuid, (BACKLINK_MPU, mpu.upload_id), deleted=True))
-        except Exception:
-            pass
+        except Exception as e:
+            log.warning("interrupted-part tombstone failed (block refs "
+                        "leak until abort/complete): %s", e)
         raise
 
     # record the finished part
@@ -269,8 +273,9 @@ async def handle_upload_part_copy(ctx, req: Request) -> Response:
             await ctx.garage.version_table.insert(Version.new(
                 version_uuid, (BACKLINK_MPU, mpu.upload_id),
                 deleted=True))
-        except Exception:
-            pass
+        except Exception as e:
+            log.warning("interrupted-copy tombstone failed (block refs "
+                        "leak until abort/complete): %s", e)
         raise
     finally:
         # an aborted copy must cancel the source's readahead prefetches

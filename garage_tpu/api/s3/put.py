@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import logging
 import os
 from typing import Optional
 
@@ -26,6 +27,8 @@ from ...utils.crdt import now_msec
 from ...utils.data import gen_uuid
 from ..http import Request, Response
 from .xml import S3Error, bad_request
+
+log = logging.getLogger("garage_tpu.api.s3.put")
 
 # default concurrent block writes in the put pipeline (ref: put.rs:42);
 # the live value comes from `[s3_api] put_blocks_max_parallel`
@@ -274,8 +277,9 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
         try:
             await garage.object_table.insert(Object(bucket_id, key, [
                 ObjectVersion(uuid, ts, ObjectVersionState.aborted())]))
-        except Exception:
-            pass
+        except Exception as e:
+            log.warning("aborted-upload marker failed (refs leak until "
+                        "repair): %s", e)
         raise
 
 

@@ -97,6 +97,7 @@ def _read_probe_cache() -> Optional[dict]:
         if 0 <= age < _PROBE_TTL:  # reject future timestamps
             return cached
     except Exception:
+        # lint: ignore[GL05] stale/corrupt probe cache is the same as no cache
         pass
     return None
 
@@ -199,8 +200,11 @@ class DeviceFeeder:
     batches are big enough), "off" (host only), "require" (device always;
     raise if probe fails — bench/test use)."""
 
-    def __init__(self, codec=None, mode: str = "auto"):
+    def __init__(self, codec=None, mode: str = "auto",
+                 max_batch: int = 256):
         self.codec = codec
+        # greedy-drain cap: blocks per device batch ([tpu] batch_blocks)
+        self.max_batch = max(1, int(max_batch))
         env_mode = os.environ.get("GARAGE_TPU_DEVICE")
         if mode == "auto" and env_mode == "off":
             # test/CI kill-switch: never probe, never spawn calibration
@@ -596,7 +600,8 @@ class DeviceFeeder:
             try:
                 # greedy non-waiting drain: whatever queued while the
                 # last batch was on the device becomes the next batch
-                while not self._q.empty() and len(batch) < 256:
+                while not self._q.empty() \
+                        and len(batch) < self.max_batch:
                     batch.append(self._q.get_nowait())
                 n_md5 = sum(1 for it in batch if it.op == "hash_md5")
                 want = min(self.active_streams, 8)
@@ -846,6 +851,7 @@ class DeviceFeeder:
             if native.available():
                 return native.blake3_many(blobs)
         except Exception:
+            # lint: ignore[GL05] native backend optional; pure-python fallback follows
             pass
         from ..utils.data import blake3sum
 
@@ -871,6 +877,7 @@ class DeviceFeeder:
                                                     pmat, prefix=p)
                             for p, d in items]
             except Exception:
+                # lint: ignore[GL05] native backend optional; _do_encode fallback follows
                 pass
         # device, or host without native: delegate the encode itself to
         # _do_encode (single source of truth) and wrap with pack_shard
@@ -900,6 +907,7 @@ class DeviceFeeder:
                                + [bytes(p) for p in parity])
                 return out
         except Exception:
+            # lint: ignore[GL05] native backend optional; numpy fallback follows
             pass
         # last resort: pure numpy — NEVER codec.encode here, whose JAX
         # path would re-enter the possibly-dead backend this host branch
@@ -938,6 +946,7 @@ class DeviceFeeder:
             if native.available():
                 native_mod = native
         except Exception:
+            # lint: ignore[GL05] native backend optional; numpy path handles it
             pass
         out = []
         for s in stripes:

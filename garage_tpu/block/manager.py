@@ -175,6 +175,7 @@ class BlockManager:
                  codec: Optional[BlockCodec] = None,
                  compression: bool = True, fsync: bool = False,
                  device_mode: str = "auto",
+                 device_batch_blocks: int = 256,
                  ram_buffer_max: int = 256 * 1024 * 1024,
                  read_cache_max_bytes: Optional[int] = None):
         self.system = system
@@ -198,6 +199,7 @@ class BlockManager:
         self.feeder = DeviceFeeder(
             codec=codec if isinstance(codec, ErasureCodec) else None,
             mode=device_mode,
+            max_batch=device_batch_blocks,
         )
         # RAM held by in-flight outbound block writes, bounded like the
         # reference's buffer_stream semaphore (ref: manager.rs:156,
@@ -603,7 +605,13 @@ class BlockManager:
                 if resp.get("data") is None:
                     return None
                 return unpack_shard(resp["data"])
-            except Exception:
+            except Exception as e:
+                # local disk/unpack failures are a different signal
+                # than a peer fetch failing; don't conflate them
+                registry().inc("block_shard_fetch_errors",
+                               source="local" if node == me else "remote")
+                log.debug("shard fetch part=%d from %s failed: %s",
+                          idx, node[:4].hex(), e)
                 return None
 
         health = self.rpc.health()

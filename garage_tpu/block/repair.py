@@ -382,7 +382,8 @@ class ScrubWorker(Worker):
                 blk = DataBlock.unpack(packed)
                 blk.verify(hash32)
                 return packed
-            except Exception:
+            except Exception as e:
+                log.debug("decode candidate %s rejected: %s", idx, e)
                 return None
 
         candidates = [tuple(range(k))]

@@ -239,7 +239,9 @@ class BlockResyncManager:
                 )
                 if resp.get("data") is not None:
                     return resp["data"]
-            except Exception:
+            except Exception as e:
+                log.debug("resync shard fetch part=%d from %s "
+                          "failed: %s", idx, node[:4].hex(), e)
                 continue
         return None
 

@@ -78,7 +78,8 @@ class Garage:
 
         # ---- db (ref: garage.rs:95-116) --------------------------------
         db_path = os.path.join(config.metadata_dir, "db")
-        self.db = open_db(db_path, engine=config.db_engine)
+        self.db = open_db(db_path, engine=config.db_engine,
+                          fsync=config.metadata_fsync)
 
         # ---- identity / net (ref: garage.rs:118-130, system.rs) --------
         netid = (bytes.fromhex(config.rpc_secret) if config.rpc_secret
@@ -131,6 +132,7 @@ class Garage:
             compression=config.compression_level is not None,
             fsync=config.data_fsync,
             device_mode="auto" if config.tpu.enable else "off",
+            device_batch_blocks=config.tpu.batch_blocks,
             ram_buffer_max=config.block_ram_buffer_max,
             read_cache_max_bytes=config.block_read_cache_max_bytes,
         )

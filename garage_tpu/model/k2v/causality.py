@@ -57,6 +57,7 @@ class CausalContext:
         try:
             raw = base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
         except Exception:
+            # lint: ignore[GL05] malformed client token -> None is the parse contract (400 upstream)
             return None
         if len(raw) < 8 or len(raw) % 16 != 8:
             return None

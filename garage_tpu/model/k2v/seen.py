@@ -53,4 +53,5 @@ class RangeSeenMarker:
             return cls({sk: {int(n): int(t) for n, t in vc}
                         for sk, vc in items})
         except Exception:
+            # lint: ignore[GL05] malformed client token -> None is the parse contract (400 upstream)
             return None

@@ -205,7 +205,7 @@ class PlainChannel:
         try:
             self.writer.close()
         except Exception:
-            pass
+            pass  # lint: ignore[GL05] transport close is best-effort
 
 
 async def _plain_client_handshake(reader, writer, netid: bytes, privkey
@@ -387,7 +387,7 @@ class SecureChannel:
         try:
             self.writer.close()
         except Exception:
-            pass
+            pass  # lint: ignore[GL05] transport close is best-effort
 
 
 class _SendItem:

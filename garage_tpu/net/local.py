@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 from typing import Optional
 
+from ..utils.background import spawn
 from ..utils.error import RpcError
 from .conn import Conn
 
@@ -47,7 +48,7 @@ class LocalChannel:
                 self.tx.put_nowait(None)
                 self.rx.put_nowait(None)
             except Exception:
-                pass
+                pass  # lint: ignore[GL05] eof marker into a full/closed queue is a no-op
 
 
 class LocalNetwork:
@@ -76,7 +77,7 @@ class LocalNetwork:
             node = self.nodes.get(x)
             conn = node.conns.get(y) if node else None
             if conn is not None:
-                asyncio.ensure_future(conn.close())
+                spawn(conn.close(), "localnet-partition-close")
 
     def heal(self, a: bytes, b: bytes) -> None:
         self.partitions.discard(frozenset((a, b)))

@@ -20,6 +20,7 @@ try:
 except ModuleNotFoundError:
     Ed25519PrivateKey = None
 
+from ..utils.background import spawn
 from ..utils.error import RpcError
 from .conn import Conn, SecureChannel, client_handshake, server_handshake
 from .endpoint import Endpoint
@@ -240,7 +241,7 @@ class NetApp:
             if old_is_initiated(old) == we_should_initiate != initiator:
                 chan.close()
                 return
-            asyncio.ensure_future(old.close())
+            spawn(old.close(), "netapp-replace-conn-close")
         conn = Conn(peer_id, chan, self._handle_request, initiator)
         self.conns[peer_id] = conn
         conn.start()

@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Optional
 
+from ..utils.background import spawn
 from ..utils.data import blake2sum
 from ..utils.metrics import registry
 from .message import PRIO_HIGH
@@ -460,7 +461,7 @@ class PeeringManager:
                     and peer.addr is not None
                 ):
                     peer.state = PeerConnState.TRYING
-                    asyncio.ensure_future(self._try_connect(peer))
+                    spawn(self._try_connect(peer), "peer-connect")
             await asyncio.sleep(min(1.0, self.retry_interval / 10))
 
     async def _try_connect(self, peer: _Peer) -> None:
@@ -496,15 +497,15 @@ class PeeringManager:
         if not incoming:
             # tell the acceptor our public address (ref Hello message,
             # src/net/netapp.rs:440-470)
-            asyncio.ensure_future(self._send_hello(peer_id))
+            spawn(self._send_hello(peer_id), "peer-hello")
 
     async def _send_hello(self, peer_id: bytes) -> None:
         try:
             await self.ep_hello.call(
                 peer_id, {"addr": list(self.netapp.public_addr or ())}, PRIO_HIGH, timeout=10.0
             )
-        except Exception:
-            pass
+        except Exception as e:
+            log.debug("hello to %s failed: %s", peer_id[:4].hex(), e)
 
     def _on_disconnected(self, peer_id: bytes) -> None:
         p = self.peers.get(peer_id)
@@ -559,8 +560,9 @@ class PeeringManager:
             resp, _ = await self.ep_list.call(node, {}, PRIO_HIGH, timeout=self.ping_timeout)
             for pid, addr in resp.get("peers", []):
                 self.add_peer(tuple(addr) if addr else None, bytes(pid))
-        except Exception:
-            pass
+        except Exception as e:
+            log.debug("peer-list pull from %s failed: %s",
+                      node[:4].hex(), e)
 
 
 def _is_pair(entry) -> bool:

@@ -10,6 +10,8 @@ from __future__ import annotations
 import asyncio
 from typing import AsyncIterator, Optional
 
+from ..utils.background import spawn
+
 _HIGH_WATER = 1 << 20  # pause producer above 1 MiB buffered
 
 
@@ -123,14 +125,14 @@ class ByteStream:
         self._size = 0
         self._drained.set()
         if not self._eof:
-            asyncio.ensure_future(self._drain_rest())
+            spawn(self._drain_rest(), "stream-discard-drain")
 
     async def _drain_rest(self) -> None:
         try:
             while await self.read_chunk(1 << 16):
                 pass
         except Exception:
-            pass
+            pass  # lint: ignore[GL05] draining an abandoned stream; errors have no consumer
 
     def __aiter__(self) -> AsyncIterator[bytes]:
         return self._iter()
@@ -162,5 +164,5 @@ class ByteStream:
             except Exception as e:
                 s.push_error(e)
 
-        asyncio.ensure_future(pump())
+        spawn(pump(), "stream-iter-pump")
         return s

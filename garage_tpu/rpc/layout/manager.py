@@ -12,6 +12,7 @@ import asyncio
 import logging
 from typing import Callable, Optional
 
+from ...utils.background import spawn
 from ...utils.migrate import decode as migrate_decode, encode as migrate_encode
 from ...utils.persister import Persister
 from ..replication_mode import ReplicationMode
@@ -62,7 +63,7 @@ class LayoutManager:
                 cb()
             except Exception:
                 log.exception("layout on_change callback failed")
-        asyncio.ensure_future(self.broadcast())
+        spawn(self.broadcast(), "layout-broadcast")
 
     def merge_remote(self, raw: bytes) -> bool:
         remote = migrate_decode(LayoutHistory, raw)

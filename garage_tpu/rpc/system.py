@@ -22,6 +22,7 @@ from ..net import NetApp, PeeringManager
 from ..net.message import PRIO_HIGH
 from ..net.netapp import gen_node_key, node_key_from_bytes, node_key_to_bytes
 from ..net.peering import PeerConnState
+from ..utils.background import spawn
 from ..utils.migrate import Migratable
 from ..utils.persister import Persister
 from .layout.manager import LayoutManager
@@ -282,10 +283,11 @@ class System:
 
                 raw = menc(self.layout_manager.history)
                 await self.layout_manager._advertise_one(peer_id, raw)
-            except Exception:
-                pass
+            except Exception as e:
+                log.debug("layout push to %s failed: %s",
+                          peer_id[:4].hex(), e)
 
-        asyncio.ensure_future(push())
+        spawn(push(), "layout-push-on-connect")
 
     # ---- rpc handler ---------------------------------------------------
 

@@ -87,16 +87,21 @@ class TableGc(Worker):
         """Group by storage-node set, then run the 2 RPC phases.
         ref: gc.rs:152-275."""
         me = self.table.system.id
-        # drop entries whose row changed since (no longer that tombstone)
+        # drop entries whose row changed since (no longer that tombstone);
+        # per-entry sqlite read + digest runs off the event loop (GL01)
         from ..utils.data import blake2sum
 
-        live: list[GcTodoEntry] = []
-        for e in batch:
-            cur = self.data.store.get(e.row_key)
-            if cur is None or blake2sum(cur) != e.value_hash:
-                self.data.gc_todo.remove(e.todo_key())
-            else:
-                live.append(e)
+        def filter_live() -> list[GcTodoEntry]:
+            out: list[GcTodoEntry] = []
+            for e in batch:
+                cur = self.data.store.get(e.row_key)
+                if cur is None or blake2sum(cur) != e.value_hash:
+                    self.data.gc_todo.remove(e.todo_key())
+                else:
+                    out.append(e)
+            return out
+
+        live = await asyncio.to_thread(filter_live)
 
         by_nodes: dict[tuple, list[GcTodoEntry]] = {}
         for e in live:

@@ -16,6 +16,42 @@ from typing import Any, Callable, Dict, Optional
 
 logger = logging.getLogger("garage.background")
 
+# fire-and-forget tasks retained here until done: asyncio keeps only a
+# weak reference to running tasks, so an un-retained task can be
+# garbage-collected mid-flight and its exception is never observed
+# (GL04 orphan-task — the static rule and this helper are two halves
+# of the same invariant)
+_detached: set[asyncio.Task] = set()
+
+
+def spawn(coro, name: str = "") -> asyncio.Task:
+    """Deliberately-detached task with lifecycle hygiene: retained
+    until done, exception observed and logged instead of surfacing as
+    'Task exception was never retrieved' at interpreter exit."""
+    t = asyncio.ensure_future(coro)
+    if name:
+        try:
+            t.set_name(name)
+        except AttributeError:
+            pass
+    _detached.add(t)
+    t.add_done_callback(_spawn_done)
+    return t
+
+
+def _spawn_done(t: asyncio.Task) -> None:
+    _detached.discard(t)
+    if t.cancelled():
+        return
+    e = t.exception()
+    if e is not None:
+        # warning, not debug: before spawn() existed these surfaced as
+        # asyncio's ERROR-level "Task exception was never retrieved",
+        # and a detached task dying is never expected (expected
+        # failures are caught inside the task)
+        logger.warning("detached task %s failed: %s",
+                       t.get_name(), e, exc_info=e)
+
 
 class WState(Enum):
     BUSY = "busy"

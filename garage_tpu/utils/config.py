@@ -32,8 +32,10 @@ class TpuConfig:
     """TPU data-plane knobs (no reference analogue)."""
 
     enable: bool = True
-    # batch of blocks shipped to the device in one encode/hash call
-    batch_blocks: int = 16
+    # max blocks shipped to the device in one encode/hash call (the
+    # feeder's greedy-drain cap; 256 matches the previously hard-coded
+    # value)
+    batch_blocks: int = 256
     # platform override for tests ("cpu" forces the jnp fallback path)
     platform: Optional[str] = None
 
@@ -144,8 +146,10 @@ class Config:
     k2v_api_bind_addr: Optional[str] = None
     admin_api_bind_addr: Optional[str] = None
     admin_token: Optional[str] = None
+    # lint: ignore[GL08] read via getattr in fill_secrets
     admin_token_file: Optional[str] = None
     metrics_token: Optional[str] = None
+    # lint: ignore[GL08] read via getattr in fill_secrets
     metrics_token_file: Optional[str] = None
     # [admin] trace_sink: OTLP/HTTP collector base URL (ref:
     # config.rs admin.trace_sink + garage/tracing_setup.rs)

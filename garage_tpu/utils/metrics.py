@@ -10,9 +10,27 @@ count / sum / max so rates and averages are derivable.
 
 from __future__ import annotations
 
+import os
+import re
 import threading
 import time
 from typing import Iterator, Optional
+
+# The metric naming contract: <subsystem>_<snake_case>. The static rule
+# GL07 (garage_tpu/analysis/) enforces it at review time on literal
+# names; this runtime check enforces the SAME regex at registration
+# time so a dynamically formatted name (f"qos_{key}") that escapes the
+# static net still fails fast in debug mode. Keep the two in lockstep:
+# the analyzer imports this regex.
+METRIC_NAME_RE = re.compile(
+    r"^(api|qos|cache|chaos|rpc|block|table|resync|scrub|s3)_"
+    r"[a-z0-9_]+$")
+
+# Debug-mode strictness: on under GARAGE_METRICS_STRICT=1 (the test
+# suite sets it), off in production — a bad metric name must never
+# take down a serving node. "0"/"false"/"no" disable explicitly.
+STRICT_METRIC_NAMES = os.environ.get(
+    "GARAGE_METRICS_STRICT", "").lower() not in ("", "0", "false", "no")
 
 
 class _Series:
@@ -34,6 +52,11 @@ class MetricsRegistry:
         key = (name, labels)
         s = self._series.get(key)
         if s is None:
+            if STRICT_METRIC_NAMES and not METRIC_NAME_RE.match(name):
+                raise ValueError(
+                    f"metric name {name!r} violates the naming scheme "
+                    f"{METRIC_NAME_RE.pattern!r} (GL07); use a static "
+                    "<subsystem>_<snake_case> name")
             with self._lock:
                 s = self._series.setdefault(key, _Series())
         return s

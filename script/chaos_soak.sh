@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Nightly chaos soak (ROADMAP "Chaos in CI nightly"): the deterministic
+# chaos suite first, then N randomized-seed soak iterations against a
+# real in-process cluster. Every iteration logs its seed ON ENTRY, so
+# any failure replays deterministically:
+#
+#     CHAOS_SOAK_SEED=<seed> pytest tests/test_chaos.py -k soak -s
+#
+# Usage: script/chaos_soak.sh [iterations]    (default 10)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+PY=${PYTHON:-python}
+export JAX_PLATFORMS=cpu GARAGE_TPU_DEVICE=off GARAGE_METRICS_STRICT=1 \
+       PYTHONUNBUFFERED=1
+ITERS=${1:-10}
+
+say() { printf '\033[1;34m== %s\033[0m\n' "$*"; }
+
+say "chaos suite (deterministic seeds)"
+"$PY" -m pytest tests/test_chaos.py -q -m 'not slow' -p no:cacheprovider
+
+say "randomized soak: $ITERS iterations"
+for i in $(seq 1 "$ITERS"); do
+    SEED=$(( (RANDOM << 15) ^ RANDOM ^ $$ + i ))
+    say "soak $i/$ITERS seed=$SEED (replay: CHAOS_SOAK_SEED=$SEED pytest tests/test_chaos.py -k soak -s)"
+    CHAOS_SOAK_SEED=$SEED "$PY" -m pytest tests/test_chaos.py \
+        -k test_randomized_soak -q -s -p no:cacheprovider
+done
+say "chaos soak OK"

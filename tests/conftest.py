@@ -13,6 +13,9 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # subprocess would see the axon tunnel (which ignores JAX_PLATFORMS) and
 # start calibration threads whose C++ state aborts interpreter teardown
 os.environ["GARAGE_TPU_DEVICE"] = "off"
+# enforce the metric naming contract at registration time (the runtime
+# half of the static GL07 rule; utils/metrics.py)
+os.environ.setdefault("GARAGE_METRICS_STRICT", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

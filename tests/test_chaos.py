@@ -10,11 +10,14 @@ so silent non-injection cannot pass).
 """
 
 import asyncio
+import os
+import random
 import time
 
 import pytest
 
 from garage_tpu.chaos import FaultSpec, arm, controller, disarm
+from garage_tpu.utils.data import blake2sum
 from garage_tpu.chaos import injector
 from garage_tpu.net.peering import (
     BREAKER_COOLDOWN,
@@ -536,3 +539,76 @@ def test_net_delay_slows_but_does_not_break(tmp_path):
             await stop_all(systems, tasks)
 
     run(main())
+
+
+# ---- randomized soak (script/chaos_soak.sh) ----------------------------
+#
+# One iteration of the nightly soak: a seeded-random fault mix over a
+# real 3-node cluster, PUT/GET rounds that may fail while chaos is
+# armed (quorum loss is legal) but must NEVER return wrong bytes, and
+# a full read-back after disarm. The seed comes from CHAOS_SOAK_SEED
+# and is printed on entry, so any failure replays deterministically:
+#
+#     CHAOS_SOAK_SEED=<seed> pytest tests/test_chaos.py -k soak -s
+
+
+@pytest.mark.slow
+@pytest.mark.skipif("CHAOS_SOAK_SEED" not in os.environ,
+                    reason="soak iteration; driven by script/chaos_soak.sh")
+def test_randomized_soak(tmp_path):
+    seed = int(os.environ["CHAOS_SOAK_SEED"])
+    print(f"\nchaos soak seed={seed}")
+    rng = random.Random(seed)
+
+    async def main():
+        net, systems, managers, tasks = await make_block_cluster(tmp_path)
+        try:
+            victim = systems[rng.randrange(1, len(systems))].id
+            c = arm(seed=seed)
+            for _ in range(rng.randint(2, 4)):
+                kind = rng.choice(["rpc_error", "disk_read_error",
+                                   "disk_bitrot", "net_delay"])
+                spec = {"kind": kind,
+                        "prob": round(rng.uniform(0.05, 0.4), 3),
+                        "count": rng.randint(1, 6)}
+                if kind == "rpc_error":
+                    spec["peer"] = victim.hex()[:8]
+                if kind == "net_delay":
+                    spec["peer"] = victim.hex()[:8]
+                    spec["delay_s"] = 0.02
+                c.add(FaultSpec(**spec))
+            stored: list[tuple[bytes, bytes]] = []
+            for i in range(12):
+                data = bytes([rng.randrange(256)]) * rng.randint(
+                    1 << 10, 64 << 10)
+                h = blake2sum(data)
+                try:
+                    await asyncio.wait_for(
+                        managers[0].rpc_put_block(h, data), 20.0)
+                    stored.append((h, data))
+                except Exception:
+                    pass  # quorum loss under chaos is legal
+                if stored and rng.random() < 0.7:
+                    rh, rdata = stored[rng.randrange(len(stored))]
+                    m = managers[rng.randrange(len(managers))]
+                    try:
+                        got = await asyncio.wait_for(
+                            m.rpc_get_block(rh, cacheable=False), 20.0)
+                    except Exception:
+                        continue  # failure is legal; corruption is not
+                    assert got == rdata, \
+                        f"soak seed={seed}: corrupt read round {i}"
+            disarm()
+            # steady state: everything that was acknowledged must read
+            # back byte-identical from an arbitrary node
+            assert stored, f"soak seed={seed}: no PUT survived"
+            for rh, rdata in stored:
+                m = managers[rng.randrange(len(managers))]
+                got = await asyncio.wait_for(
+                    m.rpc_get_block(rh, cacheable=False), 30.0)
+                assert got == rdata, \
+                    f"soak seed={seed}: corrupt read after disarm"
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main(), timeout=240.0)

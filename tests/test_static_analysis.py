@@ -1,0 +1,486 @@
+"""garage-lint self-tests: per-rule firing + suppression fixtures,
+waiver hygiene, baseline round-trip, and the tier-1 enforcement hook
+(the full analyzer over garage_tpu/ must be clean).
+
+Fixture snippets are analyzed in memory via analyze_source with a
+rel_path chosen to satisfy each rule's directory scoping.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from garage_tpu.analysis import (META_RULE, analyze_paths, analyze_source,
+                                 apply_baseline, default_rules,
+                                 load_baseline, save_baseline)
+from garage_tpu.analysis.baseline import DEFAULT_BASELINE
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(src: str, rel_path: str = "garage_tpu/fake/mod.py"):
+    """-> list of ACTIVE violations for one in-memory module."""
+    ctx = analyze_source(textwrap.dedent(src), default_rules(),
+                         rel_path=rel_path)
+    return [v for v in ctx.violations if v.active]
+
+
+def rules_of(violations):
+    return sorted({v.rule for v in violations})
+
+
+# ---- GL01 blocking-call-in-async ---------------------------------------
+
+def test_gl01_fires_on_blocking_call_in_async():
+    vs = run("""
+        import time
+        async def handler(req):
+            time.sleep(0.1)
+    """)
+    assert rules_of(vs) == ["GL01"]
+    assert "time.sleep" in vs[0].message
+
+
+def test_gl01_fires_on_open_and_digest_of_data():
+    vs = run("""
+        import hashlib
+        async def read_block(path, data):
+            f = open(path, "rb")
+            h = hashlib.sha256(data)
+    """)
+    assert [v.rule for v in vs] == ["GL01", "GL01"]
+
+
+def test_gl01_exempts_to_thread_wrapped_and_constant_digest():
+    vs = run("""
+        import asyncio, hashlib, time
+        async def handler(path, data):
+            def work():
+                time.sleep(0.1)
+                return open(path, "rb").read()
+            raw = await asyncio.to_thread(work)
+            empty = hashlib.sha256()           # no data: instantaneous
+            also = await asyncio.to_thread(hashlib.sha256, data)
+        def sync_path(path):
+            return open(path).read()           # not async: fine
+    """)
+    assert vs == []
+
+
+# ---- GL02 hedge-on-mutation --------------------------------------------
+
+def test_gl02_fires_on_explicit_hedge_true():
+    # the PR 4 acceptance scenario: flipping the k2v pin to hedge=True
+    vs = run("""
+        async def _call_any(self, who, payload):
+            await self.item_table.rpc.try_call_many(
+                self.endpoint, who, payload,
+                RequestStrategy(quorum=1, hedge=True))
+    """)
+    assert "GL02" in rules_of(vs)
+
+
+def test_gl02_fires_on_hedge_defaulting_mutation():
+    by_name = run("""
+        async def insert_rpc(self, who, payload):
+            await self.rpc.try_call_many(
+                self.ep, who, payload, RequestStrategy(quorum=1))
+    """)
+    assert rules_of(by_name) == ["GL02"]
+    by_op = run("""
+        async def _fanout(self, who, raws):
+            await self.rpc.try_call_many(
+                self.ep, who, {"op": "insert_many", "entries": raws},
+                RequestStrategy(quorum=2))
+    """)
+    assert rules_of(by_op) == ["GL02"]
+
+
+def test_gl02_quiet_on_pinned_or_read_calls():
+    vs = run("""
+        async def insert_rpc(self, who, payload):
+            await self.rpc.try_call_many(
+                self.ep, who, payload,
+                RequestStrategy(quorum=1, hedge=False))
+        async def _get_traced(self, pk):
+            return await self.rpc.try_call_many(
+                self.ep, self.nodes, {"op": "get", "pk": pk},
+                RequestStrategy(quorum=1))
+    """)
+    assert vs == []
+
+
+def test_gl02_resolves_local_strategy_binding():
+    vs = run("""
+        async def delete_rpc(self, who, payload):
+            st = RequestStrategy(quorum=1)
+            await self.rpc.try_call_many(self.ep, who, payload, st)
+    """)
+    assert rules_of(vs) == ["GL02"]
+
+
+# ---- GL03 ssec-cache-leak ----------------------------------------------
+
+S3_PATH = "garage_tpu/api/s3/fake_get.py"
+
+
+def test_gl03_fires_without_explicit_cacheable():
+    vs = run("""
+        async def stream(mgr, h, sse_key):
+            return await mgr.rpc_get_block(h)
+    """, rel_path=S3_PATH)
+    assert rules_of(vs) == ["GL03"]
+
+
+def test_gl03_quiet_with_cacheable_or_outside_sse_scope():
+    vs = run("""
+        async def stream(mgr, h, sse_key):
+            return await mgr.rpc_get_block(
+                h, cacheable=sse_key is None)
+        async def plain(mgr, h):
+            return await mgr.rpc_get_block(h)
+    """, rel_path=S3_PATH)
+    assert vs == []
+
+
+def test_gl03_scoped_to_s3_and_block_dirs():
+    vs = run("""
+        async def stream(mgr, h, sse_key):
+            return await mgr.rpc_get_block(h)
+    """, rel_path="garage_tpu/web/server.py")
+    assert vs == []
+
+
+# ---- GL04 orphan-task --------------------------------------------------
+
+def test_gl04_fires_on_dropped_task():
+    vs = run("""
+        import asyncio
+        def kick(coro):
+            asyncio.create_task(coro())
+            asyncio.ensure_future(coro())
+    """)
+    assert [v.rule for v in vs] == ["GL04", "GL04"]
+
+
+def test_gl04_quiet_when_retained_or_awaited():
+    vs = run("""
+        import asyncio
+        from garage_tpu.utils.background import spawn
+        async def kick(self, coro):
+            t = asyncio.create_task(coro())
+            self._tasks.add(t)
+            await asyncio.create_task(coro())
+            spawn(coro())
+    """)
+    assert vs == []
+
+
+# ---- GL05 swallowed-exception ------------------------------------------
+
+def test_gl05_fires_on_silent_swallow():
+    for body in ("pass", "return None", "return"):
+        vs = run(f"""
+            def f(x):
+                try:
+                    g()
+                except Exception:
+                    {body}
+        """)
+        assert rules_of(vs) == ["GL05"], body
+    vs = run("""
+        def f(xs):
+            for x in xs:
+                try:
+                    g(x)
+                except Exception:
+                    continue
+    """)
+    assert rules_of(vs) == ["GL05"]
+
+
+def test_gl05_quiet_on_logged_narrow_or_test_code():
+    vs = run("""
+        def f():
+            try:
+                g()
+            except Exception as e:
+                log.debug("g failed: %s", e)
+            try:
+                g()
+            except KeyError:
+                pass
+            try:
+                g()
+            except Exception:
+                return False
+    """)
+    assert vs == []
+    in_test = run("""
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """, rel_path="tests/test_fake.py")
+    assert in_test == []
+
+
+# ---- GL06 await-holding-lock -------------------------------------------
+
+BLOCK_PATH = "garage_tpu/block/fake.py"
+
+
+def test_gl06_fires_on_rpc_await_under_async_lock():
+    vs = run("""
+        async def refresh(self, payload):
+            async with self._lock:
+                await self.rpc.try_call_many(self.ep, self.nodes,
+                                             payload, st)
+    """, rel_path=BLOCK_PATH)
+    assert rules_of(vs) == ["GL06"]
+
+
+def test_gl06_quiet_outside_lock_or_non_rpc_awaits():
+    vs = run("""
+        async def refresh(self, payload):
+            async with self._lock:
+                await asyncio.sleep(0)
+                data = await asyncio.to_thread(self.read_local, h)
+            await self.rpc.try_call_many(self.ep, self.nodes,
+                                         payload, st)
+            async with self._sem:   # not a lock by name
+                await self.rpc.call(self.ep, n, payload, 0)
+    """, rel_path=BLOCK_PATH)
+    assert vs == []
+
+
+def test_gl06_scoped_to_table_and_block():
+    vs = run("""
+        async def push(self, payload):
+            async with self._lock:
+                await self.rpc.call(self.ep, n, payload, 0)
+    """, rel_path="garage_tpu/api/s3/fake.py")
+    assert vs == []
+
+
+# ---- GL07 unregistered-metric ------------------------------------------
+
+def test_gl07_fires_on_dynamic_and_off_scheme_names():
+    vs = run("""
+        from garage_tpu.utils.metrics import registry
+        def f(key):
+            registry().inc(f"qos_{key}_total")
+            registry().inc("frontend_requests")
+    """)
+    assert [v.rule for v in vs] == ["GL07", "GL07"]
+    assert "dynamically" in vs[0].message
+
+
+def test_gl07_quiet_on_scheme_conforming_literals():
+    vs = run("""
+        from garage_tpu.utils.metrics import registry
+        def f(n):
+            registry().inc("qos_shed_requests", scope="global")
+            registry().observe("rpc_request_duration_seconds", n)
+            with registry().timer("s3_get_seconds"):
+                pass
+    """)
+    assert vs == []
+
+
+def test_gl07_runtime_agrees_with_static_rule(monkeypatch):
+    # the satellite fix: utils/metrics.py rejects off-scheme names at
+    # registration time in debug mode — same regex as the static rule
+    import garage_tpu.utils.metrics as m
+    monkeypatch.setattr(m, "STRICT_METRIC_NAMES", True)
+    reg = m.MetricsRegistry()
+    reg.inc("qos_ok_total")
+    with pytest.raises(ValueError, match="naming scheme"):
+        reg.inc("qos_Bad-Name")
+    with pytest.raises(ValueError, match="naming scheme"):
+        reg.inc("frontend_requests")
+    monkeypatch.setattr(m, "STRICT_METRIC_NAMES", False)
+    reg2 = m.MetricsRegistry()
+    reg2.inc("frontend_requests")  # production: never raises
+
+
+# ---- GL08 config-knob-drift --------------------------------------------
+
+def _mini_tree(tmp_path, config_body, app_body):
+    pkg = tmp_path / "garage_tpu"
+    (pkg / "utils").mkdir(parents=True)
+    (pkg / "utils" / "config.py").write_text(textwrap.dedent(config_body))
+    (pkg / "app.py").write_text(textwrap.dedent(app_body))
+    return str(pkg)
+
+
+def test_gl08_fires_on_unknown_key_and_dead_knob(tmp_path):
+    pkg = _mini_tree(tmp_path, """
+        from dataclasses import dataclass
+        @dataclass
+        class Config:
+            block_size: int = 5
+            dead_knob: int = 1
+    """, """
+        def f(cfg):
+            return cfg.block_sizze + cfg.block_size
+    """)
+    vs, _ = analyze_paths([pkg], default_rules(), root=str(tmp_path))
+    got = {(v.rule, v.message.split("`")[1]) for v in vs if v.active}
+    assert ("GL08", "block_sizze") in got       # read, not a field
+    assert ("GL08", "dead_knob") in got         # field, never read
+
+
+def test_gl08_readme_mention_and_section_alias_count_as_use(tmp_path):
+    pkg = _mini_tree(tmp_path, """
+        from dataclasses import dataclass, field
+        @dataclass
+        class QosConfig:
+            global_rps: float = 1.0
+        @dataclass
+        class Config:
+            block_size: int = 5
+            documented_knob: int = 1
+            qos: QosConfig = field(default_factory=QosConfig)
+    """, """
+        def f(cfg):
+            qc = cfg.qos
+            return cfg.block_size + qc.global_rps
+    """)
+    vs, _ = analyze_paths([pkg], default_rules(), root=str(tmp_path),
+                          data={"readme_text": "set `documented_knob`"})
+    assert [v for v in vs if v.active] == []
+
+
+# ---- waivers ------------------------------------------------------------
+
+def test_waiver_suppresses_with_reason():
+    vs = analyze_source(textwrap.dedent("""
+        def f():
+            try:
+                g()
+            except Exception:
+                pass  # lint: ignore[GL05] g is best-effort telemetry
+    """), default_rules(), rel_path="garage_tpu/fake.py").violations
+    assert [v.rule for v in vs] == ["GL05"]
+    assert vs[0].waived and not vs[0].active
+
+
+def test_waiver_without_reason_is_an_error():
+    vs = run("""
+        def f():
+            try:
+                g()
+            except Exception:
+                pass  # lint: ignore[GL05]
+    """)
+    # the GL05 stays active AND the reasonless waiver is a GL00
+    assert rules_of(vs) == [META_RULE, "GL05"]
+
+
+def test_stale_waiver_is_an_error():
+    vs = run("""
+        def f():  # lint: ignore[GL05] nothing here actually fires
+            return 1
+    """)
+    assert rules_of(vs) == [META_RULE]
+    assert "stale waiver" in vs[0].message
+
+
+def test_waiver_in_docstring_is_prose_not_suppression():
+    vs = run('''
+        def f():
+            """Example: x()  # lint: ignore[GL05] reason."""
+            return 1
+    ''')
+    assert vs == []  # no stale-waiver error from the docstring
+
+
+# ---- baseline -----------------------------------------------------------
+
+FIRING = """
+    def f():
+        try:
+            g()
+        except Exception:
+            pass
+"""
+
+
+def test_baseline_round_trip(tmp_path):
+    bl = str(tmp_path / "baseline.json")
+    first = analyze_source(textwrap.dedent(FIRING), default_rules(),
+                           rel_path="garage_tpu/fake.py").violations
+    assert save_baseline(bl, first) == 1
+    second = analyze_source(textwrap.dedent(FIRING), default_rules(),
+                            rel_path="garage_tpu/fake.py").violations
+    stale = apply_baseline(second, load_baseline(bl))
+    assert stale == []
+    assert all(v.baselined for v in second if v.rule == "GL05")
+    assert [v for v in second if v.active] == []
+
+
+def test_stale_baseline_entry_is_an_error(tmp_path):
+    bl = str(tmp_path / "baseline.json")
+    first = analyze_source(textwrap.dedent(FIRING), default_rules(),
+                           rel_path="garage_tpu/fake.py").violations
+    save_baseline(bl, first)
+    clean = analyze_source("def f():\n    return 1\n", default_rules(),
+                           rel_path="garage_tpu/fake.py").violations
+    stale = apply_baseline(clean, load_baseline(bl))
+    assert len(stale) == 1 and stale[0].rule == META_RULE
+    assert "stale baseline" in stale[0].message
+
+
+def test_missing_baseline_file_is_empty(tmp_path):
+    assert load_baseline(str(tmp_path / "nope.json")) == []
+
+
+# ---- GL00 framework ------------------------------------------------------
+
+def test_unparseable_source_is_gl00():
+    vs = run("def broken(:\n")
+    assert rules_of(vs) == [META_RULE]
+
+
+# ---- tier-1 enforcement hook --------------------------------------------
+
+def _tree_violations():
+    rules = default_rules()
+    data = {}
+    readme = os.path.join(REPO, "README.md")
+    if os.path.exists(readme):
+        with open(readme, encoding="utf-8") as f:
+            data["readme_text"] = f.read()
+    violations, project = analyze_paths(
+        [os.path.join(REPO, "garage_tpu")], rules, root=REPO, data=data)
+    violations += apply_baseline(
+        violations, load_baseline(os.path.join(REPO, DEFAULT_BASELINE)))
+    return violations, project
+
+
+def test_tree_has_zero_non_baselined_violations():
+    """THE enforcement hook: any new violation in garage_tpu/ fails
+    tier-1 until fixed, waived with a reason, or (exceptionally)
+    baselined."""
+    violations, project = _tree_violations()
+    active = [v for v in violations if v.active]
+    assert len(project.files) > 100  # the scan actually saw the tree
+    assert active == [], "\n" + "\n".join(v.render() for v in active)
+
+
+def test_cli_runs_clean_json(capsys):
+    from garage_tpu.analysis.__main__ import main
+    rc = main(["--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["violations"] == []
+    assert out["files"] > 100
+
+
+def test_every_rule_has_an_id_and_fixture_coverage():
+    ids = {r.id for r in default_rules()}
+    assert ids == {f"GL0{i}" for i in range(1, 9)}
