@@ -969,6 +969,119 @@ def bench_degraded(nhashes: int = 24, block_kib: int = 256) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_resize(n_nodes: int = 16, nobj: int = 48, obj_kib: int = 256,
+                 leg_s: float = 5.0) -> dict:
+    """Zero-downtime cluster resize economics (ISSUE 6): foreground
+    PUT/GET p50/p99 while a layout transition (add-node, then
+    drain-node) rebalances data across a 16-node cluster-in-a-box,
+    vs the same workload with no resize — with the qos governor and
+    breaker-aware resync placement active, rebalance must yield to
+    foreground tails. Also reports the rebalance throughput itself
+    (resync bytes moved / transition wall time)."""
+    import pathlib
+    import shutil
+    import sys
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    for p in (here, os.path.join(here, "tests")):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    from clusterbox import ClusterBox, Workload
+    from test_model import put_object_like_api
+
+    from garage_tpu.utils.data import gen_uuid
+
+    tmp = tempfile.mkdtemp(
+        prefix="gt_resize_",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
+
+    async def scenario() -> dict:
+        # gossip cadence scaled for a 16-node single-core sim: the
+        # test default (status every 0.1 s) is thousands of status
+        # RPCs/s at this fan-out and would drown the workload in
+        # control-plane noise
+        box = await ClusterBox(pathlib.Path(tmp), n=n_nodes, rf=3,
+                               governor=True, status_interval=0.5,
+                               ping_interval=2.0).start()
+        try:
+            # seed data so the rebalance has bytes to move
+            g0 = box.nodes[0].garage
+            bucket = gen_uuid()
+            rng = np.random.default_rng(31)
+            sem = asyncio.Semaphore(8)
+
+            async def seed(i):
+                data = rng.integers(0, 256, obj_kib << 10,
+                                    dtype=np.uint8).tobytes()
+                async with sem:
+                    await put_object_like_api(g0, bucket, f"s{i}", data)
+
+            await asyncio.gather(*(seed(i) for i in range(nobj)))
+            await asyncio.sleep(4.0)  # let seeding's table queues drain
+
+            # baseline leg: steady-state foreground, no resize
+            wb = Workload(box, obj_kib=obj_kib, period=0.02)
+            wb.start()
+            await asyncio.sleep(leg_s)
+            base = await wb.stop()
+
+            # resize leg: the same workload while an add-node and then
+            # a drain-node transition rebalance the cluster
+            moved0 = sum(nd.manager.metrics["resync_bytes"]
+                         for nd in box.live())
+            wr = Workload(box, obj_kib=obj_kib, period=0.02)
+            wr.start()
+            t0 = time.monotonic()
+            newbie = await box.add_node()
+            orch = box.orchestrator()
+            orch.stage_add(newbie.id, "z1", 1 << 30)
+            rep_add = await orch.run(timeout=240.0)
+            orch.stage_remove(box.nodes[1].id)
+            rep_drain = await orch.run(timeout=240.0)
+            try:
+                await box.wait(lambda: box.resync_backlog() == 0, 90,
+                               "rebalance backlog")
+            except AssertionError:
+                pass  # report what moved either way
+            dt = time.monotonic() - t0
+            res = await wr.stop()
+            moved = sum(nd.manager.metrics["resync_bytes"]
+                        for nd in box.live()) - moved0
+            out = {
+                "resize_nodes": n_nodes,
+                "resize_add_transition_s": round(
+                    rep_add.total_seconds, 2),
+                "resize_drain_transition_s": round(
+                    rep_drain.total_seconds, 2),
+                "resize_rebalance_mb": round(moved / 1e6, 1),
+                "resize_rebalance_mbps": round(
+                    moved / max(dt, 1e-9) / 1e6, 2),
+                "resize_ops_failed": len(res["failures"]),
+                "resize_backlog_left": box.resync_backlog(),
+                "resize_get_p50_ms": res["get_p50_ms"],
+                "resize_get_p99_ms": res["get_p99_ms"],
+                "resize_put_p50_ms": res["put_p50_ms"],
+                "resize_put_p99_ms": res["put_p99_ms"],
+                "resize_base_get_p99_ms": base["get_p99_ms"],
+                "resize_base_put_p99_ms": base["put_p99_ms"],
+            }
+            if base["get_p99_ms"] and res["get_p99_ms"]:
+                out["resize_get_p99_vs_baseline"] = round(
+                    res["get_p99_ms"] / base["get_p99_ms"], 2)
+            if base["put_p99_ms"] and res["put_p99_ms"]:
+                out["resize_put_p99_vs_baseline"] = round(
+                    res["put_p99_ms"] / base["put_p99_ms"], 2)
+            return out
+        finally:
+            await box.stop()
+
+    try:
+        return asyncio.run(asyncio.wait_for(scenario(), 600))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_native_blake3() -> float:
     """The native host BLAKE3 kernel (b3gf.c, AVX2 8-way) — what the
     product actually hashes with on the host path."""
@@ -1201,6 +1314,14 @@ def main() -> None:
         extra.update(bench_degraded())
     except Exception as e:
         extra["degraded_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # zero-downtime resize: rebalance throughput vs foreground p99
+    # during an add-node + drain-node transition on a 16-node
+    # cluster-in-a-box (ISSUE 6)
+    try:
+        extra.update(bench_resize())
+    except Exception as e:
+        extra["resize_error"] = f"{type(e).__name__}: {e}"[:300]
     if platform == "cpu":
         maybe_reexec_on_device()
 

@@ -587,6 +587,20 @@ class AdminHttpServer:
               "Number of blocks in the resync queue")
         gauge("block_resync_errored_blocks",
               g.block_manager.resync.errors_len())
+        # the resize plane watches the same queue under its own name:
+        # during a layout transition this IS the rebalance backlog,
+        # and "backlog drained to zero" is the smoke/soak assertion
+        gauge("resync_backlog", g.block_manager.resync.queue_len(),
+              "Rebalance/resync backlog (blocks awaiting "
+              "re-examination)")
+        gauge("resize_layout_min_stored",
+              g.system.layout_manager.history.min_stored(),
+              "Oldest live layout version (== current once a resize "
+              "fully commits)")
+        gauge("resize_layout_ack_min",
+              g.system.layout_manager.helper.ack_map_min())
+        gauge("resize_layout_sync_min",
+              g.system.layout_manager.helper.sync_map_min())
         # hot-block read cache (block/cache.py): cache_hits/misses/
         # evictions/bytes + admission counters
         out.append("# TYPE cache_hits counter")

@@ -114,7 +114,9 @@ class AdminRpcHandler:
 
     async def op_layout_apply(self, p):
         lm = self.garage.system.layout_manager
-        lm.apply_staged(p.get("version"))
+        # off-loop compute: an expensive assignment must not freeze a
+        # node that is serving traffic mid-resize
+        await lm.apply_staged_async(p.get("version"))
         return {"version": lm.history.current().version}
 
     async def op_layout_revert(self, p):

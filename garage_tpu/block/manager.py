@@ -29,10 +29,9 @@ from typing import Optional
 from ..chaos import injector as _chaos
 from ..net.message import PRIO_BACKGROUND, PRIO_NORMAL
 from ..rpc.rpc_helper import (
-    MAX_HEDGES_PER_CALL,
+    HedgedRace,
     RequestStrategy,
     RpcHelper,
-    _consume_task_result,
 )
 from ..utils.data import blake2sum
 from ..utils.metrics import registry
@@ -177,7 +176,8 @@ class BlockManager:
                  device_mode: str = "auto",
                  device_batch_blocks: int = 256,
                  ram_buffer_max: int = 256 * 1024 * 1024,
-                 read_cache_max_bytes: Optional[int] = None):
+                 read_cache_max_bytes: Optional[int] = None,
+                 resync_breaker_aware: bool = True):
         self.system = system
         self.db = db
         self.data_layout = data_layout
@@ -225,9 +225,24 @@ class BlockManager:
         )
         from .resync import BlockResyncManager
 
-        self.resync = BlockResyncManager(self, db)
+        self.resync = BlockResyncManager(
+            self, db, breaker_aware=resync_breaker_aware)
         self.metrics = {"bytes_read": 0, "bytes_written": 0,
-                        "corruptions": 0, "resync_sent": 0, "resync_recv": 0}
+                        "corruptions": 0, "resync_sent": 0,
+                        "resync_recv": 0, "resync_bytes": 0}
+        # layout-transition participation (ISSUE 6): a new layout
+        # version means every block held or needed here must be
+        # re-examined (fetch what moved in, offload what moved away),
+        # and once that backlog drains the block layer reports its
+        # sync position so old layout versions can be GC'd. The block
+        # layer registers as a sync SOURCE next to the table syncers —
+        # the node's sync tracker advances at the minimum across
+        # layers.
+        lm = getattr(system, "layout_manager", None)
+        if lm is not None:
+            lm.register_sync_source("blocks")
+            self.resync.bootstrap_layout_marker()
+            lm.on_change.append(self.resync.note_layout_change)
 
     @property
     def erasure(self) -> bool:
@@ -377,10 +392,17 @@ class BlockManager:
                 await self._write_shard_sets(hash32, payloads, sets)
 
     async def _write_shard_sets(self, hash32, payloads, sets) -> None:
+        # hedge=True (ROADMAP carry-over): a shard holder that sits in
+        # the quorum-critical set and goes quiet used to hold the whole
+        # PUT to its timeout — exactly the tail a draining node grows
+        # during a resize. Shard puts are keyed by content hash + shard
+        # index, so a re-issued backup push landing twice writes the
+        # same bytes to the same path: idempotent, first ack wins.
         await self.rpc.try_write_many_sets(
             self.endpoint, sets, None,
             RequestStrategy(quorum=self.codec.write_quorum,
-                            prio=PRIO_NORMAL, timeout=60.0),
+                            prio=PRIO_NORMAL, timeout=60.0,
+                            hedge=True),  # lint: ignore[GL02] shard puts are content-addressed and idempotent; a duplicate backup push re-writes identical bytes
             make_call=lambda key: self.endpoint.call(
                 key[0],
                 {"op": "put", "hash": hash32, "part": key[1],
@@ -459,72 +481,48 @@ class BlockManager:
                 # injected/real local EIO: degrade to the remote holders
                 errs.append(e)
         remote = self.rpc.request_order([n for n in nodes if n != me])
-        health = self.rpc.health()
-        hedging = health is not None and health.hedging_enabled
-        pending: dict[asyncio.Task, tuple[bytes, bool]] = {}
+        race = HedgedRace(self.rpc.health(), "block_get")
         i = 0
-        hedges = 0
 
         def launch(hedged: bool = False):
             nonlocal i
             node = remote[i]
             i += 1
-            t = asyncio.create_task(self.rpc.call(
+            race.launch(node, self.rpc.call(
                 self.endpoint, node,
                 {"op": "get", "hash": hash32, "part": None},
                 PRIO_NORMAL, timeout=60.0,
-            ))
-            pending[t] = (node, hedged)
+            ), hedged)
 
         if remote:
             launch()
         try:
-            while pending:
-                can_hedge = hedging and i < len(remote) \
-                    and hedges < MAX_HEDGES_PER_CALL
-                done, _ = await asyncio.wait(
-                    pending.keys(), return_when=asyncio.FIRST_COMPLETED,
-                    timeout=(health.hedge_delay(
-                        n for n, _ in pending.values())
-                        if can_hedge else None),
-                )
-                if not done:
-                    if health.try_take_hedge():
-                        hedges += 1
-                        registry().inc("rpc_hedge_launched",
-                                       endpoint="block_get")
-                        launch(hedged=True)
-                    else:
-                        hedging = False  # rate cap hit: plain waits
-                    continue
+            while race.pending:
+                done = await race.wait(
+                    can_hedge=i < len(remote),
+                    launch_hedge=lambda: launch(hedged=True))
                 # drain EVERY completed task before returning: a loser
                 # that failed in the same wait round must have its
                 # exception retrieved, or asyncio logs an orphan
                 won = None
-                for t in done:
-                    _node, was_hedged = pending.pop(t)
+                for _node, was_hedged, t in done:
                     try:
                         resp = t.result()
                         if won is None and resp.get("data") is not None:
                             won = resp["data"]
-                            if was_hedged:
-                                health.record_hedge_win()
-                                registry().inc("rpc_hedge_win",
-                                               endpoint="block_get")
+                            race.note_success(was_hedged)
                     except Exception as e:
                         errs.append(e)
                 if won is not None:
                     return won, False
                 # every holder in this round failed or had no copy:
                 # move down the list
-                if i < len(remote):
+                if done and i < len(remote):
                     launch()
         finally:
-            for t in pending:
-                # a task that finished between the wait and this
-                # cleanup still needs its exception consumed
-                t.add_done_callback(_consume_task_result)
-                t.cancel()
+            # a task that finished between the wait and this cleanup
+            # still needs its exception consumed
+            race.cancel_pending()
         raise MissingBlock(hash32)
 
     async def _get_erasure(self, hash32: bytes) -> bytes:
@@ -614,66 +612,47 @@ class BlockManager:
                           idx, node[:4].hex(), e)
                 return None
 
-        health = self.rpc.health()
-        hedging = health is not None and health.hedging_enabled
+        race = HedgedRace(self.rpc.health(), "block_get_shard")
         parts: dict[int, bytes] = {}
         lens_by_idx: dict[int, int] = {}
         order = list(enumerate(placement))  # systematic first by design
         i = 0
-        hedges = 0
-        pending: dict[asyncio.Task, tuple[int, bool]] = {}
+
+        def launch_next(hedged: bool = False):
+            nonlocal i
+            idx, node = order[i]
+            i += 1
+            race.launch(idx, fetch(node, idx), hedged)
+
         try:
-            while len(parts) < need and (pending or i < len(order)):
-                while i < len(order) and len(pending) < need - len(parts):
-                    idx, node = order[i]
-                    pending[asyncio.create_task(fetch(node, idx))] = \
-                        (idx, False)
-                    i += 1
-                if not pending:
+            while len(parts) < need and (race.pending or i < len(order)):
+                while i < len(order) \
+                        and len(race.pending) < need - len(parts):
+                    launch_next()
+                if not race.pending:
                     break
-                can_hedge = hedging and i < len(order) \
-                    and hedges < MAX_HEDGES_PER_CALL
-                done, _ = await asyncio.wait(
-                    pending.keys(),
-                    return_when=asyncio.FIRST_COMPLETED,
-                    timeout=(health.hedge_delay(
-                        placement[idx] for idx, _ in pending.values())
-                        if can_hedge else None),
-                )
-                if not done:
-                    # every in-flight shard fetch is past its holder's
-                    # observed p95: hedge the next candidate shard
-                    # instead of waiting out a hung holder (exceeds
-                    # the need-len(parts) concurrency cap by design)
-                    if health.try_take_hedge():
-                        hedges += 1
-                        registry().inc("rpc_hedge_launched",
-                                       endpoint="block_get_shard")
-                        idx, node = order[i]
-                        pending[asyncio.create_task(fetch(node, idx))] \
-                            = (idx, True)
-                        i += 1
-                    else:
-                        hedging = False
-                    continue
-                for t in done:
-                    idx, was_hedged = pending.pop(t)
+                # when every in-flight shard fetch is past its holder's
+                # observed p95, the hedge launches the next candidate
+                # shard instead of waiting out a hung holder (exceeds
+                # the need-len(parts) concurrency cap by design)
+                done = await race.wait(
+                    can_hedge=i < len(order),
+                    launch_hedge=lambda: launch_next(hedged=True),
+                    hedge_nodes=[placement[idx]
+                                 for idx, _ in race.pending.values()])
+                for idx, was_hedged, t in done:
                     r = t.result()
                     if r is not None:
                         parts[idx] = r[0]
                         lens_by_idx[idx] = r[1]
-                        if was_hedged:
-                            health.record_hedge_win()
-                            registry().inc("rpc_hedge_win",
-                                           endpoint="block_get_shard")
+                        race.note_success(was_hedged)
         finally:
             # cancel stragglers (hedges included) on every exit path —
             # a client disconnect cancels this coroutine at the wait
             # above, and the in-flight MiB-scale fetches must not keep
             # running for nobody; fetch() swallows its own errors so
             # nothing logs
-            for t in pending:
-                t.cancel()
+            race.cancel_pending()
         if len(parts) < need:
             return None
         lens = list(lens_by_idx.values())

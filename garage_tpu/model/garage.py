@@ -135,6 +135,7 @@ class Garage:
             device_batch_blocks=config.tpu.batch_blocks,
             ram_buffer_max=config.block_ram_buffer_max,
             read_cache_max_bytes=config.block_read_cache_max_bytes,
+            resync_breaker_aware=config.block_resync_breaker_aware,
         )
 
         # ---- tables (ref: garage.rs:178-248) ---------------------------
@@ -210,6 +211,7 @@ class Garage:
             hedging=config.rpc_hedging,
             hedge_rate=config.rpc_hedge_rate,
             adaptive_timeout=config.rpc_adaptive_timeout,
+            write_hedging=config.rpc_hedge_writes,
         )
 
         # ---- fault injection ([chaos] section) -------------------------
@@ -283,6 +285,7 @@ class Garage:
                              qc.scrub_tranquility_max),
                 resync_range=(qc.resync_tranquility_min,
                               qc.resync_tranquility_max),
+                resync_backlog_ref=qc.resync_backlog_ref,
             )
             self.runner.spawn_worker(self.qos_governor)
             gov = self.qos_governor

@@ -100,6 +100,11 @@ class PeerHealthTracker:
     def __init__(self):
         self.peers: dict[bytes, PeerHealth] = {}
         self.hedging_enabled = True
+        # backup pushes for IDEMPOTENT writes (erasure shard puts are
+        # content-addressed, so a duplicate landing is a no-op); the
+        # `[rpc] hedge_writes` knob — writes additionally need an
+        # explicit per-call hedge=True opt-in, audited by GL02
+        self.write_hedging_enabled = True
         self.adaptive_timeout_enabled = True
         self.hedge_rate = 8.0  # sustained hedges/s across all calls
         self._hedge_tokens = HEDGE_BUCKET_CAP
@@ -111,13 +116,16 @@ class PeerHealthTracker:
 
     def configure(self, hedging: Optional[bool] = None,
                   hedge_rate: Optional[float] = None,
-                  adaptive_timeout: Optional[bool] = None) -> None:
+                  adaptive_timeout: Optional[bool] = None,
+                  write_hedging: Optional[bool] = None) -> None:
         if hedging is not None:
             self.hedging_enabled = bool(hedging)
         if hedge_rate is not None:
             self.hedge_rate = max(0.0, float(hedge_rate))
         if adaptive_timeout is not None:
             self.adaptive_timeout_enabled = bool(adaptive_timeout)
+        if write_hedging is not None:
+            self.write_hedging_enabled = bool(write_hedging)
 
     def reset(self) -> None:
         """Drop all observations (bench A/B legs must not inherit the
@@ -281,6 +289,7 @@ class PeerHealthTracker:
             "breaker_opens": self.breaker_opens,
             "breaker_closes": self.breaker_closes,
             "hedging_enabled": self.hedging_enabled,
+            "write_hedging_enabled": self.write_hedging_enabled,
             "adaptive_timeout_enabled": self.adaptive_timeout_enabled,
         }
 

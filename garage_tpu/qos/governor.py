@@ -63,13 +63,28 @@ class GovernorWorker(Worker):
     # show the overload), capped at one MAX_STEP per interval
     QUEUE_GAIN = 0.1
     QUEUE_REF_DEPTH = 5  # depth at which the queue signal saturates
+    # pressure push from the resync/rebalance backlog while foreground
+    # traffic is active: a deep backlog means rebalance pushes/fetches
+    # are competing with users for the same links and disks, so
+    # background work yields BEFORE the latency EWMA shows the damage.
+    # When the cluster is foreground-idle the idle decay wins instead
+    # and the rebalance sprints.
+    RESYNC_GAIN = 0.3  # max push per interval, at backlog saturation
+    RESYNC_REF_BACKLOG = 256.0  # `[qos] resync_backlog_ref`
+    # pressure maps onto the table syncers' per-partition sleep too: a
+    # layout change triggers an anti-entropy round of every table on
+    # every node at once, and unthrottled rounds were the dominant
+    # foreground-p99 cost of a resize
+    TABLE_SYNC_TRANQ_MAX = 0.05  # s/partition at pressure 1.0
 
     def __init__(self, garage, interval: float = 2.0,
                  target_latency: float = 0.05,
                  scrub_range: tuple[float, float] = (1.0, 30.0),
                  resync_range: tuple[float, float] = (0.0, 2.0),
                  sample_fn: Optional[Callable[[], tuple[int, float]]] = None,
-                 queue_depth_fn: Optional[Callable[[], int]] = None):
+                 queue_depth_fn: Optional[Callable[[], int]] = None,
+                 resync_backlog_fn: Optional[Callable[[], int]] = None,
+                 resync_backlog_ref: Optional[float] = None):
         self.garage = garage
         self.interval = interval
         self.target_latency = target_latency
@@ -77,10 +92,14 @@ class GovernorWorker(Worker):
         self.resync_range = resync_range
         self.sample_fn = sample_fn or foreground_latency_totals
         self.queue_depth_fn = queue_depth_fn
+        self.resync_backlog_fn = resync_backlog_fn
+        self.resync_backlog_ref = float(resync_backlog_ref
+                                        or self.RESYNC_REF_BACKLOG)
         self.enabled = True
         self.pressure = 0.0
         self.ewma: Optional[float] = None
         self.last_queue_depth = 0
+        self.last_resync_backlog = 0
         self._last: Optional[tuple[int, float]] = None
         self.adjustments = 0
 
@@ -91,6 +110,20 @@ class GovernorWorker(Worker):
         bm = getattr(self.garage, "block_manager", None)
         sem = getattr(bm, "_ram_sem", None)
         return sem.queue_depth() if sem is not None else 0
+
+    def _resync_backlog(self) -> int:
+        """Blocks queued for resync and due NOW — during a cluster
+        resize this IS the rebalance backlog. Future-due entries
+        (error backoff, breaker deferrals) are excluded: they are not
+        competing with foreground traffic."""
+        if self.resync_backlog_fn is not None:
+            return self.resync_backlog_fn()
+        bm = getattr(self.garage, "block_manager", None)
+        resync = getattr(bm, "resync", None)
+        qlen = getattr(resync, "due_len", None) \
+            or getattr(resync, "queue_len", None)
+        # getattr-soft: governor tests run against stub resyncs
+        return qlen() if callable(qlen) else 0
 
     # ---- control step (synchronous, unit-testable) ---------------------
 
@@ -121,6 +154,15 @@ class GovernorWorker(Worker):
             move = min(self.MAX_STEP,
                        self.QUEUE_GAIN * min(depth, self.QUEUE_REF_DEPTH))
             self.pressure = min(1.0, self.pressure + move)
+        # resync-backlog signal (ISSUE 6): rebalance yields to
+        # foreground p99 while users are active; with no foreground
+        # traffic the idle decay above already lets it sprint
+        self.last_resync_backlog = backlog = self._resync_backlog()
+        if backlog > 0 and dc > 0:
+            move = min(self.MAX_STEP,
+                       self.RESYNC_GAIN
+                       * min(backlog / self.resync_backlog_ref, 1.0))
+            self.pressure = min(1.0, self.pressure + move)
         self._apply()
 
     def _apply(self) -> None:
@@ -143,6 +185,13 @@ class GovernorWorker(Worker):
             # each batch/pass boundary anyway, and a persister write per
             # governor tick would be pure write amplification
             sw.state.tranquility = lo + u * (hi - lo)
+        all_tables = getattr(self.garage, "all_tables", None)
+        if callable(all_tables):
+            tranq = u * self.TABLE_SYNC_TRANQ_MAX
+            for t in all_tables():
+                syncer = getattr(t, "syncer", None)
+                if syncer is not None:
+                    syncer.tranquility = tranq
         self.adjustments += 1
         registry().inc("qos_governor_pressure", self.pressure)
 
@@ -173,6 +222,7 @@ class GovernorWorker(Worker):
             "ewma_latency_s": (round(self.ewma, 6)
                                if self.ewma is not None else None),
             "queue_depth": self.last_queue_depth,
+            "resync_backlog": self.last_resync_backlog,
             "target_latency_s": self.target_latency,
             "scrub_range": list(self.scrub_range),
             "resync_range": list(self.resync_range),

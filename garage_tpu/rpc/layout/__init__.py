@@ -13,3 +13,4 @@ from .version import LayoutVersion, NodeRole, PARTITION_BITS, N_PARTITIONS  # no
 from .history import LayoutHistory, UpdateTrackers, LayoutStaging  # noqa: F401
 from .helper import LayoutHelper  # noqa: F401
 from .manager import LayoutManager  # noqa: F401
+from .transition import ResizeOrchestrator, ResizeReport, ResizeStuck  # noqa: F401

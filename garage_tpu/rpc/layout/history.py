@@ -179,30 +179,63 @@ class LayoutHistory(Migratable):
         """Current roles with staged changes applied on top."""
         return self.current().roles.merge(self.staging.roles)
 
-    def apply_staged_changes(self, version: Optional[int] = None) -> None:
+    def compute_staged_changes(self, version: Optional[int] = None,
+                               staging: Optional["LayoutStaging"] = None,
+                               ) -> LayoutVersion:
         """Compute the next LayoutVersion (max-flow assignment) from
-        current roles + staged changes. ref: history.rs:270."""
+        current roles + staged changes WITHOUT installing it — pure
+        CPU work, safe to run in a worker thread so an expensive
+        assignment never blocks the serving loop (ref: history.rs:270).
+        Off-loop callers MUST pass the `staging` snapshot they pinned:
+        reading the live self.staging from the worker thread would tear
+        against a concurrent stage call — and install_version's
+        `consumed` check only protects the clear, not the compute
+        input."""
+        if staging is None:
+            staging = self.staging
         next_version = self.current().version + 1
         if version is not None and version != next_version:
             raise ValueError(
                 f"expected version {next_version}, operator said {version} "
                 "(layout changed concurrently?)"
             )
-        roles = self.staged_roles()
-        zr = self.staging.parameters.value.get("zone_redundancy", "maximum")
+        roles = self.current().roles.merge(staging.roles)
+        zr = staging.parameters.value.get("zone_redundancy", "maximum")
         node_id_vec, ring, psize = compute_assignment(
             list(roles.items()), self.replication_factor, zr, prev=self.current()
         )
-        self.versions.append(
-            LayoutVersion(
-                next_version, self.replication_factor, zr, roles,
-                node_id_vec, ring, psize,
+        return LayoutVersion(
+            next_version, self.replication_factor, zr, roles,
+            node_id_vec, ring, psize,
+        )
+
+    def install_version(self, lv: LayoutVersion,
+                        consumed: Optional[LayoutStaging] = None) -> None:
+        """Append a computed LayoutVersion; refuses a stale compute
+        (layout changed while the assignment ran). Staging is cleared
+        only when it is still the `consumed` snapshot the compute read
+        — a role staged DURING an off-loop compute must survive into
+        the next apply, not be silently discarded."""
+        if lv.version != self.current().version + 1:
+            raise ValueError(
+                f"computed layout v{lv.version} is stale: current is "
+                f"v{self.current().version} (layout changed concurrently)")
+        self.versions.append(lv)
+        if consumed is None or consumed is self.staging:
+            self.staging = LayoutStaging(
+                crdt.Lww.new({"zone_redundancy": lv.zone_redundancy}),
+                crdt.LwwMap(),
             )
-        )
-        self.staging = LayoutStaging(
-            crdt.Lww.new({"zone_redundancy": zr}), crdt.LwwMap()
-        )
+        # else: staging changed mid-compute — keep it whole (already-
+        # applied entries make the next apply a cheap near-no-op; a
+        # lost staged role would be unrecoverable)
         self.cleanup_old_versions()
+
+    def apply_staged_changes(self, version: Optional[int] = None) -> None:
+        """Synchronous compute + install. ref: history.rs:270."""
+        staged = self.staging
+        self.install_version(self.compute_staged_changes(version),
+                             consumed=staged)
 
     def revert_staged_changes(self) -> None:
         # drop staged PARAMETERS too: reverting restores the current

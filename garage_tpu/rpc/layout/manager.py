@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Callable, Optional
 
 from ...utils.background import spawn
@@ -43,6 +44,17 @@ class LayoutManager:
         self.helper = LayoutHelper(history, netapp.id)
         self.ep = netapp.endpoint("garage_rpc/layout").set_handler(self._handle)
         self.on_change: list[Callable[[], None]] = []  # table syncers hook in
+        # layout-versioned data layers (each table's syncer, the block
+        # store) register here; the node's sync tracker advances to the
+        # MINIMUM across sources — see register_sync_source
+        self._sync_done: dict[str, int] = {}
+        # broadcast debounce: during a transition every tracker tick
+        # fires _changed, and an immediate full-history broadcast to
+        # every peer per tick is an O(N^2) gossip storm on big
+        # clusters — coalesce to at most one broadcast per interval
+        self._bcast_interval = 0.1
+        self._bcast_last = 0.0
+        self._bcast_scheduled = False
 
     @property
     def history(self) -> LayoutHistory:
@@ -63,7 +75,23 @@ class LayoutManager:
                 cb()
             except Exception:
                 log.exception("layout on_change callback failed")
-        spawn(self.broadcast(), "layout-broadcast")
+        spawn(self._broadcast_soon(), "layout-broadcast")
+
+    async def _broadcast_soon(self) -> None:
+        """Coalescing broadcast: back-to-back tracker changes ride one
+        gossip wave instead of one full-history fan-out each."""
+        if self._bcast_scheduled:
+            return  # an in-flight wave will carry this change too
+        self._bcast_scheduled = True
+        try:
+            wait = self._bcast_last + self._bcast_interval \
+                - time.monotonic()
+            if wait > 0:
+                await asyncio.sleep(wait)
+            self._bcast_last = time.monotonic()
+        finally:
+            self._bcast_scheduled = False
+        await self.broadcast()
 
     def merge_remote(self, raw: bytes) -> bool:
         remote = migrate_decode(LayoutHistory, raw)
@@ -80,13 +108,55 @@ class LayoutManager:
         self.helper.advance_ack()
         self._changed()
 
+    async def apply_staged_async(self, version: Optional[int] = None) -> None:
+        """apply_staged with the assignment computed in a worker
+        thread: the max-flow + movement-minimization step is pure-CPU
+        and can take SECONDS on an unlucky graph — a cluster resize
+        must never freeze the event loop that is serving traffic (the
+        whole point of a zero-downtime transition)."""
+        staged = self.history.staging
+        lv = await asyncio.to_thread(self.history.compute_staged_changes,
+                                     version, staged)
+        # install on the loop: a concurrent layout VERSION change while
+        # the compute ran is rejected by install_version, and staging
+        # mutated mid-compute is preserved (not cleared) for the next
+        # apply
+        self.history.install_version(lv, consumed=staged)
+        if len(self.history.staging.roles):
+            log.warning("layout roles were staged while the v%d "
+                        "assignment computed; they remain staged — "
+                        "run apply again to activate them", lv.version)
+        self.helper.advance_ack()
+        self._changed()
+
     def revert_staged(self) -> None:
         self.history.revert_staged_changes()
         self._changed()
 
+    def register_sync_source(self, name: str) -> None:
+        """A layer holding layout-versioned data (one per table syncer,
+        one for the block store) registers here. The node's gossiped
+        sync tracker then advances to the MINIMUM completed version
+        across all sources — before this, any single table finishing
+        its round advanced the tracker for the whole node, and the
+        cluster could GC a layout version whose other layers were
+        still migrating off it."""
+        self._sync_done.setdefault(name, 0)
+
+    def sync_until_from(self, name: str, version: int) -> None:
+        """Source `name` has all its data for layout `version` in
+        place locally; advance the node tracker as far as the slowest
+        registered source allows."""
+        if version > self._sync_done.get(name, 0):
+            self._sync_done[name] = version
+        self._report_sync(min(self._sync_done.values()))
+
     def sync_table_until(self, version: int) -> None:
-        """Called by syncers when all data for layout `version` is in
-        place locally (ref: manager.rs:120-133)."""
+        """Un-sourced report — single-layer deployments and tests that
+        drive the tracker directly (ref: manager.rs:120-133)."""
+        self._report_sync(version)
+
+    def _report_sync(self, version: int) -> None:
         if self.helper.sync_until(version):
             self.helper.advance_sync_ack()
             if self.history.cleanup_old_versions():

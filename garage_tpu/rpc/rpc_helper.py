@@ -9,8 +9,19 @@ engine:
   nodes), adding replacements as errors come in; or all at once.
 - `try_write_many_sets`: write to multiple quorum sets during layout
   transitions; succeeds when EVERY set reaches its write quorum;
-  remaining requests continue in the background.
+  remaining requests continue in the background. Idempotent writes may
+  opt into HEDGED backup pushes (strategy.hedge=True): a quorum-key
+  still unanswered past its holder's observed p95 gets the same call
+  re-issued, and the first landing wins — GL02 keeps every such
+  opt-in justified (content-addressed shard puts qualify, CRDT
+  inserts do not).
 - `QuorumSetResultTracker`: the bookkeeping shared by both.
+- `HedgedRace`: the shared hedged-wait loop. The hedge logic used to
+  exist in three near-copies (here, block `_get_replicate`, erasure
+  `_gather_parts`) and the shard-write hedge would have made four;
+  the budget, rate-cap token draw, win accounting and loser cleanup
+  now live in this one class, and callers keep only their success
+  predicate and replacement policy.
 
 Beyond the reference, every call feeds the shared per-peer health
 tracker (net/peering.py PeerHealthTracker) and reads it back:
@@ -61,6 +72,100 @@ DEFAULT_TIMEOUT = 30.0
 MAX_HEDGES_PER_CALL = 2
 
 
+class HedgedRace:
+    """One hedged fan-out (Dean & Barroso hedging, shared engine).
+
+    Owns the pending-task map, the hedge-delay FIRST_COMPLETED wait,
+    the per-call hedge budget, the global rate-cap token draw, the
+    launch/win metrics and loser cleanup. Callers supply a launch
+    callback (what a hedge actually issues: next-ranked node for reads,
+    a re-issued call for idempotent writes) and decide success from the
+    completed tasks themselves.
+
+    Works with health=None (bare test stubs): hedging simply stays off
+    and wait() degrades to a plain FIRST_COMPLETED."""
+
+    def __init__(self, health, label: str, *,
+                 enabled: Optional[bool] = None,
+                 max_hedges: int = MAX_HEDGES_PER_CALL):
+        self.health = health
+        self.label = label
+        self.hedging = health is not None and bool(
+            enabled if enabled is not None else health.hedging_enabled)
+        self.max_hedges = max_hedges
+        self.hedges = 0
+        self.pending: dict[asyncio.Task, tuple[Any, bool]] = {}
+
+    def launch(self, key, coro, hedged: bool = False) -> asyncio.Task:
+        t = asyncio.create_task(coro)
+        self.pending[t] = (key, hedged)
+        return t
+
+    def take_hedge(self) -> bool:
+        """Draw one hedge: per-call budget, then the cluster-wide token
+        bucket. A refused token disables hedging for the rest of this
+        race (plain waits from here on) — exactly the old inline
+        behavior."""
+        if not self.hedging or self.hedges >= self.max_hedges:
+            return False
+        if not self.health.try_take_hedge():
+            self.hedging = False
+            return False
+        self.hedges += 1
+        registry().inc("rpc_hedge_launched", endpoint=self.label)
+        return True
+
+    async def wait(self, can_hedge: bool, launch_hedge=None,
+                   hedge_nodes=None) -> list:
+        """One FIRST_COMPLETED round over the pending tasks.
+
+        If nothing lands within the peers' observed-p95 hedge delay and
+        a hedge is allowed, launch_hedge() is invoked (after the token
+        draw) and [] is returned for this round. Otherwise the
+        completed tasks are popped and returned as (key, hedged, task)
+        triples — the caller inspects results and reports wins via
+        note_success()."""
+        can = (self.hedging and can_hedge and launch_hedge is not None
+               and self.hedges < self.max_hedges)
+        if can:
+            nodes = (hedge_nodes if hedge_nodes is not None
+                     else [k for k, _ in self.pending.values()])
+            timeout = self.health.hedge_delay(nodes)
+        else:
+            timeout = None
+        done, _ = await asyncio.wait(
+            self.pending.keys(), return_when=asyncio.FIRST_COMPLETED,
+            timeout=timeout,
+        )
+        if not done:
+            # hedge-delay elapsed with everything still in flight:
+            # back up (if the global rate cap still has budget)
+            if self.take_hedge():
+                launch_hedge()
+            return []
+        out = []
+        for t in done:
+            key, hedged = self.pending.pop(t)
+            out.append((key, hedged, t))
+        return out
+
+    def note_success(self, hedged: bool) -> None:
+        if hedged and self.health is not None:
+            self.health.record_hedge_win()
+            registry().inc("rpc_hedge_win", endpoint=self.label)
+
+    def cancel_pending(self, cancel: bool = True) -> None:
+        """Consume-then-cancel every straggler (or just consume when
+        the caller wants writes to converge in the background)."""
+        for t in self.pending:
+            # consume first: a task that completed with an error
+            # between the last wait and this cleanup is immune to
+            # cancel and would log "never retrieved"
+            t.add_done_callback(_consume_task_result)
+            if cancel:
+                t.cancel()
+
+
 def named_rpc_error(e: Exception, node: bytes, endpoint_path: str) -> RpcError:
     """Wrap a transport/handler error so the surfaced message names the
     peer and endpoint. The original exception rides along as __cause__
@@ -108,9 +213,15 @@ class QuorumSetResultTracker:
 
     def success(self, node: bytes, resp) -> None:
         self.successes[node] = resp
+        # a hedged retry can land after its sibling attempt failed; the
+        # key IS written, so the stale failure must not keep counting
+        # against the set (a key in both maps inflates the failure
+        # count and can raise a spurious QuorumError)
+        self.failures.pop(node, None)
 
     def failure(self, node: bytes, err: Exception) -> None:
-        self.failures[node] = err
+        if node not in self.successes:
+            self.failures[node] = err
 
     def set_counts(self) -> list[tuple[int, int]]:
         """(successes, failures) per set."""
@@ -250,81 +361,51 @@ class RpcHelper:
         if quorum > len(nodes):
             raise QuorumError(quorum, 1, 0, len(nodes), ["not enough nodes"])
         order = self.request_order(list(nodes))
-        health = self.health()
-        hedging = (strategy.hedge if strategy.hedge is not None
-                   else (health is not None and health.hedging_enabled)) \
-            and not strategy.send_all_at_once and health is not None
+        race = HedgedRace(
+            self.health(), endpoint.path,
+            enabled=(False if strategy.send_all_at_once
+                     else strategy.hedge))
         successes: list = []
         errors: list[Exception] = []
-        pending: dict[asyncio.Task, tuple[bytes, bool]] = {}
         next_i = 0
-        hedges = 0
 
         def launch_one(hedged: bool = False):
             nonlocal next_i
             node = order[next_i]
             next_i += 1
             pl = make_payload(node) if make_payload else payload
-            t = asyncio.create_task(
-                self._tracked_call(endpoint, node, pl, strategy.prio,
-                                   strategy.timeout)
-            )
-            pending[t] = (node, hedged)
+            race.launch(node, self._tracked_call(
+                endpoint, node, pl, strategy.prio, strategy.timeout),
+                hedged)
 
         n_initial = len(order) if strategy.send_all_at_once else min(quorum, len(order))
         for _ in range(n_initial):
             launch_one()
         try:
             while len(successes) < quorum:
-                if not pending:
+                if not race.pending:
                     raise QuorumError(
                         quorum, 1, len(successes), len(nodes), [str(e) for e in errors]
                     )
-                can_hedge = (hedging and next_i < len(order)
-                             and hedges < MAX_HEDGES_PER_CALL)
-                done, _ = await asyncio.wait(
-                    pending.keys(), return_when=asyncio.FIRST_COMPLETED,
-                    timeout=(health.hedge_delay(n for n, _ in pending.values())
-                             if can_hedge else None),
-                )
-                if not done:
-                    # hedge-delay elapsed with everything still in
-                    # flight: back up on the next-ranked node (if the
-                    # global rate cap still has budget)
-                    if health.try_take_hedge():
-                        hedges += 1
-                        registry().inc("rpc_hedge_launched",
-                                       endpoint=endpoint.path)
-                        launch_one(hedged=True)
-                    else:
-                        hedging = False  # budget empty: plain waits
-                    continue
-                for t in done:
-                    node, hedged = pending.pop(t)
+                done = await race.wait(
+                    can_hedge=next_i < len(order),
+                    launch_hedge=lambda: launch_one(hedged=True))
+                for node, hedged, t in done:
                     try:
                         resp, _stream = t.result()
                         successes.append((node, resp))
-                        if hedged:
-                            health.record_hedge_win()
-                            registry().inc("rpc_hedge_win",
-                                           endpoint=endpoint.path)
+                        race.note_success(hedged)
                     except Exception as e:
                         errors.append(e)
                         if next_i < len(order):
                             launch_one()
             return [r for _, r in successes]
         finally:
-            for t in pending:
-                if strategy.interrupt_stragglers:
-                    # consume first: a task that completed with an
-                    # error between the last wait and this cleanup is
-                    # immune to cancel and would log "never retrieved"
-                    t.add_done_callback(_consume_task_result)
-                    t.cancel()
-                else:
-                    # left running so replicas converge; swallow the result
-                    # so a late failure doesn't log "never retrieved"
-                    t.add_done_callback(_consume_task_result)
+            # interrupt_stragglers: reads cancel the losers; writes are
+            # left running so replicas converge — either way the result
+            # is consumed so a late failure doesn't log "never
+            # retrieved"
+            race.cancel_pending(cancel=strategy.interrupt_stragglers)
 
     # ---- try_write_many_sets (ref: rpc_helper.rs:413-538) --------------
 
@@ -344,7 +425,15 @@ class RpcHelper:
 
         Set entries are opaque quorum keys — normally node ids, but e.g.
         the erasure block path uses (node, shard_index) tuples with a
-        `make_call` that issues the per-key RPC itself."""
+        `make_call` that issues the per-key RPC itself.
+
+        strategy.hedge=True opts the write into BACKUP PUSHES: a quorum
+        key still unanswered past its holder's observed p95 gets the
+        same call re-issued, first landing wins. Only idempotent writes
+        may opt in (content-addressed shard/block puts); GL02 flags
+        every hedge=True site so the justification is reviewable, and
+        the `[rpc] hedge_writes` knob can disable the behavior
+        cluster-wide."""
         tracker = QuorumSetResultTracker(write_sets, strategy.quorum)
         if not tracker.nodes:
             # empty/unassigned layout: fail fast instead of hanging on a
@@ -358,7 +447,7 @@ class RpcHelper:
             # the erasure path
             return key[0] if isinstance(key, tuple) else key
 
-        async def one(key):
+        async def one(key, hedged: bool = False):
             t0 = time.monotonic()
             try:
                 if make_call is not None:
@@ -373,6 +462,8 @@ class RpcHelper:
                 if health is not None:
                     health.record_success(node_of(key),
                                           time.monotonic() - t0)
+                if hedged and key not in tracker.successes:
+                    race.note_success(True)
                 tracker.success(key, resp)
             except asyncio.CancelledError:
                 raise
@@ -383,14 +474,57 @@ class RpcHelper:
                 if not isinstance(e, RpcError) \
                         or not hasattr(e, "node"):
                     e = named_rpc_error(e, node_of(key), endpoint.path)
-                tracker.failure(key, e)
+                # a hedged attempt is a bonus try: its failure must not
+                # count against the key while the original is still in
+                # flight (same invariant as read hedges — "losers are
+                # not counted as failures"), or a fast-failing backup
+                # raises a spurious QuorumError on a write the original
+                # lands moments later
+                if not hedged:
+                    tracker.failure(key, e)
             if not result.done():
                 if tracker.all_quorums_ok():
                     result.set_result(True)
                 elif tracker.too_many_failures():
                     result.set_exception(tracker.quorum_error())
 
+        # writes default to UNHEDGED (hedge=None stays off): only an
+        # explicit, GL02-audited hedge=True — and the cluster knob —
+        # arm the backup pushes
+        race = HedgedRace(
+            health, endpoint.path,
+            enabled=(strategy.hedge is True and health is not None
+                     and health.write_hedging_enabled))
+
+        async def hedge_backups():
+            """Re-issue the slowest still-pending write once it is past
+            its holder's observed p95 — the write-path analog of the
+            read hedge. The re-issued call races its sibling; the
+            tracker keeps whichever lands (idempotent by contract)."""
+            while not result.done() and race.hedging \
+                    and race.hedges < race.max_hedges:
+                waiting = [k for k in tracker.nodes
+                           if k not in tracker.successes
+                           and k not in tracker.failures]
+                if not waiting:
+                    return
+                await asyncio.sleep(
+                    health.hedge_delay(node_of(k) for k in waiting))
+                if result.done():
+                    return
+                still = [k for k in waiting
+                         if k not in tracker.successes
+                         and k not in tracker.failures]
+                if not still:
+                    continue
+                if not race.take_hedge():
+                    return
+                tasks.append(asyncio.create_task(one(still[0],
+                                                     hedged=True)))
+
         tasks = [asyncio.create_task(one(n)) for n in tracker.nodes]
+        hedge_task = (asyncio.create_task(hedge_backups())
+                      if race.hedging else None)
         try:
             await result
             return tracker
@@ -398,4 +532,8 @@ class RpcHelper:
             for t in tasks:
                 t.cancel()
             raise
+        finally:
+            if hedge_task is not None:
+                hedge_task.add_done_callback(_consume_task_result)
+                hedge_task.cancel()
         # on success, remaining tasks continue in background by design

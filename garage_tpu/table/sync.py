@@ -28,6 +28,7 @@ from .merkle import INTERMEDIATE, LEAF, MerkleNode
 log = logging.getLogger("garage_tpu.table.sync")
 
 ANTI_ENTROPY_INTERVAL = 600.0
+FAILED_ROUND_RETRY = 5.0  # a partial round blocks layout-sync progress
 
 
 class TableSyncer(Worker):
@@ -41,23 +42,55 @@ class TableSyncer(Worker):
             f"garage_tpu/table_sync:{table.name}"
         ).set_handler(self._handle)
         self._last_sync = 0.0
-        self._layout_digest = None
+        self._layout_version = None
         self.rounds_done = 0
+        self._fail_streak = 0
+        # seconds slept between partitions during a round; the qos
+        # governor maps its pressure onto this during a rebalance so
+        # anti-entropy storms yield to foreground p99 (resize: a layout
+        # change triggers a round of EVERY table on EVERY node at once)
+        self.tranquility = 0.0
+        # one sync source per table: the node's layout sync tracker
+        # advances at the minimum across every registered layer
+        self._sync_source = f"table:{table.name}"
+        lm = getattr(table.system, "layout_manager", None)
+        if lm is not None:
+            lm.register_sync_source(self._sync_source)
 
     # ---- worker --------------------------------------------------------
 
     async def work(self):
-        digest = self.table.system.layout_helper.history.digest()
+        # trigger on the layout VERSION, not the full gossip digest:
+        # the digest covers the CRDT ack/sync trackers, which tick on
+        # every gossip round of a layout transition — digest-triggered
+        # rounds made every syncer on every node re-walk all 256
+        # partitions continuously for the whole transition window
+        # (measured as the dominant foreground-p99 cost of a resize)
+        version = self.table.system.layout_helper.current().version
         due = (
             time.monotonic() - self._last_sync >= self.interval
-            or digest != self._layout_digest
+            or version != self._layout_version
         )
         if not due:
             return WState.IDLE
-        self._layout_digest = digest
-        await self.sync_all_partitions()
-        self._last_sync = time.monotonic()
+        self._layout_version = version
+        all_ok = await self.sync_all_partitions()
         self.rounds_done += 1
+        if all_ok:
+            self._last_sync = time.monotonic()
+            self._fail_streak = 0
+        else:
+            # a failed round never reported sync_until_from, and with
+            # the digest already recorded nothing would retry it until
+            # the 600 s interval — mid-resize that wedges the whole
+            # cluster's sync convergence on one dropped RPC. Retry soon,
+            # but back off exponentially toward the full interval: a
+            # peer that stays down for an hour must not cost every
+            # replica a doomed root_ck RPC per partition every 5 s.
+            retry = min(self.interval,
+                        FAILED_ROUND_RETRY * (2 ** self._fail_streak))
+            self._fail_streak += 1
+            self._last_sync = time.monotonic() - self.interval + retry
         return WState.IDLE
 
     async def wait_for_work(self):
@@ -68,13 +101,17 @@ class TableSyncer(Worker):
         (ref: table/sync.rs add_full_sync, CLI `repair tables`)."""
         self._last_sync = 0.0
 
-    async def sync_all_partitions(self) -> None:
+    async def sync_all_partitions(self) -> bool:
         me = self.table.system.id
         # pin the version we're syncing against BEFORE the round; a layout
         # change mid-round must not get credit for this round's work
         round_version = self.table.system.layout_helper.current().version
         all_ok = True
         for sp in self.table.replication.sync_partitions():
+            if self.tranquility > 0:
+                # governed yield: background anti-entropy paces itself
+                # so foreground requests interleave
+                await asyncio.sleep(self.tranquility)
             stored_here = any(me in s for s in sp.storage_sets)
             try:
                 if stored_here:
@@ -93,12 +130,21 @@ class TableSyncer(Worker):
         # replicas never received their data (ref: sync.rs:520-567)
         lm = getattr(self.table.system, "layout_manager", None)
         if all_ok and lm is not None:
-            lm.sync_table_until(round_version)
+            lm.sync_until_from(self._sync_source, round_version)
+        return all_ok
 
     # ---- pairwise merkle sync (push) -----------------------------------
 
     async def sync_partition_with(self, partition: int, peer: bytes) -> None:
         """Push items the peer is missing/behind on (ref: sync.rs:275-405)."""
+        if self.merkle.read_node(partition, b"").is_empty():
+            # nothing to push from an empty partition — and sync is
+            # push-based, so the peer's own round covers the reverse
+            # direction. With 256 partitions x every table x every
+            # node re-walked on each layout change, skipping the empty
+            # ones is the difference between a resize round of ~10^2
+            # and ~10^5 RPCs on a sparse table.
+            return
         my_root = self.merkle.root_hash(partition)
         resp = await self.endpoint.call(
             peer, {"op": "root_ck", "partition": partition}, PRIO_BACKGROUND

@@ -66,6 +66,10 @@ class QosConfig:
     scrub_tranquility_max: float = 30.0
     resync_tranquility_min: float = 0.0
     resync_tranquility_max: float = 2.0
+    # resync/rebalance backlog depth at which the governor's backlog
+    # signal saturates (rebalance yields to foreground p99 during a
+    # cluster resize; README "Cluster resize")
+    resync_backlog_ref: float = 256.0
 
 
 @dataclass
@@ -101,6 +105,10 @@ class Config:
     # block_ram_buffer_max // 4; 0 disables. Runtime-tunable via admin
     # POST /v1/s3/tuning (README "Hot-block read cache").
     block_read_cache_max_bytes: Optional[int] = None
+    # [block] resync_breaker_aware: rebalance/resync pushes skip peers
+    # whose circuit breaker is open and spread across healthy holders
+    # (README "Cluster resize"); off restores blind placement
+    block_resync_breaker_aware: bool = True
     compression_level: Optional[int] = 1  # zstd level; None disables
     replication_factor: int = 1
     consistency_mode: str = "consistent"  # consistent|degraded|dangerous
@@ -120,6 +128,10 @@ class Config:
     # bucket, hedges/s), and p99-derived adaptive per-call timeouts
     rpc_hedging: bool = True
     rpc_hedge_rate: float = 8.0
+    # [rpc] hedge_writes: backup pushes for IDEMPOTENT writes that
+    # opted in per-call (erasure shard puts; README "Cluster resize").
+    # Off = writes never hedge, regardless of per-call opt-ins.
+    rpc_hedge_writes: bool = True
     rpc_adaptive_timeout: bool = True
     bootstrap_peers: list[str] = field(default_factory=list)
     # external discovery (ref: rpc/consul.rs, rpc/kubernetes.rs);

@@ -23,7 +23,7 @@ from typing import Iterator, Optional
 # static net still fails fast in debug mode. Keep the two in lockstep:
 # the analyzer imports this regex.
 METRIC_NAME_RE = re.compile(
-    r"^(api|qos|cache|chaos|rpc|block|table|resync|scrub|s3)_"
+    r"^(api|qos|cache|chaos|rpc|block|table|resync|resize|scrub|s3)_"
     r"[a-z0-9_]+$")
 
 # Debug-mode strictness: on under GARAGE_METRICS_STRICT=1 (the test

@@ -26,4 +26,18 @@ for i in $(seq 1 "$ITERS"); do
     CHAOS_SOAK_SEED=$SEED "$PY" -m pytest tests/test_chaos.py \
         -k test_randomized_soak -q -s -p no:cacheprovider
 done
+
+# resize soak (ISSUE 6): layout churn — add-node, drain-node,
+# kill-and-restart — under randomized budgeted chaos with a live
+# workload; static-membership faults alone don't exercise the
+# transition machinery. Fewer iterations: each one drives three full
+# transitions on a 5-node cluster-in-a-box.
+RESIZE_ITERS=$(( (ITERS + 4) / 5 ))
+say "resize soak: $RESIZE_ITERS iterations (layout churn + chaos)"
+for i in $(seq 1 "$RESIZE_ITERS"); do
+    SEED=$(( (RANDOM << 15) ^ RANDOM ^ $$ + 1000 + i ))
+    say "resize soak $i/$RESIZE_ITERS seed=$SEED (replay: CHAOS_SOAK_SEED=$SEED pytest tests/test_resize.py -k resize_soak -s)"
+    CHAOS_SOAK_SEED=$SEED "$PY" -m pytest tests/test_resize.py \
+        -k test_resize_soak -q -s -p no:cacheprovider
+done
 say "chaos soak OK"

@@ -262,4 +262,62 @@ curl -sf -H "Authorization: Bearer smoke-admin-token" \
     "http://127.0.0.1:$ADM1/metrics" | grep -q '^chaos_enabled 0' \
     || die "chaos did not disarm"
 
+say "resize: kill-and-restart a node mid-workload (ISSUE 6)"
+# sustained presigned PUT/GET against node 1 while node 2 is crashed
+# (SIGKILL) and later restarted; every op must succeed byte-identical —
+# quorum 2/3 covers the outage, the breaker covers the tail
+FAILLOG="$TMP/krloop.fail"; : > "$FAILLOG"
+(
+    for i in $(seq 1 30); do
+        head -c 60000 /dev/urandom > "$TMP/kr$i"
+        curl -sf --max-time 30 -X PUT --data-binary "@$TMP/kr$i" \
+            "$(presign PUT /smoke/kr$i)" >/dev/null \
+            || { echo "PUT kr$i failed" >> "$FAILLOG"; continue; }
+        curl -sf --max-time 30 "$(presign GET /smoke/kr$i)" \
+            -o "$TMP/kr$i.back" \
+            || { echo "GET kr$i failed" >> "$FAILLOG"; continue; }
+        cmp -s "$TMP/kr$i" "$TMP/kr$i.back" \
+            || echo "kr$i bytes differ" >> "$FAILLOG"
+    done
+) &
+KRLOOP=$!
+sleep 2
+say "  crashing node 2 (SIGKILL)"
+kill -9 "${PIDS[1]}" 2>/dev/null; wait "${PIDS[1]}" 2>/dev/null || true
+# stay down long enough for node 1's breaker to open and pass its
+# cooldown (open -> half-open needs >5 s down + traffic observing it)
+sleep 8
+say "  restarting node 2"
+"$PY" -m garage_tpu.cli.server --config "$TMP/node2/garage.toml" \
+    --log-level warning >> "$TMP/node2/log" 2>&1 &
+PIDS[1]=$!
+wait "$KRLOOP" || true
+[ -s "$FAILLOG" ] && { cat "$FAILLOG"; die "ops failed during kill-and-restart"; }
+# node 1 observed the whole breaker lifecycle: open (node 2 died),
+# half-open (cooldown elapsed under traffic), closed (recovery)
+KRM=$(curl -sfm 20 -H "Authorization: Bearer smoke-admin-token" \
+    "http://127.0.0.1:$ADM1/metrics")
+for label in open half_open closed; do
+    echo "$KRM" | grep -q "rpc_breaker_transition_count{to=\"$label\"}" \
+        || die "breaker never went $label during kill-and-restart"
+done
+# the restarted node rejoins and its resync backlog drains to zero
+for _ in $(seq 1 60); do
+    UP=$(curl -s -H "Authorization: Bearer smoke-admin-token" \
+        "http://127.0.0.1:$ADM1/v1/health" \
+        | "$PY" -c 'import json,sys; print(json.load(sys.stdin)["connectedNodes"])' \
+        2>/dev/null || echo 0)
+    [ "$UP" = "3" ] && break
+    sleep 0.5
+done
+[ "$UP" = "3" ] || die "node 2 did not rejoin after restart"
+for _ in $(seq 1 40); do
+    BACKLOG=$(curl -sfm 20 -H "Authorization: Bearer smoke-admin-token" \
+        "http://127.0.0.1:$ADM2/metrics" 2>/dev/null \
+        | awk '/^resync_backlog /{print $2}' || true)
+    [ "$BACKLOG" = "0" ] && break
+    sleep 0.5
+done
+[ "$BACKLOG" = "0" ] || die "resync backlog did not drain after restart ($BACKLOG)"
+
 say "ALL SMOKE TESTS PASSED"

@@ -2055,7 +2055,9 @@ def test_k2v_cli_roundtrip(server):
                for line in r.stdout.splitlines())
     r = k2vcli("read-range", "pk1")
     assert "sk1" in r.stdout
-    r = k2vcli("delete", "pk1", "sk1", "-c", causality)
+    # --causality=TOKEN: base64 tokens can start with '-' and would
+    # otherwise be parsed as an option flag
+    r = k2vcli("delete", "pk1", "sk1", "--causality=" + causality)
     assert "ok" in r.stdout
     # read-after-delete surfaces the causal tombstone
     r = k2vcli("read", "pk1", "sk1")
