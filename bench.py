@@ -1082,6 +1082,301 @@ def bench_resize(n_nodes: int = 16, nobj: int = 48, obj_kib: int = 256,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_metadata(keys: int = 150_000, engines=("sqlite", "lsm"),
+                   delim_prefixes: int = 256, list_reps: int = 24,
+                   sync_missing: int = 1_000) -> dict:
+    """Metadata at millions of objects (ISSUE 7): the many-small-keys
+    workload every earlier bench skipped. Per engine (sqlite vs lsm),
+    on one `keys`-row table shaped like a real bucket
+    (`d00042/o00001234` — `delim_prefixes` distinct top-level
+    prefixes):
+
+      insert/s      bulk load through the REAL table write path
+                    (TableData.update_many: CRDT merge + store write +
+                    merkle todo per row)
+      merkle        convergence rate draining the todo backlog through
+                    MerkleUpdater.update_batch (one walk per subtree)
+      list p50/p99  _collect_objects — the actual S3 lister — paged
+                    from random continuation points (plain) and folding
+                    the bucket into common prefixes (delimiter);
+                    delimiter fetches-per-page is reported so the
+                    O(distinct prefixes) skip-scan claim is a number
+      sync round    a REAL TableSyncer anti-entropy round between two
+                    loopback nodes: divergent (peer missing
+                    `sync_missing` rows -> trie descent + push) and
+                    converged (root-checksum confirmation) legs
+
+    Keys default small enough for the main bench line; the nightly
+    smoke runs --keys 1000000 and the slow tier 10M."""
+    import pathlib  # noqa: F401  (parity with sibling benches)
+    import random
+    import shutil
+    import tempfile
+
+    from garage_tpu.api.s3 import list as s3list
+    from garage_tpu.db import open_db
+    from garage_tpu.table.data import TableData
+    from garage_tpu.table.merkle import MerkleUpdater
+    from garage_tpu.table.schema import Entry, TableSchema, tree_key
+
+    class MetaEntry(Entry):
+        VERSION_MARKER = b"BMta1"
+
+        def __init__(self, pk, sk, value):
+            self.pk, self.sk, self.value = pk, sk, value
+
+        def partition_key(self):
+            return self.pk
+
+        def sort_key(self):
+            return self.sk
+
+        def merge(self, other):
+            return other if other.value >= self.value else self
+
+        def pack(self):
+            return [self.pk, self.sk, self.value]
+
+        @classmethod
+        def unpack(cls, raw):
+            return cls(raw[0], raw[1], raw[2])
+
+        # duck-typed for the S3 list collector (_collect_objects reads
+        # .key and .last_data() only)
+        @property
+        def key(self):
+            return self.sk.decode()
+
+        def last_data(self):
+            return self
+
+    class MetaSchema(TableSchema):
+        TABLE_NAME = "benchmeta"
+        ENTRY = MetaEntry
+
+    class _Repl:  # standalone build: same partition math as the ring
+        def partition_of(self, h):
+            return h[0]
+
+        def storage_nodes(self, h):
+            return [b"me"]
+
+    bucket = b"bench-bucket"
+    per_prefix = max(1, keys // delim_prefixes)
+    val = b"m" * 96  # ~ an object row's metadata payload
+
+    def key_of(i: int) -> bytes:
+        return b"d%05d/o%08d" % (i // per_prefix, i)
+
+    def pctl(samples, q):
+        return round(float(np.percentile(np.array(samples), q)) * 1000, 3)
+
+    def build_and_measure(engine: str, tmp: str) -> dict:
+        r: dict = {}
+        db = open_db(os.path.join(tmp, "a"), engine=engine)
+        schema = MetaSchema()
+        data = TableData(db, schema, _Repl(), b"me")
+
+        # 1. bulk insert through the real local write path
+        insert_dt = 0.0
+        for lo in range(0, keys, 10_000):
+            raws = [schema.encode_entry(MetaEntry(bucket, key_of(i), val))
+                    for i in range(lo, min(lo + 10_000, keys))]
+            t0 = time.perf_counter()
+            data.update_many(raws)
+            insert_dt += time.perf_counter() - t0
+        r["insert_per_s"] = round(keys / insert_dt, 1)
+
+        # 2. merkle convergence: drain the whole todo backlog batched
+        # (1024-row transactions: bulk-load drain, amortizing the upper
+        # trie levels harder than the worker's foreground-friendly 256)
+        m = MerkleUpdater(data)
+        t0 = time.perf_counter()
+        while True:
+            todo = list(data.merkle_todo.iter(limit=4096))
+            if not todo:
+                break
+            for i in range(0, len(todo), 1024):
+                m.update_batch(todo[i:i + 1024])
+        r["merkle_items_per_s"] = round(
+            keys / (time.perf_counter() - t0), 1)
+
+        if engine == "lsm":
+            # read-optimized steady state for the list legs (the
+            # maintenance worker reaches it on an idle node)
+            db._engine.compact_full()
+            es = db.engine_stats()
+            r["segments"] = es["segments"]
+            r["flushes"] = es["flushes"]
+            r["compactions"] = es["compactions"]
+
+        # 3. list latencies through the real S3 collector
+        class _Ctx:
+            bucket_id = bucket
+            fetches = 0
+
+            def __init__(self):
+                self.garage = self
+                self.object_table = self
+
+            async def get_range(self, pk, start_sk=None, flt=None,
+                                limit=1000, prefix_sk=None, **kw):
+                self.fetches += 1
+                raws = data.read_range(pk, start_sk, None, limit,
+                                       prefix_sk=prefix_sk)
+                return [schema.decode_entry(x) for x in raws]
+
+        rng = random.Random(7)
+
+        async def list_legs():
+            ctx = _Ctx()
+            plain, delim = [], []
+            # warm-up: one page of each shape untimed, so the p99
+            # measures the steady state, not first-touch cache fills
+            await s3list._collect_objects(ctx, "", None, "", 1000)
+            await s3list._collect_objects(ctx, "", None, "/", 1000)
+            for _ in range(list_reps):
+                resume = ("k", key_of(rng.randrange(keys)).decode())
+                t0 = time.perf_counter()
+                await s3list._collect_objects(ctx, "", resume, "", 1000)
+                plain.append(time.perf_counter() - t0)
+            ctx.fetches = 0
+            t0 = time.perf_counter()
+            _, cps, _, _ = await s3list._collect_objects(
+                ctx, "", None, "/", 1000)
+            first_dt = time.perf_counter() - t0
+            fetches = ctx.fetches
+            delim.append(first_dt)
+            for _ in range(list_reps - 1):
+                t0 = time.perf_counter()
+                await s3list._collect_objects(ctx, "", None, "/", 1000)
+                delim.append(time.perf_counter() - t0)
+            return plain, delim, len(cps), fetches
+
+        plain, delim, n_prefixes, delim_fetches = asyncio.run(list_legs())
+        r["list_p50_ms"] = pctl(plain, 50)
+        r["list_p99_ms"] = pctl(plain, 99)
+        r["delim_list_p50_ms"] = pctl(delim, 50)
+        r["delim_list_p99_ms"] = pctl(delim, 99)
+        r["delim_prefixes"] = n_prefixes
+        # the skip-scan claim as a number: range reads per delimiter
+        # page ~ distinct prefixes, independent of keys under them
+        r["delim_fetches_per_page"] = delim_fetches
+
+        # 4. real anti-entropy round between two loopback nodes; peer B
+        # starts as a snapshot of A missing `sync_missing` rows
+        db.snapshot(os.path.join(tmp, "b"))
+        db_b = open_db(os.path.join(tmp, "b"), engine=engine)
+        data_b = TableData(db_b, MetaSchema(), _Repl(), b"me")
+        missing = rng.sample(range(keys), min(sync_missing, keys))
+
+        def drop(tx):
+            for i in missing:
+                k = tree_key(bucket, key_of(i))
+                tx.remove(data_b.store, k)
+                tx.insert(data_b.merkle_todo, k, b"")
+
+        db_b.transaction(drop)
+        mb = MerkleUpdater(data_b)
+        while True:
+            todo = list(data_b.merkle_todo.iter(limit=4096))
+            if not todo:
+                break
+            for i in range(0, len(todo), MerkleUpdater.TX_STEP):
+                mb.update_batch(todo[i:i + MerkleUpdater.TX_STEP])
+
+        from garage_tpu.net import LocalNetwork, NetApp
+        from garage_tpu.rpc import ReplicationMode, RpcHelper, System
+        from garage_tpu.rpc.layout import NodeRole
+        from garage_tpu.table import Table, TableShardedReplication
+        from garage_tpu.table.sync import TableSyncer
+
+        async def sync_round():
+            net = LocalNetwork()
+            systems = []
+            for i in range(2):
+                app = NetApp(b"bench-meta")
+                net.register(app)
+                s = System(app, ReplicationMode.parse(2),
+                           os.path.join(tmp, f"node{i}"),
+                           status_interval=0.2, ping_interval=0.2)
+                systems.append(s)
+            tasks = [asyncio.create_task(s.run()) for s in systems]
+            try:
+                await systems[1].netapp.try_connect(
+                    systems[0].netapp.public_addr, systems[0].id)
+                systems[1].peering.add_peer(
+                    systems[0].netapp.public_addr, systems[0].id)
+                deadline = time.monotonic() + 15
+                while time.monotonic() < deadline:
+                    if all(len(s.netapp.conns) == 1 for s in systems):
+                        break
+                    await asyncio.sleep(0.05)
+                lm = systems[0].layout_manager
+                for s in systems:
+                    lm.history.stage_role(
+                        s.id, NodeRole(zone="z1", capacity=1 << 30))
+                lm.apply_staged(None)
+                while time.monotonic() < deadline:
+                    if all(s.layout_manager.history.current().version == 1
+                           for s in systems):
+                        break
+                    await asyncio.sleep(0.05)
+                tabs = []
+                for s, d in zip(systems, (db, db_b)):
+                    repl = TableShardedReplication(
+                        s, s.replication.read_quorum,
+                        s.replication.write_quorum)
+                    tabs.append(Table(MetaSchema(), repl,
+                                      RpcHelper(s), d))
+                syncers = [TableSyncer(t, interval=1e9) for t in tabs]
+                t0 = time.perf_counter()
+                ok = await syncers[0].sync_all_partitions()
+                div_s = time.perf_counter() - t0
+                healed = len(tabs[1].data.store) == keys
+                t0 = time.perf_counter()
+                await syncers[0].sync_all_partitions()
+                conv_s = time.perf_counter() - t0
+                return div_s, conv_s, ok and healed
+            finally:
+                for s in systems:
+                    await s.stop()
+                for t in tasks:
+                    t.cancel()
+
+        div_s, conv_s, sync_ok = asyncio.run(
+            asyncio.wait_for(sync_round(), 300))
+        r["sync_round_divergent_s"] = round(div_s, 3)
+        r["sync_round_converged_s"] = round(conv_s, 3)
+        r["sync_healed"] = sync_ok
+        db.close()
+        db_b.close()
+        return r
+
+    out: dict = {"meta_keys": keys}
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    for engine in engines:
+        tmp = tempfile.mkdtemp(prefix=f"gt_meta_{engine}_", dir=base)
+        try:
+            for k, v in build_and_measure(engine, tmp).items():
+                out[f"meta_{engine}_{k}"] = v
+        except Exception as e:  # one engine must never kill the line
+            out[f"meta_{engine}_error"] = f"{type(e).__name__}: {e}"[:300]
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    if out.get("meta_lsm_insert_per_s") and out.get(
+            "meta_sqlite_insert_per_s"):
+        out["meta_insert_lsm_vs_sqlite"] = round(
+            out["meta_lsm_insert_per_s"]
+            / out["meta_sqlite_insert_per_s"], 2)
+    if out.get("meta_lsm_delim_list_p99_ms") and out.get(
+            "meta_sqlite_delim_list_p99_ms"):
+        out["meta_delim_p99_lsm_vs_sqlite"] = round(
+            out["meta_sqlite_delim_list_p99_ms"]
+            / out["meta_lsm_delim_list_p99_ms"], 2)
+    return out
+
+
 def bench_native_blake3() -> float:
     """The native host BLAKE3 kernel (b3gf.c, AVX2 8-way) — what the
     product actually hashes with on the host path."""
@@ -1322,6 +1617,14 @@ def main() -> None:
         extra.update(bench_resize())
     except Exception as e:
         extra["resize_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # metadata at scale (ISSUE 7): insert/list/sync on a many-small-keys
+    # table, sqlite vs lsm. Modest key count here; the nightly soak runs
+    # `bench.py bench_metadata --keys 1000000` for the full-scale line.
+    try:
+        extra.update(bench_metadata())
+    except Exception as e:
+        extra["metadata_error"] = f"{type(e).__name__}: {e}"[:300]
     if platform == "cpu":
         maybe_reexec_on_device()
 
@@ -1383,4 +1686,22 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    import sys
+
+    if len(sys.argv) > 1 and sys.argv[1] == "bench_metadata":
+        # standalone scenario (nightly soak smoke / operator runs):
+        # python bench.py bench_metadata --keys 1000000
+        import argparse
+
+        ap = argparse.ArgumentParser()
+        ap.add_argument("cmd")
+        ap.add_argument("--keys", type=int, default=1_000_000)
+        ap.add_argument("--engines", default="sqlite,lsm")
+        a = ap.parse_args()
+        print(json.dumps({
+            "metric": "bench_metadata",
+            **bench_metadata(keys=a.keys,
+                             engines=tuple(a.engines.split(","))),
+        }), flush=True)
+        os._exit(0)
     main()

@@ -78,17 +78,21 @@ class AdminHttpServer:
             # the first table_size_bytes read scans each table for its
             # baseline — do that off the event loop ONCE; steady-state
             # scrapes read the cached base + delta inline
+            import asyncio
+
             if any(t.data._bytes_base is None
                    for t in self.garage.all_tables()):
-                import asyncio
-
                 await asyncio.to_thread(
                     lambda: [t.data.size_bytes()
                              for t in self.garage.all_tables()])
+            # the whole render runs off-loop: per-table row counts and
+            # the metadata engine_stats() are COUNT(*) scans on sqlite —
+            # at millions of rows a scrape must not stall the loop
+            body = await asyncio.to_thread(self.render_metrics)
             return Response(200,
                             [("content-type",
                               "text/plain; version=0.0.4")],
-                            self.render_metrics().encode())
+                            body.encode())
         if path == "/check" and req.method == "GET":
             return await self._check_domain(req)
         if path == "/v1/trace" and req.method == "GET":
@@ -255,6 +259,42 @@ class AdminHttpServer:
                 return None
             return _json(ctl.state())
 
+        if path == "/v1/metadata" and m == "GET":
+            # metadata-engine observability (README "Metadata at
+            # scale"): per-engine internals (lsm: segments, compaction
+            # backlog, WAL/memtable bytes; sqlite: file size), per-table
+            # row/todo depths, compaction worker state, and the
+            # resize-phase readout so one call answers "what is the
+            # metadata plane doing right now"
+            import asyncio as _aio
+
+            g = self.garage
+
+            def collect():
+                # engine_stats + per-table depths are COUNT(*) scans on
+                # sqlite: keep them off the event loop (GL01 in spirit)
+                return (g.db.engine_stats(),
+                        {t.name: t.data.stats() for t in g.all_tables()})
+
+            engine, tables = await _aio.to_thread(collect)
+            lm = getattr(g, "lsm_maintenance", None)
+            maintenance = None
+            if lm is not None:
+                maintenance = {"steps": lm.steps,
+                               "tranquility": round(lm.tranquility, 4),
+                               "backlog": engine.get(
+                                   "compaction_backlog", 0)}
+            from ..utils.metrics import registry as _reg
+
+            phases = {}
+            for labels, count, total, mx in _reg().series(
+                    "resize_phase_seconds"):
+                phases[labels.get("phase", "?")] = {
+                    "count": count, "total_s": round(total, 3),
+                    "max_s": round(mx, 3)}
+            return _json({"engine": engine, "tables": tables,
+                          "compaction": maintenance,
+                          "resize_phase_seconds": phases})
         if path == "/v1/qos" and m == "GET":
             return _json(self._qos_state())
         if path == "/v1/qos" and m == "POST":
@@ -624,6 +664,23 @@ class AdminHttpServer:
             for k, v in s.items():
                 gauge(f"table_{k}", v, table=t.name)
             gauge("table_size_bytes", t.data.size_bytes(), table=t.name)
+
+        # metadata engine internals (db/lsm.py et al.; README "Metadata
+        # at scale") — segment count, compaction backlog and WAL size
+        # make compaction stalls and flush storms visible to operators
+        es = g.db.engine_stats()
+        gauge("meta_rows", es.get("rows", 0),
+              "Live rows across all metadata trees",
+              engine=es.get("engine", "?"))
+        for k in ("segments", "compaction_backlog", "wal_bytes",
+                  "memtable_bytes", "flushes", "compactions",
+                  "file_bytes"):
+            if k in es:
+                gauge(f"meta_{k}", es[k], engine=es["engine"])
+        lm = getattr(g, "lsm_maintenance", None)
+        if lm is not None:
+            gauge("meta_compaction_tranquility",
+                  round(lm.tranquility, 4))
 
         # per-node status + ping gauges (ref: rpc/system_metrics.rs:302)
         for peer in g.system.peering.get_peer_list():

@@ -18,6 +18,12 @@ from .xml import S3Error, xml, xml_response
 log = logging.getLogger("garage_tpu.api.s3.list")
 
 PAGE = 1000
+# rows fetched right after a delimiter skip-seek: in prefix-heavy
+# layouts the very next row folds into a new common prefix, so a full
+# PAGE fetch per distinct prefix would re-create the O(keys) cost the
+# skip-scan removed. A small probe keeps per-prefix cost ~constant; a
+# probe that comes back fold-free falls back to full pages.
+DELIM_PROBE = 16
 
 
 def _enc_token(s: str) -> str:
@@ -100,6 +106,18 @@ def _iso(ts_msec: int) -> str:
     ).strftime("%Y-%m-%dT%H:%M:%S.000Z")
 
 
+def _marker_is_folded_prefix(marker: str, prefix: str,
+                             delimiter: str) -> bool:
+    """True when a NextMarker/NextKeyMarker names a folded common
+    prefix. A folded prefix is always `prefix + <nonempty> + delimiter`
+    — a marker that merely ends with the delimiter (e.g. equal to the
+    request prefix, or outside its window) must resume key-by-key, or
+    the ("p",...) cursor would seek past the entire prefix window and
+    return an empty page."""
+    return (bool(delimiter) and marker.endswith(delimiter)
+            and marker.startswith(prefix) and len(marker) > len(prefix))
+
+
 def _prefix_upper_bound(b: bytes):
     bb = bytearray(b)
     while bb:
@@ -115,12 +133,19 @@ async def _collect_objects(ctx, prefix: str, resume, delimiter: str,
     """Shared lister. `resume` is None or ("k", last_key) /
     ("p", last_common_prefix) — the last item the previous page
     returned. Folds keys under `delimiter` into common prefixes.
-    Returns (contents, common_prefixes, next_token, truncated)."""
+    Returns (contents, common_prefixes, next_token, truncated).
+
+    Delimiter skip-scan (ISSUE 7): the moment a key folds into a common
+    prefix, the cursor jumps straight to the prefix's upper bound
+    instead of consuming every key under it — one engine seek per
+    DISTINCT prefix, so a page over a bucket with a million keys under
+    `photos/` costs O(distinct prefixes) range reads, not O(keys)."""
     garage = ctx.garage
     contents = []  # (key, ObjectVersion) rows
     prefixes: set[str] = set()
     last_token = None  # last RETURNED item, for the continuation token
 
+    probe = False
     if resume is None:
         sk = prefix.encode() if prefix else None
     elif resume[0] == "p":
@@ -128,14 +153,17 @@ async def _collect_objects(ctx, prefix: str, resume, delimiter: str,
         sk = _prefix_upper_bound(resume[1].encode())
         if sk is None:
             return contents, [], None, False
+        probe = True  # next row most likely folds again
     else:
         sk = resume[1].encode() + b"\x00"
     while True:
+        lim = DELIM_PROBE if probe else PAGE
         entries = await garage.object_table.get_range(
-            ctx.bucket_id, start_sk=sk, flt={"type": "data"}, limit=PAGE,
+            ctx.bucket_id, start_sk=sk, flt={"type": "data"}, limit=lim,
         )
         if not entries:
             return contents, sorted(prefixes), None, False
+        reseek = False
         for o in entries:
             key = o.key
             sk = key.encode() + b"\x00"
@@ -147,13 +175,19 @@ async def _collect_objects(ctx, prefix: str, resume, delimiter: str,
                 rest = key[len(prefix):]
                 if delimiter in rest:
                     cp = prefix + rest.split(delimiter)[0] + delimiter
-                    if cp in prefixes:
-                        continue
-                    if len(contents) + len(prefixes) >= max_keys:
-                        return contents, sorted(prefixes), last_token, True
-                    prefixes.add(cp)
-                    last_token = ("p", cp)
-                    continue
+                    if cp not in prefixes:
+                        if len(contents) + len(prefixes) >= max_keys:
+                            return (contents, sorted(prefixes),
+                                    last_token, True)
+                        prefixes.add(cp)
+                        last_token = ("p", cp)
+                    # skip-scan: every remaining key under cp folds into
+                    # the prefix just recorded — seek past all of them
+                    sk = _prefix_upper_bound(cp.encode())
+                    if sk is None:
+                        return contents, sorted(prefixes), None, False
+                    reseek = True
+                    break
             v = o.last_data()
             if v is None:
                 continue
@@ -161,8 +195,12 @@ async def _collect_objects(ctx, prefix: str, resume, delimiter: str,
                 return contents, sorted(prefixes), last_token, True
             contents.append((key, v))
             last_token = ("k", key)
-        if len(entries) < PAGE:
+        if reseek:
+            probe = True
+            continue
+        if len(entries) < lim:
             return contents, sorted(prefixes), None, False
+        probe = False  # a fold-free page: back to full pages
 
 
 async def handle_list_objects_v2(ctx, req: Request) -> Response:
@@ -215,7 +253,7 @@ async def handle_list_objects_v1(ctx, req: Request) -> Response:
     delimiter = q.get("delimiter", "")
     max_keys = _page_size(q, "max-keys", lo=0)
     marker = q.get("marker", "")
-    if marker and delimiter and marker.endswith(delimiter):
+    if marker and _marker_is_folded_prefix(marker, prefix, delimiter):
         resume = ("p", marker)  # marker was a folded common prefix
     elif marker:
         resume = ("k", marker)
@@ -255,6 +293,7 @@ async def _collect_uploads(ctx, prefix: str, resume, delimiter: str,
 
     `resume` is None or a cursor:
       ("k", key)        — start strictly after `key`
+      ("p", cprefix)    — start past every key under `cprefix`
       ("i", key)        — start AT `key`, all of its uploads
       ("u", key, uuid)  — start AT `key`, uploads with id > `uuid`
     An object may hold several concurrent uploads (one uploading
@@ -263,7 +302,10 @@ async def _collect_uploads(ctx, prefix: str, resume, delimiter: str,
     Returns (uploads, common_prefixes, next_cursor, truncated) where
     uploads is [(key, version)] and next_cursor follows the same
     cursor grammar (its key becomes NextKeyMarker; a ("u",...) or
-    ("i",...) cursor additionally yields NextUploadIdMarker)."""
+    ("i",...) cursor additionally yields NextUploadIdMarker).
+
+    Folded prefixes skip-scan exactly like _collect_objects: one
+    engine seek past the whole prefix instead of consuming each key."""
     garage = ctx.garage
     ups = []
     prefixes: set[str] = set()
@@ -275,6 +317,10 @@ async def _collect_uploads(ctx, prefix: str, resume, delimiter: str,
         sk = prefix.encode() if prefix else None
     elif resume[0] == "k":
         sk = resume[1].encode() + b"\x00"
+    elif resume[0] == "p":
+        sk = _prefix_upper_bound(resume[1].encode())
+        if sk is None:
+            return ups, [], None, False
     else:  # "i" / "u": re-read the marker key itself
         sk = resume[1].encode()
         if resume[0] == "u":
@@ -287,14 +333,17 @@ async def _collect_uploads(ctx, prefix: str, resume, delimiter: str,
     def full() -> bool:
         return len(ups) + len(prefixes) >= max_uploads
 
+    probe = resume is not None and resume[0] == "p"
     while True:
+        lim = DELIM_PROBE if probe else PAGE
         entries = await garage.object_table.get_range(
             ctx.bucket_id, start_sk=sk,
-            flt={"type": "uploading", "multipart": True}, limit=PAGE,
+            flt={"type": "uploading", "multipart": True}, limit=lim,
             prefix_sk=prefix.encode() if prefix else None,
         )
         if not entries:
             return ups, sorted(prefixes), None, False
+        reseek = False
         for o in entries:
             key = o.key
             sk = key.encode() + b"\x00"
@@ -310,11 +359,15 @@ async def _collect_uploads(ctx, prefix: str, resume, delimiter: str,
                         if full():
                             return ups, sorted(prefixes), last_cursor, True
                         prefixes.add(cp)
-                    # each key under the folded prefix is consumed
-                    # individually; the cursor trails along so a fill
-                    # right after resumes past everything consumed
-                    last_cursor = ("k", key)
-                    continue
+                    # skip-scan past every key under the folded prefix;
+                    # the cursor records the prefix itself so a page
+                    # that fills right here resumes past all of it
+                    last_cursor = ("p", cp)
+                    sk = _prefix_upper_bound(cp.encode())
+                    if sk is None:
+                        return ups, sorted(prefixes), None, False
+                    reseek = True
+                    break
             vs = sorted((v for v in o.versions if v.is_uploading(True)),
                         key=lambda v: v.uuid)
             if after_uuid is not None and key == marker_key:
@@ -328,8 +381,12 @@ async def _collect_uploads(ctx, prefix: str, resume, delimiter: str,
                 placed_any = True
             if not placed_any:
                 last_cursor = ("k", key)
-        if len(entries) < PAGE:
+        if reseek:
+            probe = True
+            continue
+        if len(entries) < lim:
             return ups, sorted(prefixes), None, False
+        probe = False  # a fold-free page: back to full pages
 
 
 async def handle_list_object_versions(ctx, req: Request) -> Response:
@@ -346,7 +403,16 @@ async def handle_list_object_versions(ctx, req: Request) -> Response:
     delimiter = q.get("delimiter", "")
     max_keys = _page_size(q, "max-keys", lo=0)
     key_marker = q.get("key-marker")
-    resume = ("k", key_marker) if key_marker else None
+    if key_marker and _marker_is_folded_prefix(key_marker, prefix,
+                                               delimiter):
+        # the previous page ended on a folded common prefix (same
+        # convention as v1/uploads): resume past the whole prefix,
+        # or page 2 re-emits the same CommonPrefixes entry
+        resume = ("p", key_marker)
+    elif key_marker:
+        resume = ("k", key_marker)
+    else:
+        resume = None
     if max_keys == 0:
         contents, prefixes, next_token, truncated = [], [], None, False
     else:
@@ -397,7 +463,13 @@ async def handle_list_multipart_uploads(ctx, req: Request) -> Response:
         else:
             resume = ("u", key_marker, upload_id_marker)
     elif key_marker is not None:
-        resume = ("k", key_marker)
+        if _marker_is_folded_prefix(key_marker, prefix, delimiter):
+            # the previous page ended on a folded common prefix (same
+            # convention as ListObjects v1): resume past the whole
+            # prefix, not key-by-key under it
+            resume = ("p", key_marker)
+        else:
+            resume = ("k", key_marker)
     else:
         resume = None
     ups, prefixes, next_cursor, truncated = await _collect_uploads(

@@ -19,6 +19,13 @@ import threading
 from typing import Callable, Iterator, Optional, Tuple
 
 
+# "previous value unknown" sentinel for _Engine.put/delete's `prev`
+# hint: the Tree/Transaction facades have ALWAYS just read the old
+# value when they write, and an engine that needs existence for live-
+# count bookkeeping (lsm) can skip a full source walk when it is told.
+PREV_UNKNOWN = object()
+
+
 class TxAbort(Exception):
     """Raise inside a transaction body to roll back. ref: db/lib.rs TxError::Abort."""
 
@@ -71,6 +78,13 @@ class Db:
         with self._lock:
             self._engine.snapshot(to_dir)
 
+    def engine_stats(self) -> dict:
+        """Per-engine internals for operators (admin GET /v1/metadata +
+        the meta_* gauges): segment counts, WAL size, compaction
+        backlog for lsm; file size for sqlite; row totals everywhere."""
+        with self._lock:
+            return self._engine.stats()
+
     def close(self) -> None:
         with self._lock:
             self._engine.close()
@@ -94,7 +108,7 @@ class Tree:
             self._e.begin()
             try:
                 old = self._e.get(self.name, key)
-                self._e.put(self.name, key, value)
+                self._e.put(self.name, key, value, prev=old)
             except BaseException:
                 self._e.rollback()
                 raise
@@ -107,7 +121,7 @@ class Tree:
             try:
                 old = self._e.get(self.name, key)
                 if old is not None:
-                    self._e.delete(self.name, key)
+                    self._e.delete(self.name, key, prev=old)
             except BaseException:
                 self._e.rollback()
                 raise
@@ -158,13 +172,13 @@ class Transaction:
 
     def insert(self, tree: Tree, key: bytes, value: bytes) -> Optional[bytes]:
         old = self._e.get(tree.name, key)
-        self._e.put(tree.name, key, value)
+        self._e.put(tree.name, key, value, prev=old)
         return old
 
     def remove(self, tree: Tree, key: bytes) -> Optional[bytes]:
         old = self._e.get(tree.name, key)
         if old is not None:
-            self._e.delete(tree.name, key)
+            self._e.delete(tree.name, key, prev=old)
         return old
 
     def length(self, tree: Tree) -> int:
@@ -188,8 +202,12 @@ class _Engine:
     def ensure_tree(self, name: str) -> None: ...
     def list_trees(self) -> list[str]: ...
     def get(self, tree: str, key: bytes) -> Optional[bytes]: ...
-    def put(self, tree: str, key: bytes, value: bytes) -> None: ...
-    def delete(self, tree: str, key: bytes) -> None: ...
+
+    # `prev` is a hint: the stored value (None = absent) the caller just
+    # read under the same lock, or PREV_UNKNOWN
+    def put(self, tree: str, key: bytes, value: bytes,
+            prev=PREV_UNKNOWN) -> None: ...
+    def delete(self, tree: str, key: bytes, prev=PREV_UNKNOWN) -> None: ...
     def clear(self, tree: str) -> None: ...
     def length(self, tree: str) -> int: ...
     def range(self, tree, start, end, reverse, limit=None) -> list: ...
@@ -198,6 +216,9 @@ class _Engine:
     def rollback(self) -> None: ...
     def snapshot(self, to_dir: str) -> None: ...
     def close(self) -> None: ...
+
+    def stats(self) -> dict:
+        return {"engine": self.NAME}
 
 
 class MemEngine(_Engine):
@@ -223,7 +244,7 @@ class MemEngine(_Engine):
     def get(self, tree, key):
         return self._data[tree].get(key)
 
-    def put(self, tree, key, value):
+    def put(self, tree, key, value, prev=PREV_UNKNOWN):
         d = self._data[tree]
         if self._undo is not None:
             self._undo.append((tree, key, d.get(key)))
@@ -231,7 +252,7 @@ class MemEngine(_Engine):
             bisect.insort(self._keys[tree], key)
         d[key] = value
 
-    def delete(self, tree, key):
+    def delete(self, tree, key, prev=PREV_UNKNOWN):
         d = self._data[tree]
         if key in d:
             if self._undo is not None:
@@ -299,6 +320,10 @@ class MemEngine(_Engine):
             if i < len(ks) and ks[i] == key:
                 ks.pop(i)
 
+    def stats(self):
+        return {"engine": self.NAME, "trees": len(self._data),
+                "rows": sum(len(d) for d in self._data.values())}
+
     def snapshot(self, to_dir):
         # dev/test engine: dump all trees as one msgpack file so the
         # snapshot workers + CLI behave uniformly across engines
@@ -331,6 +356,8 @@ class SqliteEngine(_Engine):
         self._conn.execute(
             "PRAGMA synchronous=%s" % ("FULL" if fsync else "OFF"))
         self._depth = 0
+        self._stats_cache: Optional[dict] = None
+        self._stats_at = 0.0
 
     @staticmethod
     def _tbl(name: str) -> str:
@@ -352,12 +379,12 @@ class SqliteEngine(_Engine):
             f"SELECT v FROM {self._tbl(tree)} WHERE k=?", (key,)).fetchone()
         return row[0] if row else None
 
-    def put(self, tree, key, value):
+    def put(self, tree, key, value, prev=PREV_UNKNOWN):
         self._conn.execute(
             f"INSERT INTO {self._tbl(tree)}(k,v) VALUES(?,?) "
             "ON CONFLICT(k) DO UPDATE SET v=excluded.v", (key, value))
 
-    def delete(self, tree, key):
+    def delete(self, tree, key, prev=PREV_UNKNOWN):
         self._conn.execute(f"DELETE FROM {self._tbl(tree)} WHERE k=?", (key,))
 
     def clear(self, tree):
@@ -408,16 +435,43 @@ class SqliteEngine(_Engine):
         finally:
             dst.close()
 
+    def stats(self):
+        # the row total is a COUNT(*) scan per tree — O(all rows) while
+        # holding the Db lock. /metrics scrapes every few seconds, so
+        # cache it; file size stays live (stat() is cheap)
+        import time
+
+        now = time.monotonic()
+        if self._stats_cache is None or now - self._stats_at >= 10.0:
+            trees = self.list_trees()
+            self._stats_cache = {
+                "engine": self.NAME, "trees": len(trees),
+                "rows": sum(self.length(t) for t in trees)}
+            self._stats_at = now
+        st = dict(self._stats_cache)
+        try:
+            st["file_bytes"] = os.path.getsize(self.path)
+        except OSError:
+            st["file_bytes"] = 0
+        return st
+
     def close(self):
         self._conn.close()
 
 
 def open_db(path: str, engine: str = "sqlite", fsync: bool = False) -> Db:
-    """ref: src/db/open.rs:65-125."""
+    """ref: src/db/open.rs:65-125 (engine selection; `[metadata]
+    db_engine = sqlite|memory|lsm`)."""
     if engine == "sqlite":
         return Db(SqliteEngine(os.path.join(path, "db.sqlite")
                                if not path.endswith(".sqlite") else path,
                                fsync=fsync))
     if engine == "memory":
         return Db(MemEngine())
-    raise ValueError(f"unknown db engine {engine!r} (sqlite|memory)")
+    if engine == "lsm":
+        from .lsm import LsmEngine
+
+        return Db(LsmEngine(os.path.join(path, "db.lsm")
+                            if not path.endswith(".lsm") else path,
+                            fsync=fsync))
+    raise ValueError(f"unknown db engine {engine!r} (sqlite|memory|lsm)")

@@ -110,6 +110,8 @@ class Garage:
             bootstrap_peers=bootstrap,
             discovery=providers_from_config(config), **kwargs,
         )
+        self.system.layout_manager.set_broadcast_debounce(
+            config.rpc_layout_debounce_ms / 1000.0)
         rpc = RpcHelper(self.system)
         self.rpc = rpc
         rm = self.replication
@@ -205,6 +207,7 @@ class Garage:
         # configured byte rate
         self.block_manager.read_qos_charge = self.qos.shape_bytes
         self.qos_governor = None  # spawned in spawn_workers
+        self.lsm_maintenance = None  # spawned in spawn_workers (lsm only)
 
         # ---- self-healing rpc knobs ([rpc] section) --------------------
         self.system.peering.health.configure(
@@ -274,6 +277,13 @@ class Garage:
             t.spawn_workers(self.runner)
         self.block_manager.spawn_workers(self.runner, scrub=scrub)
         self.block_manager.register_bg_vars(self.bg_vars)
+        if self.db.engine_name == "lsm":
+            # background size-tiered compaction, paced by the governor
+            # exactly like resync/scrub (README "Metadata at scale")
+            from ..db.lsm import LsmMaintenanceWorker
+
+            self.lsm_maintenance = LsmMaintenanceWorker(self.db)
+            self.runner.spawn_worker(self.lsm_maintenance)
         qc = self.config.qos
         if qc.governor:
             from ..qos import GovernorWorker
@@ -286,6 +296,7 @@ class Garage:
                 resync_range=(qc.resync_tranquility_min,
                               qc.resync_tranquility_max),
                 resync_backlog_ref=qc.resync_backlog_ref,
+                table_sync_tranq_max=self.config.table_sync_tranquility_max,
             )
             self.runner.spawn_worker(self.qos_governor)
             gov = self.qos_governor

@@ -74,8 +74,13 @@ class GovernorWorker(Worker):
     # pressure maps onto the table syncers' per-partition sleep too: a
     # layout change triggers an anti-entropy round of every table on
     # every node at once, and unthrottled rounds were the dominant
-    # foreground-p99 cost of a resize
+    # foreground-p99 cost of a resize. Default for the
+    # `[table] sync_tranquility_max` knob (ctor overrides).
     TABLE_SYNC_TRANQ_MAX = 0.05  # s/partition at pressure 1.0
+    # lsm compaction pacing: seconds of sleep between merge steps at
+    # pressure 1.0 (db/lsm.py LsmMaintenanceWorker). A merge step is a
+    # burst of disk+CPU, so it yields harder than per-item work does.
+    LSM_COMPACT_TRANQ_MAX = 5.0
 
     def __init__(self, garage, interval: float = 2.0,
                  target_latency: float = 0.05,
@@ -84,7 +89,8 @@ class GovernorWorker(Worker):
                  sample_fn: Optional[Callable[[], tuple[int, float]]] = None,
                  queue_depth_fn: Optional[Callable[[], int]] = None,
                  resync_backlog_fn: Optional[Callable[[], int]] = None,
-                 resync_backlog_ref: Optional[float] = None):
+                 resync_backlog_ref: Optional[float] = None,
+                 table_sync_tranq_max: Optional[float] = None):
         self.garage = garage
         self.interval = interval
         self.target_latency = target_latency
@@ -95,6 +101,9 @@ class GovernorWorker(Worker):
         self.resync_backlog_fn = resync_backlog_fn
         self.resync_backlog_ref = float(resync_backlog_ref
                                         or self.RESYNC_REF_BACKLOG)
+        self.table_sync_tranq_max = float(
+            self.TABLE_SYNC_TRANQ_MAX if table_sync_tranq_max is None
+            else table_sync_tranq_max)
         self.enabled = True
         self.pressure = 0.0
         self.ewma: Optional[float] = None
@@ -187,11 +196,16 @@ class GovernorWorker(Worker):
             sw.state.tranquility = lo + u * (hi - lo)
         all_tables = getattr(self.garage, "all_tables", None)
         if callable(all_tables):
-            tranq = u * self.TABLE_SYNC_TRANQ_MAX
+            tranq = u * self.table_sync_tranq_max
             for t in all_tables():
                 syncer = getattr(t, "syncer", None)
                 if syncer is not None:
                     syncer.tranquility = tranq
+        # lsm compaction yields to foreground latency the same way the
+        # table syncers do (db/lsm.py LsmMaintenanceWorker)
+        lm = getattr(self.garage, "lsm_maintenance", None)
+        if lm is not None:
+            lm.tranquility = u * self.LSM_COMPACT_TRANQ_MAX
         self.adjustments += 1
         registry().inc("qos_governor_pressure", self.pressure)
 

@@ -52,9 +52,15 @@ class LayoutManager:
         # fires _changed, and an immediate full-history broadcast to
         # every peer per tick is an O(N^2) gossip storm on big
         # clusters — coalesce to at most one broadcast per interval
-        self._bcast_interval = 0.1
+        self._bcast_interval = 0.1  # `[rpc] layout_debounce_ms` / 1000
         self._bcast_last = 0.0
         self._bcast_scheduled = False
+
+    def set_broadcast_debounce(self, seconds: float) -> None:
+        """Operator knob `[rpc] layout_debounce_ms` (Garage wires it at
+        startup): the minimum spacing between full-history gossip
+        waves. Raise on big clusters, lower for test convergence."""
+        self._bcast_interval = max(0.0, seconds)
 
     @property
     def history(self) -> LayoutHistory:

@@ -74,7 +74,13 @@ class TableData:
         `prefix_sk` bounds both ends to sort keys with that prefix (so a
         reverse scan without an explicit start begins at the prefix's
         upper bound, not at it); `end_sk` is an exclusive stop bound.
-        ref: table/data.rs read_range + k2v range semantics."""
+        ref: table/data.rs read_range + k2v range semantics.
+
+        Streams from a BOUNDED cursor (ISSUE 7): the engine is asked for
+        at most ~limit rows per batch, never the whole partition tail —
+        on a bucket with a million keys the old unbounded iter()
+        materialized every row after the start key just to return the
+        first page."""
         prefix = tree_key(pk, b"")
         part_end = _prefix_upper_bound(prefix)
         lo, hi = prefix, part_end
@@ -86,7 +92,6 @@ class TableData:
                 lo = max(lo, tree_key(pk, start_sk))
             if end_sk is not None:
                 hi = min(hi, tree_key(pk, end_sk))
-            it = self.store.iter(start=lo, end=hi)
         else:
             # reverse: start_sk = inclusive upper start; end_sk =
             # exclusive lower stop (keys must stay > end_sk)
@@ -94,16 +99,35 @@ class TableData:
                 hi = min(hi, tree_key(pk, start_sk) + b"\x00")
             if end_sk is not None:
                 lo = max(lo, tree_key(pk, end_sk) + b"\x00")
-            it = self.store.iter(start=lo, end=hi, reverse=True)
         out = []
-        for k, v in it:
-            if not k.startswith(prefix):
+        while len(out) < limit:
+            # filtered scans over-fetch a little so sparse matches don't
+            # degenerate into per-row engine calls
+            want = (limit - len(out)) if flt is None \
+                else max(limit - len(out), 64)
+            if not reverse:
+                batch = list(self.store.iter(start=lo, end=hi,
+                                             limit=want))
+            else:
+                batch = list(self.store.iter(start=lo, end=hi,
+                                             reverse=True, limit=want))
+            for k, v in batch:
+                if not k.startswith(prefix):
+                    return out
+                if flt is None:
+                    # unfiltered pages skip the per-row decode entirely
+                    out.append(v)
+                elif self.schema.matches_filter(
+                        self.schema.decode_entry(v), flt):
+                    out.append(v)
+                if len(out) >= limit:
+                    return out
+            if len(batch) < want:
                 break
-            e = self.schema.decode_entry(v)
-            if flt is None or self.schema.matches_filter(e, flt):
-                out.append(v)
-            if len(out) >= limit:
-                break
+            if not reverse:
+                lo = batch[-1][0] + b"\x00"
+            else:
+                hi = batch[-1][0]
         return out
 
     def iter_all(self) -> Iterator[tuple[bytes, bytes]]:

@@ -104,10 +104,25 @@ def node_key(partition: int, prefix: bytes) -> bytes:
     return partition.to_bytes(2, "big") + prefix
 
 
-class MerkleUpdater(Worker):
-    """Drains merkle_todo into the trie (ref: merkle.rs worker)."""
+def _group_by_byte(items: list, i: int) -> list:
+    """Group khash-sorted items by khash[i] (consecutive runs)."""
+    out: list = []
+    cur_b = -1
+    for it in items:
+        b = it[1][i]
+        if b != cur_b:
+            out.append((b, []))
+            cur_b = b
+        out[-1][1].append(it)
+    return out
 
-    BATCH = 128
+
+class MerkleUpdater(Worker):
+    """Drains merkle_todo into the trie (ref: merkle.rs worker).
+    Batched: todo rows fold into the trie one walk per subtree
+    (update_batch), not one root-to-leaf walk per row."""
+
+    BATCH = 1024
 
     def __init__(self, data: TableData):
         self.data = data
@@ -150,6 +165,108 @@ class MerkleUpdater(Worker):
         hashes up the trie inside one db transaction."""
         self.data.db.transaction(
             lambda tx: self._apply_one(tx, row_key, new_hash))
+
+    def update_batch(self, todo: list[tuple[bytes, bytes]]) -> None:
+        """Apply a batch of todo rows with ONE walk per touched subtree
+        instead of one root-to-leaf walk per row: rows are grouped by
+        partition, sorted by khash, and folded bottom-up, so a burst of
+        inserts into one partition repacks/rehashes the root (and every
+        shared upper node) once per batch instead of once per item.
+        The resulting trie shape and hashes are identical to sequential
+        `update_item` application (tests/test_table.py asserts it) —
+        the shape stays a pure function of the key set."""
+        by_part: dict[int, list] = {}
+        for k, v in todo:
+            by_part.setdefault(self._partition_of_row(k), []).append(
+                (k, blake2sum(k), v if v else None))
+
+        def body(tx):
+            for partition, items in by_part.items():
+                items.sort(key=lambda it: it[1])
+                self._update_many_rec(tx, partition, b"", items)
+            for k, v in todo:
+                # only clear a todo entry unchanged since we read it
+                # (a concurrent write may have requeued the row)
+                if tx.get(self.data.merkle_todo, k) == v:
+                    tx.remove(self.data.merkle_todo, k)
+
+        self.data.db.transaction(body)
+
+    def _update_many_rec(self, tx, partition: int, prefix: bytes,
+                         items: list) -> Optional[bytes]:
+        """Bulk form of _update_rec. `items` is [(row_key, khash,
+        vhash|None)] sorted by khash, all sharing `prefix` in khash.
+        Returns the node's new hash, EMPTY_HASH if it vanished, or None
+        if unchanged."""
+        i = len(prefix)
+        k = node_key(partition, prefix)
+        node = MerkleNode.unpack(tx.get(self.data.merkle_tree, k))
+
+        if node.kind == INTERMEDIATE:
+            changed = False
+            for byte, group in _group_by_byte(items, i):
+                sub = self._update_many_rec(
+                    tx, partition, prefix + bytes([byte]), group)
+                if sub is None:
+                    continue
+                node = node.with_child(byte,
+                                       None if sub == EMPTY_HASH else sub)
+                changed = True
+            if not changed:
+                return None
+            if node.is_empty():
+                tx.remove(self.data.merkle_tree, k)
+                return EMPTY_HASH
+            if len(node.children) == 1:
+                # single child left: a leaf child pulls up (canonical
+                # shape, same as _update_rec / merkle.rs:164-183)
+                cb = node.children[0][0]
+                ck = node_key(partition, prefix + bytes([cb]))
+                child = MerkleNode.unpack(tx.get(self.data.merkle_tree, ck))
+                if child.kind == LEAF:
+                    tx.remove(self.data.merkle_tree, ck)
+                    node = child
+            tx.insert(self.data.merkle_tree, k, node.pack())
+            return node.node_hash()
+
+        # EMPTY or LEAF: the whole subtree is the final key set below;
+        # compose it from the existing leaf (if any, not superseded by
+        # an update) plus the batch's inserts, then build in place.
+        final: list = []
+        if node.kind == LEAF:
+            upd = next((it for it in items if it[0] == node.key), None)
+            if upd is None:
+                final.append((node.key, blake2sum(node.key), node.hash))
+            elif upd[2] is not None:
+                final.append(upd)
+        final.extend(it for it in items
+                     if it[2] is not None
+                     and not (node.kind == LEAF and it[0] == node.key))
+        final.sort(key=lambda it: it[1])
+
+        if not final:
+            if node.kind == LEAF:
+                tx.remove(self.data.merkle_tree, k)
+                return EMPTY_HASH
+            return None  # deletes of keys we never held
+        if len(final) == 1:
+            rk, _, vh = final[0]
+            if node.kind == LEAF and node.key == rk and node.hash == vh:
+                return None
+            leaf = MerkleNode.leaf(rk, vh)
+            tx.insert(self.data.merkle_tree, k, leaf.pack())
+            return leaf.node_hash()
+        # two or more keys: this node becomes an intermediate; the
+        # subtrees below are built fresh (nothing deeper can exist
+        # under an EMPTY/LEAF node)
+        children = []
+        for byte, group in _group_by_byte(final, i):
+            sub = self._update_many_rec(
+                tx, partition, prefix + bytes([byte]), group)
+            children.append((byte, sub))
+        inter = MerkleNode.intermediate(children)
+        tx.insert(self.data.merkle_tree, k, inter.pack())
+        return inter.node_hash()
 
     def _apply_one(self, tx, row_key: bytes, new_hash: bytes,
                    cache: Optional[dict] = None) -> None:
@@ -255,30 +372,24 @@ class MerkleUpdater(Worker):
 
     # ---- worker loop ---------------------------------------------------
 
-    # rows per db transaction: each trie update is ~4 tiny statements,
-    # so per-row transactions were BEGIN/COMMIT-dominated under PUT
-    # load; 32 rows amortize that while bounding db-lock hold time
-    # (the PUT path shares the lock)
-    TX_STEP = 32
+    # rows per db transaction: the batched walk amortizes the upper
+    # trie levels across the whole step, so bigger steps cut the
+    # per-row cost further — 256 balances that against db-lock hold
+    # time (the PUT path shares the lock)
+    TX_STEP = 256
 
     async def work(self):
         import asyncio
 
-        todo = list(self.data.merkle_todo.iter())[: self.BATCH]
+        # bounded cursor read: a deep backlog (bulk load, resync storm)
+        # must not be materialized whole just to take the first BATCH
+        todo = list(self.data.merkle_todo.iter(limit=self.BATCH))
         if not todo:
             return WState.IDLE
 
-        def apply(rows):
-            def body(tx):
-                cache: dict = {}  # per-tx node cache: rows share the
-                # top trie levels, so each batch re-reads them once
-                for k, v in rows:
-                    self._apply_one(tx, k, v, cache)
-
-            self.data.db.transaction(body)
-
         for i in range(0, len(todo), self.TX_STEP):
-            await asyncio.to_thread(apply, todo[i:i + self.TX_STEP])
+            await asyncio.to_thread(self.update_batch,
+                                    todo[i:i + self.TX_STEP])
         return WState.BUSY
 
     async def wait_for_work(self):

@@ -133,6 +133,12 @@ class Config:
     # Off = writes never hedge, regardless of per-call opt-ins.
     rpc_hedge_writes: bool = True
     rpc_adaptive_timeout: bool = True
+    # [rpc] layout_debounce_ms: coalescing window for layout gossip
+    # broadcasts (rpc/layout/manager.py). Every tracker tick during a
+    # resize fires a change; broadcasting each one is an O(N^2) gossip
+    # storm, so back-to-back changes ride one wave per window. Raise on
+    # big clusters, lower for snappier test convergence.
+    rpc_layout_debounce_ms: float = 100.0
     bootstrap_peers: list[str] = field(default_factory=list)
     # external discovery (ref: rpc/consul.rs, rpc/kubernetes.rs);
     # TOML sections [consul_discovery] / [kubernetes_discovery]
@@ -141,7 +147,11 @@ class Config:
     kubernetes_namespace: Optional[str] = None
     kubernetes_service_name: Optional[str] = None
 
-    db_engine: str = "sqlite"  # sqlite|memory (lmdb not in this image)
+    # [metadata] db_engine: sqlite (durable default) | memory (tests) |
+    # lsm (log-structured merge engine for metadata at millions of
+    # keys; README "Metadata at scale"). Top-level `db_engine = ...`
+    # also accepted, like the reference garage.toml.
+    db_engine: str = "sqlite"
 
     s3_api_bind_addr: Optional[str] = None
     s3_region: str = "garage"
@@ -168,6 +178,12 @@ class Config:
     admin_trace_sink: Optional[str] = None
     web_bind_addr: Optional[str] = None
     web_root_domain: str = ".web.garage"
+
+    # [table] sync_tranquility_max: per-partition sleep (seconds) the
+    # qos governor applies to table anti-entropy rounds at full
+    # pressure (qos/governor.py; was the hard-coded
+    # TABLE_SYNC_TRANQ_MAX). 0 disables governor pacing of table sync.
+    table_sync_tranquility_max: float = 0.05
 
     metadata_auto_snapshot_interval: Optional[float] = None  # seconds
     metadata_snapshots_dir: Optional[str] = None  # default {meta}/snapshots
@@ -334,11 +350,16 @@ def config_from_dict(raw: dict) -> Config:
         elif key == "chaos" and isinstance(val, dict):
             cfg.chaos = ChaosConfig(**val)
         elif key in ("s3_api", "k2v_api", "admin", "web", "block", "rpc",
+                     "table", "metadata",
                      "consul_discovery", "kubernetes_discovery"):
-            # nested sections like the reference layout
+            # nested sections like the reference layout; [metadata]
+            # db_engine / fsync map onto the top-level fields so the
+            # engine selection reads like the docs ([metadata]
+            # db_engine = "lsm")
             prefix = {"s3_api": "s3_", "k2v_api": "k2v_",
                       "admin": "admin_", "web": "web_", "block": "block_",
-                      "rpc": "rpc_",
+                      "rpc": "rpc_", "table": "table_",
+                      "metadata": "metadata_",
                       "consul_discovery": "consul_",
                       "kubernetes_discovery": "kubernetes_"}[key]
             for k2, v2 in val.items():

@@ -23,7 +23,7 @@ from typing import Iterator, Optional
 # static net still fails fast in debug mode. Keep the two in lockstep:
 # the analyzer imports this regex.
 METRIC_NAME_RE = re.compile(
-    r"^(api|qos|cache|chaos|rpc|block|table|resync|resize|scrub|s3)_"
+    r"^(api|qos|cache|chaos|rpc|block|table|resync|resize|scrub|s3|meta)_"
     r"[a-z0-9_]+$")
 
 # Debug-mode strictness: on under GARAGE_METRICS_STRICT=1 (the test
@@ -88,10 +88,22 @@ class MetricsRegistry:
             total += s.total
         return count, total
 
+    def series(self, name: str) -> list[tuple[dict, int, float, float]]:
+        """Every series of `name` as (labels, count, sum, max) — the
+        admin API's per-label readouts (e.g. resize_phase_seconds by
+        phase) without reaching into internals."""
+        return [(dict(labels), s.count, s.total, s.max)
+                for (n, labels), s in list(self._series.items())
+                if n == name]
+
     def render(self) -> Iterator[str]:
         """Prometheus text lines: <name>_count, <name>_sum, <name>_max."""
+        # snapshot under the lock: render runs in a scrape worker thread
+        # while the loop (and the compaction thread) insert new series
+        with self._lock:
+            items = sorted(self._series.items())
         seen_help = set()
-        for (name, labels), s in sorted(self._series.items()):
+        for (name, labels), s in items:
             if name not in seen_help:
                 seen_help.add(name)
                 yield f"# TYPE {name}_count counter"

@@ -40,4 +40,14 @@ for i in $(seq 1 "$RESIZE_ITERS"); do
     CHAOS_SOAK_SEED=$SEED "$PY" -m pytest tests/test_resize.py \
         -k test_resize_soak -q -s -p no:cacheprovider
 done
+# metadata-at-scale smoke (ISSUE 7): 1M-key bench_metadata (sqlite vs
+# lsm — insert/s, list p50/p99 plain+delimiter, merkle convergence,
+# table-sync round) so metadata perf regressions show up in the nightly
+# trajectory like block-path ones do. The 10M tier lives behind the
+# `slow` pytest marker (tests/test_metadata_scale.py).
+META_KEYS="${META_KEYS:-1000000}"
+say "metadata smoke: bench_metadata --keys $META_KEYS"
+JAX_PLATFORMS=cpu GARAGE_TPU_DEVICE=off "$PY" bench.py bench_metadata \
+    --keys "$META_KEYS"
+
 say "chaos soak OK"

@@ -25,3 +25,14 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(params=["memory", "sqlite", "lsm"])
+def db_engine(request) -> str:
+    """The engine axis: every db/table test that takes this fixture runs
+    once per KV engine, so a new engine (lsm) inherits the whole
+    existing suite for free (ISSUE 7 satellite; mirrors src/db/test.rs
+    running one suite over every adapter)."""
+    return request.param

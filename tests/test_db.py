@@ -1,14 +1,15 @@
-"""KV engine tests — the same suite over sqlite and memory engines,
-mirroring src/db/test.rs:3-150."""
+"""KV engine tests — the same suite over every engine (sqlite, memory,
+lsm), mirroring src/db/test.rs:3-150. The `db_engine` fixture lives in
+conftest.py so the table suite parametrizes over the same axis."""
 
 import pytest
 
 from garage_tpu.db import TxAbort, open_db
 
 
-@pytest.fixture(params=["sqlite", "memory"])
-def db(request, tmp_path):
-    d = open_db(str(tmp_path / "meta"), engine=request.param)
+@pytest.fixture
+def db(db_engine, tmp_path):
+    d = open_db(str(tmp_path / "meta"), engine=db_engine)
     yield d
     d.close()
 

@@ -1,0 +1,310 @@
+"""LSM engine specifics (garage_tpu/db/lsm.py): WAL crash-replay,
+compaction under concurrent snapshot readers, snapshot iterator
+isolation, tombstone collection, orphan-segment GC.
+
+The generic KV/table contract is covered by tests/test_db.py and
+tests/test_table.py parametrized over the `db_engine` fixture; this
+file only tests what is unique to the log-structured engine.
+"""
+
+import os
+
+import pytest
+
+from garage_tpu.db import TxAbort, open_db
+from garage_tpu.db.lsm import LsmEngine
+
+
+def lsm_dir(tmp_path) -> str:
+    return str(tmp_path / "meta")
+
+
+def test_wal_crash_replay_no_committed_write_lost(tmp_path):
+    """Simulated kill: the first instance is abandoned WITHOUT close()
+    (no flush, no WAL truncation) — every committed write must be
+    replayed from the WAL by the next open."""
+    d = open_db(lsm_dir(tmp_path), engine="lsm")
+    t = d.open_tree("obj")
+    for i in range(500):
+        t.insert(b"k%03d" % i, b"v%03d" % i)
+    t.remove(b"k007")
+
+    def body(tx):
+        tx.insert(t, b"txa", b"1")
+        tx.insert(t, b"txb", b"2")
+
+    d.transaction(body)
+
+    def aborted(tx):
+        tx.insert(t, b"never", b"x")
+        raise TxAbort()
+
+    with pytest.raises(TxAbort):
+        d.transaction(aborted)
+    # crash: no close, no flush — reopen from WAL alone
+    d2 = open_db(lsm_dir(tmp_path), engine="lsm")
+    t2 = d2.open_tree("obj")
+    assert len(t2) == 501  # 500 - 1 removed + 2 tx
+    assert t2.get(b"k007") is None
+    assert t2.get(b"k008") == b"v008"
+    assert t2.get(b"txa") == b"1" and t2.get(b"txb") == b"2"
+    assert t2.get(b"never") is None  # rolled back: never hit the WAL
+    d2.close()
+
+
+def test_wal_torn_tail_ignored(tmp_path):
+    """A crash mid-append leaves a torn record at the WAL tail; replay
+    must keep everything before it and ignore the garbage."""
+    d = open_db(lsm_dir(tmp_path), engine="lsm")
+    t = d.open_tree("x")
+    t.insert(b"a", b"1")
+    t.insert(b"b", b"2")
+    wal = os.path.join(lsm_dir(tmp_path), "db.lsm", "wal.log")
+    with open(wal, "ab") as f:
+        f.write(b"\xde\xad\xbe\xef torn half-record")
+    d2 = open_db(lsm_dir(tmp_path), engine="lsm")
+    t2 = d2.open_tree("x")
+    assert t2.get(b"a") == b"1" and t2.get(b"b") == b"2"
+    assert len(t2) == 2
+    d2.close()
+
+
+def test_wal_torn_tail_truncated_so_later_commits_survive(tmp_path):
+    """Recovery must TRUNCATE the torn tail: commits acknowledged after
+    a recovery would otherwise append beyond the garbage and be
+    unreachable to the next replay (silent loss on the second crash)."""
+    d = open_db(lsm_dir(tmp_path), engine="lsm")
+    d.open_tree("x").insert(b"a", b"1")
+    wal = os.path.join(lsm_dir(tmp_path), "db.lsm", "wal.log")
+    with open(wal, "ab") as f:
+        f.write(b"\x00\xff garbage from a crash mid-append")
+    d2 = open_db(lsm_dir(tmp_path), engine="lsm")
+    t2 = d2.open_tree("x")
+    assert t2.get(b"a") == b"1"
+    t2.insert(b"b", b"2")  # acknowledged AFTER the recovery
+    # crash again (no close): b must be replayed on the third open
+    d3 = open_db(lsm_dir(tmp_path), engine="lsm")
+    t3 = d3.open_tree("x")
+    assert t3.get(b"a") == b"1" and t3.get(b"b") == b"2"
+    d3.close()
+
+
+def test_clear_with_segments_survives_reopen(tmp_path):
+    """clear() drops on-disk segments: the manifest must be rewritten
+    (before the unlink) or the next open points at deleted files."""
+    d = open_db(lsm_dir(tmp_path), engine="lsm")
+    t = d.open_tree("x")
+    for i in range(50):
+        t.insert(b"%03d" % i, b"v")
+    d._engine.flush()
+    assert d.engine_stats()["segments"] >= 1
+    t.clear()
+    t.insert(b"after", b"clear")
+    # clean close/reopen
+    d.close()
+    d2 = open_db(lsm_dir(tmp_path), engine="lsm")
+    t2 = d2.open_tree("x")
+    assert len(t2) == 1 and t2.get(b"after") == b"clear"
+    # crash (no close) right after another flushed clear
+    d2._engine.flush()
+    t2.clear()
+    d3 = open_db(lsm_dir(tmp_path), engine="lsm")
+    assert len(d3.open_tree("x")) == 0
+    d3.close()
+
+
+def test_flush_resets_wal_and_survives_reopen(tmp_path):
+    d = open_db(lsm_dir(tmp_path), engine="lsm")
+    t = d.open_tree("x")
+    for i in range(100):
+        t.insert(b"%04d" % i, b"v" * 32)
+    eng = d._engine
+    eng.flush()
+    wal = os.path.join(lsm_dir(tmp_path), "db.lsm", "wal.log")
+    assert os.path.getsize(wal) == 0  # all data now lives in segments
+    assert eng.stats()["segments"] >= 1
+    d2 = open_db(lsm_dir(tmp_path), engine="lsm")
+    t2 = d2.open_tree("x")
+    assert len(t2) == 100
+    assert [k for k, _ in t2.iter(limit=3)] == [b"0000", b"0001", b"0002"]
+    d2.close()
+
+
+def test_orphan_segment_gc_on_open(tmp_path):
+    """A segment file written by a flush that crashed before its
+    manifest rename is invisible garbage and must be deleted on open."""
+    d = open_db(lsm_dir(tmp_path), engine="lsm")
+    t = d.open_tree("x")
+    t.insert(b"a", b"1")
+    d._engine.flush()
+    orphan = os.path.join(lsm_dir(tmp_path), "db.lsm", "seg-9999.sst")
+    with open(orphan, "wb") as f:
+        f.write(b"junk from a crashed flush")
+    d2 = open_db(lsm_dir(tmp_path), engine="lsm")
+    assert not os.path.exists(orphan)
+    assert d2.open_tree("x").get(b"a") == b"1"
+    d2.close()
+
+
+def _multi_segment_engine(tmp_path, rows=400):
+    """An engine with several segments + a live memtable."""
+    eng = LsmEngine(str(tmp_path / "e"), memtable_max_bytes=1 << 30)
+    eng.ensure_tree("t")
+    for lo in range(0, rows, 100):
+        eng.begin()
+        for i in range(lo, lo + 100):
+            eng.put("t", b"%05d" % i, b"v%05d" % i)
+        eng.commit()
+        eng.flush()  # one segment per batch
+    eng.begin()
+    eng.put("t", b"zz-mem", b"memtable-row")
+    eng.commit()
+    return eng
+
+
+def test_compaction_under_concurrent_snapshot_reader(tmp_path):
+    """A snapshot iterator opened before a compaction keeps streaming
+    the exact frozen view; victim segment files stay on disk until the
+    reader releases them, then disappear."""
+    eng = _multi_segment_engine(tmp_path)
+    victims = [s.path for ts in eng._trees.values() for s in ts.segments]
+    assert len(victims) >= 4
+    it = eng.iter_snapshot("t")
+    first = [next(it) for _ in range(10)]
+    assert first[0] == (b"00000", b"v00000")
+    eng.compact_full()  # merges everything under the reader
+    assert eng.stats()["segments"] == 1
+    # the reader's files are dead but must still be readable on disk
+    assert all(os.path.exists(p) for p in victims)
+    rest = list(it)
+    got = first + rest
+    assert len(got) == 401
+    assert got[-1] == (b"zz-mem", b"memtable-row")
+    assert got == sorted(got)
+    # iterator exhausted -> refs released -> victims unlinked
+    assert not any(os.path.exists(p) for p in victims)
+    eng.close()
+
+
+def test_snapshot_iterator_isolation(tmp_path):
+    """Writes and deletes after iter_snapshot() are invisible to the
+    iterator but visible to fresh reads."""
+    eng = _multi_segment_engine(tmp_path)
+    it = eng.iter_snapshot("t")
+    eng.begin()
+    eng.put("t", b"00000", b"OVERWRITTEN")
+    eng.delete("t", b"00001")
+    eng.put("t", b"00000a", b"NEW")
+    eng.commit()
+    got = dict(it)
+    assert got[b"00000"] == b"v00000"  # pre-snapshot value
+    assert b"00001" in got             # delete invisible
+    assert b"00000a" not in got        # insert invisible
+    # live reads see the new state
+    assert eng.get("t", b"00000") == b"OVERWRITTEN"
+    assert eng.get("t", b"00001") is None
+    assert eng.get("t", b"00000a") == b"NEW"
+    eng.close()
+
+
+def test_tombstones_dropped_on_full_compaction(tmp_path):
+    eng = LsmEngine(str(tmp_path / "e"))
+    eng.ensure_tree("t")
+    eng.begin()
+    for i in range(100):
+        eng.put("t", b"%03d" % i, b"v")
+    eng.commit()
+    eng.flush()
+    eng.begin()
+    for i in range(100):
+        eng.delete("t", b"%03d" % i)
+    eng.commit()
+    eng.flush()
+    assert eng.length("t") == 0
+    eng.compact_full()
+    # pure-tombstone trees compact down to nothing at all
+    assert eng.stats()["segments"] == 0
+    assert eng.range("t", None, None, False) == []
+    eng.close()
+
+
+def test_clear_rolls_back(tmp_path):
+    eng = _multi_segment_engine(tmp_path)
+    n = eng.length("t")
+    eng.begin()
+    eng.clear("t")
+    assert eng.length("t") == 0
+    eng.rollback()
+    assert eng.length("t") == n
+    assert eng.get("t", b"00000") == b"v00000"
+    # the segments survived the rolled-back clear
+    assert eng.get("t", b"00399") == b"v00399"
+    eng.close()
+
+
+def test_lsm_server_end_to_end_with_kill9_restart(tmp_path):
+    """A real forked server on `[metadata] db_engine = "lsm"`: S3
+    PUT/list/GET work, admin /v1/metadata and /metrics report the
+    engine, and a SIGKILL + restart loses no committed write (the
+    crash-replay acceptance criterion, against a live process)."""
+    from s3util import S3Client, xml_find
+    from test_s3_api import Server, _admin
+
+    srv = Server(str(tmp_path), db_engine="lsm")
+    srv.start()
+    try:
+        srv.setup_layout_and_key()
+        c = S3Client("127.0.0.1", srv.s3_port, srv.key_id, srv.secret)
+        st, _, _ = c.request("PUT", "/lsmbkt")
+        assert st == 200
+        for k in ("a/1", "a/2", "b/1", "top"):
+            st, _, _ = c.request("PUT", f"/lsmbkt/{k}", body=b"payload")
+            assert st == 200
+        st, _, body = c.request(
+            "GET", "/lsmbkt",
+            query=[("list-type", "2"), ("delimiter", "/")])
+        assert st == 200
+        assert xml_find(body, "Key") == ["top"]
+        st, _, body = c.request("GET", "/lsmbkt/a/1")
+        assert st == 200 and body == b"payload"
+        st, got = _admin(srv, "GET", "/v1/metadata")
+        assert st == 200
+        assert got["engine"]["engine"] == "lsm"
+        assert "segments" in got["engine"]
+        assert got["compaction"] is not None  # maintenance worker live
+        # meta_* gauges exported
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", srv.admin_port,
+                                          timeout=30)
+        conn.request("GET", "/metrics")
+        metrics = conn.getresponse().read().decode()
+        conn.close()
+        assert 'meta_rows{engine="lsm"}' in metrics
+
+        # hard kill: no shutdown hooks, no flush — WAL replay must
+        # restore every acknowledged write on restart
+        srv.proc.kill()
+        srv.proc.wait()
+        srv.start()
+        st, _, body = c.request("GET", "/lsmbkt/b/1")
+        assert st == 200 and body == b"payload"
+        st, _, body = c.request("GET", "/lsmbkt",
+                                query=[("list-type", "2")])
+        assert xml_find(body, "Key") == ["a/1", "a/2", "b/1", "top"]
+    finally:
+        srv.stop()
+
+
+def test_engine_stats_shape(tmp_path):
+    d = open_db(lsm_dir(tmp_path), engine="lsm")
+    t = d.open_tree("x")
+    t.insert(b"a", b"1")
+    s = d.engine_stats()
+    assert s["engine"] == "lsm"
+    for k in ("segments", "compaction_backlog", "wal_bytes",
+              "memtable_bytes", "rows"):
+        assert k in s
+    assert s["rows"] == 1
+    assert s["wal_bytes"] > 0
+    d.close()

@@ -44,8 +44,9 @@ def free_port() -> int:
 
 
 class Server:
-    def __init__(self, tmpdir: str):
+    def __init__(self, tmpdir: str, db_engine: str = "sqlite"):
         self.dir = tmpdir
+        self.db_engine = db_engine
         self.rpc_port = free_port()
         self.s3_port = free_port()
         self.admin_port = free_port()
@@ -57,7 +58,6 @@ class Server:
 metadata_dir = "{tmpdir}/meta"
 data_dir = "{tmpdir}/data"
 replication_factor = 1
-db_engine = "sqlite"
 block_size = 65536
 rpc_bind_addr = "127.0.0.1:{self.rpc_port}"
 rpc_public_addr = "127.0.0.1:{self.rpc_port}"
@@ -77,6 +77,9 @@ admin_token = "test-admin-token"
 [web]
 bind_addr = "127.0.0.1:{self.web_port}"
 root_domain = ".web.garage.test"
+
+[metadata]
+db_engine = "{db_engine}"
 """)
         self.proc: subprocess.Popen | None = None
         self.key_id = ""
@@ -552,6 +555,127 @@ def test_list_start_after(client, listing_bucket):
         "GET", listing_bucket,
         query=[("list-type", "2"), ("start-after", "b/1")])
     assert xml_find(body, "Key") == ["b/2", "b/3", "c"]
+
+
+def _common_prefixes(body) -> list:
+    root = ET.fromstring(body)
+    return sorted(el.find("./{*}Prefix").text for el in root.iter()
+                  if el.tag.split("}")[-1] == "CommonPrefixes")
+
+
+def test_list_v2_prefix_rollup_across_page_boundary(client,
+                                                    listing_bucket):
+    """max-keys=1 with a delimiter cuts the page right AFTER each
+    folded common prefix; the continuation token must resume past the
+    whole prefix (skip-scan), never re-emitting it or leaking a key
+    from under it (ISSUE 7)."""
+    got_keys, got_prefixes, token = [], [], None
+    for _ in range(10):
+        q = [("list-type", "2"), ("delimiter", "/"), ("max-keys", "1")]
+        if token:
+            q.append(("continuation-token", token))
+        status, _, body = client.request("GET", listing_bucket, query=q)
+        assert status == 200
+        got_keys += xml_find(body, "Key")
+        got_prefixes += _common_prefixes(body)
+        if xml_find(body, "IsTruncated")[0] != "true":
+            break
+        token = xml_find(body, "NextContinuationToken")[0]
+    assert got_keys == ["c"]
+    assert got_prefixes == ["a/", "b/"]
+
+
+def test_list_v2_continuation_token_overrides_start_after(
+        client, listing_bucket):
+    """AWS: when both are present, continuation-token wins and
+    start-after is ignored (it only seeds the FIRST request)."""
+    status, _, body = client.request(
+        "GET", listing_bucket,
+        query=[("list-type", "2"), ("max-keys", "2"),
+               ("start-after", "a/1")])
+    assert xml_find(body, "Key") == ["a/2", "b/1"]
+    token = xml_find(body, "NextContinuationToken")[0]
+    # a start-after far past the token's position must not matter
+    status, _, body = client.request(
+        "GET", listing_bucket,
+        query=[("list-type", "2"), ("continuation-token", token),
+               ("start-after", "zzz")])
+    assert status == 200
+    assert xml_find(body, "Key") == ["b/2", "b/3", "c"]
+
+
+def test_list_v2_prefix_containing_delimiter(client):
+    """prefix 'b/' itself contains the delimiter: folding must apply to
+    the remainder AFTER the prefix only (b/sub/ folds, b/1 doesn't)."""
+    client.request("PUT", "/edgelist")
+    for k in ("b/1", "b/2", "b/sub/x", "b/sub/y", "b/zub/q"):
+        client.request("PUT", f"/edgelist/{k}", body=b"x")
+    status, _, body = client.request(
+        "GET", "/edgelist",
+        query=[("list-type", "2"), ("prefix", "b/"), ("delimiter", "/")])
+    assert status == 200
+    assert xml_find(body, "Key") == ["b/1", "b/2"]
+    assert _common_prefixes(body) == ["b/sub/", "b/zub/"]
+    for k in ("b/1", "b/2", "b/sub/x", "b/sub/y", "b/zub/q"):
+        client.request("DELETE", f"/edgelist/{k}")
+    client.request("DELETE", "/edgelist")
+
+
+def test_admin_metadata_endpoint(server, client, listing_bucket):
+    """GET /v1/metadata: per-engine internals + per-table depths +
+    resize-phase readout in one operator call (ISSUE 7)."""
+    st, got = _admin(server, "GET", "/v1/metadata")
+    assert st == 200
+    assert got["engine"]["engine"] == "sqlite"  # this server's config
+    assert got["engine"]["rows"] > 0
+    assert "object" in got["tables"]
+    assert got["tables"]["object"]["rows"] >= 6  # the listing fixture
+    assert "resize_phase_seconds" in got
+    # auth required like every management route
+    st, _ = _admin(server, "GET", "/v1/metadata", token=None)
+    assert st == 403
+
+
+def test_list_v2_max_keys_zero(client, listing_bucket):
+    """AWS: max-keys=0 returns an empty, never-truncated page."""
+    status, _, body = client.request(
+        "GET", listing_bucket,
+        query=[("list-type", "2"), ("max-keys", "0")])
+    assert status == 200
+    assert xml_find(body, "Key") == []
+    assert xml_find(body, "KeyCount") == ["0"]
+    assert xml_find(body, "IsTruncated") == ["false"]
+
+
+def test_list_uploads_delimiter_page_boundary(client):
+    """A multipart-uploads page that fills right at a folded common
+    prefix resumes past the WHOLE prefix via the key-marker (the 'p'
+    cursor: marker == the prefix, no upload-id-marker)."""
+    made = []
+    for k in ("updl/u/a", "updl/u/b", "updl/v"):
+        _, _, body = client.request("POST", f"/conformance/{k}",
+                                    query=[("uploads", "")])
+        made.append((k, xml_find(body, "UploadId")[0]))
+    q = [("uploads", ""), ("prefix", "updl/"), ("delimiter", "/"),
+         ("max-uploads", "1")]
+    status, _, body = client.request("GET", "/conformance", query=q)
+    assert status == 200
+    assert _common_prefixes(body) == ["updl/u/"]
+    assert xml_find(body, "Key") == []
+    assert xml_find(body, "IsTruncated") == ["true"]
+    nk = xml_find(body, "NextKeyMarker")[0]
+    assert nk == "updl/u/"
+    assert not xml_find(body, "NextUploadIdMarker")
+    status, _, body = client.request(
+        "GET", "/conformance",
+        query=[("uploads", ""), ("prefix", "updl/"), ("delimiter", "/"),
+               ("key-marker", nk)])
+    assert xml_find(body, "Key") == ["updl/v"]
+    assert _common_prefixes(body) == []
+    assert xml_find(body, "IsTruncated") == ["false"]
+    for k, u in made:
+        client.request("DELETE", f"/conformance/{k}",
+                       query=[("uploadId", u)])
 
 
 # ---- delete objects (batch) --------------------------------------------
@@ -2279,6 +2403,47 @@ def test_list_object_versions(client, listing_bucket):
         query=[("versions", ""), ("key-marker", marker)])
     got += xml_find(body, "Key")
     assert got == sorted(set(got)) and len(got) == 6
+
+
+def test_list_versions_prefix_rollup_across_page_boundary(
+        client, listing_bucket):
+    """?versions + delimiter with max-keys=1: a page ending on a folded
+    common prefix sets NextKeyMarker to the prefix; the next page must
+    resume PAST the whole prefix (("p",...) cursor, same convention as
+    v1/uploads), never re-emitting it or leaking a key from under it."""
+    got_keys, got_prefixes, marker = [], [], None
+    for _ in range(10):
+        q = [("versions", ""), ("delimiter", "/"), ("max-keys", "1")]
+        if marker:
+            q.append(("key-marker", marker))
+        status, _, body = client.request("GET", listing_bucket, query=q)
+        assert status == 200
+        got_keys += xml_find(body, "Key")
+        got_prefixes += _common_prefixes(body)
+        if xml_find(body, "IsTruncated")[0] != "true":
+            break
+        marker = xml_find(body, "NextKeyMarker")[0]
+    assert got_keys == ["c"]
+    assert got_prefixes == ["a/", "b/"]
+
+
+def test_list_marker_equal_to_prefix_not_folded(client, listing_bucket):
+    """A marker that ends with the delimiter but does not strictly
+    extend the request prefix (here: equal to it) is NOT a folded
+    common prefix — folded prefixes are always prefix+<nonempty>+delim.
+    Treating it as one seeks past the whole window and returns an
+    empty page instead of the keys under the prefix."""
+    st, _, body = client.request(
+        "GET", listing_bucket,
+        query=[("versions", ""), ("prefix", "a/"), ("delimiter", "/"),
+               ("key-marker", "a/")])
+    assert st == 200
+    assert xml_find(body, "Key") == ["a/1", "a/2"]
+    st, _, body = client.request(
+        "GET", listing_bucket,
+        query=[("prefix", "a/"), ("delimiter", "/"), ("marker", "a/")])
+    assert st == 200
+    assert xml_find(body, "Key") == ["a/1", "a/2"]
 
 
 def test_unimplemented_subresources_501(client):

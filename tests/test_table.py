@@ -87,13 +87,13 @@ class _FakeRepl:
         return [b"me"]
 
 
-def make_data(tmp_path, name="kv"):
-    db = open_db(str(tmp_path / name), engine="memory")
+def make_data(tmp_path, name="kv", engine="memory"):
+    db = open_db(str(tmp_path / name), engine=engine)
     return TableData(db, KvSchema(), _FakeRepl(), b"me")
 
 
-def test_local_merge_on_write(tmp_path):
-    data = make_data(tmp_path)
+def test_local_merge_on_write(tmp_path, db_engine):
+    data = make_data(tmp_path, engine=db_engine)
     e1 = KvEntry.new(b"p", b"a", "v1", ts=100)
     e2 = KvEntry.new(b"p", b"a", "v2", ts=200)
     assert data.update_entry_decoded(e1) is not None
@@ -106,8 +106,8 @@ def test_local_merge_on_write(tmp_path):
     assert len(data.schema.trigger_log) == 2
 
 
-def test_read_range_and_limits(tmp_path):
-    data = make_data(tmp_path)
+def test_read_range_and_limits(tmp_path, db_engine):
+    data = make_data(tmp_path, engine=db_engine)
     for i in range(20):
         data.update_entry_decoded(KvEntry.new(b"p", b"k%02d" % i, i))
     data.update_entry_decoded(KvEntry.new(b"other", b"x", 99))
@@ -121,9 +121,9 @@ def test_read_range_and_limits(tmp_path):
     assert data.decode_stored(rows[0]).sk == b"k19"
 
 
-def test_merkle_root_order_independent(tmp_path):
-    d1 = make_data(tmp_path, "a")
-    d2 = make_data(tmp_path, "b")
+def test_merkle_root_order_independent(tmp_path, db_engine):
+    d1 = make_data(tmp_path, "a", engine=db_engine)
+    d2 = make_data(tmp_path, "b", engine=db_engine)
     items = [KvEntry.new(b"p%d" % (i % 3), b"s%d" % i, i, ts=1) for i in range(40)]
     for e in items:
         d1.update_entry_decoded(e)
@@ -150,10 +150,49 @@ def test_merkle_root_order_independent(tmp_path):
     assert all(m1.root_hash(q) == roots1[q] for q in range(256) if q != p)
 
 
+def test_merkle_update_batch_equals_sequential(tmp_path):
+    """The batched trie fold (ISSUE 7) must produce a byte-identical
+    merkle tree to one-row-at-a-time update_item: same node set, same
+    packed encodings, same roots — the trie shape stays a pure function
+    of the key set, whatever the apply order or batching."""
+    d_seq = make_data(tmp_path, "seq")
+    d_bat = make_data(tmp_path, "bat")
+    # inserts, overwrites and deletes across a few partitions
+    items = [KvEntry.new(b"p%d" % (i % 5), b"s%04d" % (i % 97), i, ts=i)
+             for i in range(300)]
+    for e in items:
+        d_seq.update_entry_decoded(e)
+        d_bat.update_entry_decoded(e)
+    # delete a slice so the batch path also exercises tombstone folds
+    from garage_tpu.utils.data import blake2sum
+
+    for e in items[:40]:
+        raw = d_seq.read_entry(e.pk, e.sk)
+        if raw is None:
+            continue
+        k = tree_key(e.pk, e.sk)
+        d_seq.delete_if_equal_hash(k, blake2sum(raw))
+        d_bat.delete_if_equal_hash(k, blake2sum(raw))
+    m_seq, m_bat = MerkleUpdater(d_seq), MerkleUpdater(d_bat)
+    for k, v in list(d_seq.merkle_todo.iter()):
+        m_seq.update_item(k, v)
+    todo = list(d_bat.merkle_todo.iter())
+    for i in range(0, len(todo), 64):
+        m_bat.update_batch(todo[i:i + 64])
+    assert len(d_bat.merkle_todo) == 0
+    tree_seq = list(d_seq.merkle_tree.iter())
+    tree_bat = list(d_bat.merkle_tree.iter())
+    assert tree_seq == tree_bat
+    assert any(tree_seq)  # non-degenerate
+    for p in range(256):
+        assert m_seq.root_hash(p) == m_bat.root_hash(p)
+
+
 # ---- cluster tests -----------------------------------------------------
 
 
-async def make_table_cluster(tmp_path, n=3, rf=3, fullcopy=False):
+async def make_table_cluster(tmp_path, n=3, rf=3, fullcopy=False,
+                             engine="memory"):
     net = LocalNetwork()
     systems, tables, dbs = [], [], []
     for i in range(n):
@@ -182,7 +221,7 @@ async def make_table_cluster(tmp_path, n=3, rf=3, fullcopy=False):
             break
         await asyncio.sleep(0.05)
     for i, s in enumerate(systems):
-        db = open_db(str(tmp_path / f"node{i}" / "db"), engine="memory")
+        db = open_db(str(tmp_path / f"node{i}" / "db"), engine=engine)
         dbs.append(db)
         if fullcopy:
             repl = TableFullReplication(s)
@@ -201,9 +240,9 @@ async def stop_all(systems, tasks):
         t.cancel()
 
 
-def test_quorum_insert_get(tmp_path):
+def test_quorum_insert_get(tmp_path, db_engine):
     async def main():
-        net, systems, tables, tasks = await make_table_cluster(tmp_path)
+        net, systems, tables, tasks = await make_table_cluster(tmp_path, engine=db_engine)
         try:
             await tables[0].insert(KvEntry.new(b"bucket", b"obj1", "hello"))
             # visible via any node
@@ -228,9 +267,9 @@ def test_quorum_insert_get(tmp_path):
     run(main())
 
 
-def test_insert_tolerates_one_node_down(tmp_path):
+def test_insert_tolerates_one_node_down(tmp_path, db_engine):
     async def main():
-        net, systems, tables, tasks = await make_table_cluster(tmp_path)
+        net, systems, tables, tasks = await make_table_cluster(tmp_path, engine=db_engine)
         try:
             # kill node 2's transport
             await systems[2].netapp.shutdown()
@@ -243,9 +282,9 @@ def test_insert_tolerates_one_node_down(tmp_path):
     run(main())
 
 
-def test_read_repair_heals_divergence(tmp_path):
+def test_read_repair_heals_divergence(tmp_path, db_engine):
     async def main():
-        net, systems, tables, tasks = await make_table_cluster(tmp_path)
+        net, systems, tables, tasks = await make_table_cluster(tmp_path, engine=db_engine)
         try:
             # write divergent values directly into local stores; the newer
             # value is on 2 of 3 replicas so every read quorum (R=2)
@@ -275,9 +314,9 @@ def test_read_repair_heals_divergence(tmp_path):
     run(main())
 
 
-def test_sync_heals_lagging_node(tmp_path):
+def test_sync_heals_lagging_node(tmp_path, db_engine):
     async def main():
-        net, systems, tables, tasks = await make_table_cluster(tmp_path)
+        net, systems, tables, tasks = await make_table_cluster(tmp_path, engine=db_engine)
         try:
             # node 2 misses 30 writes (applied only on 0 and 1 locally)
             for i in range(30):
@@ -299,9 +338,9 @@ def test_sync_heals_lagging_node(tmp_path):
     run(main())
 
 
-def test_gc_three_phase(tmp_path):
+def test_gc_three_phase(tmp_path, db_engine):
     async def main():
-        net, systems, tables, tasks = await make_table_cluster(tmp_path)
+        net, systems, tables, tasks = await make_table_cluster(tmp_path, engine=db_engine)
         try:
             from garage_tpu.table.gc import TableGc, GcTodoEntry
 
@@ -325,10 +364,10 @@ def test_gc_three_phase(tmp_path):
     run(main())
 
 
-def test_fullcopy_local_reads(tmp_path):
+def test_fullcopy_local_reads(tmp_path, db_engine):
     async def main():
         net, systems, tables, tasks = await make_table_cluster(
-            tmp_path, fullcopy=True
+            tmp_path, fullcopy=True, engine=db_engine
         )
         try:
             await tables[0].insert(KvEntry.new(b"cfg", b"bucket1", {"a": 1}))
@@ -350,9 +389,9 @@ def test_fullcopy_local_reads(tmp_path):
     run(main())
 
 
-def test_insert_queue_drains(tmp_path):
+def test_insert_queue_drains(tmp_path, db_engine):
     async def main():
-        net, systems, tables, tasks = await make_table_cluster(tmp_path)
+        net, systems, tables, tasks = await make_table_cluster(tmp_path, engine=db_engine)
         try:
             from garage_tpu.table.queue import InsertQueueWorker
 
