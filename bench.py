@@ -1377,6 +1377,144 @@ def bench_metadata(keys: int = 150_000, engines=("sqlite", "lsm"),
     return out
 
 
+def bench_gateway(nobj: int = 16, obj_mib: int = 2,
+                  workers_list=None) -> dict:
+    """Multi-process gateway scaling (ISSUE 8): s3_put/s3_get GB/s
+    through a forked store + N SO_REUSEPORT workers, swept over
+    `workers ∈ {1, 2, 4, cpu_count}`. `gateway_scaling_put` =
+    gbps(best N) / gbps(1) — the "frontend scales with cores" number —
+    plus the lease-rebalance convergence time measured against the
+    real BudgetLeaseBroker under a deterministic 10:1 demand skew.
+
+    workers=1 runs the single-process in-process frontend (the exact
+    pre-gateway path), so the baseline is honest."""
+    import concurrent.futures
+    import json as _json
+    import shutil
+    import sys
+    import tempfile
+    import urllib.request
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(here, "tests"))
+    from s3util import S3Client
+    from test_s3_api import Server
+
+    cpus = os.cpu_count() or 1
+    if workers_list is None:
+        workers_list = sorted({w for w in (1, 2, 4, cpus)
+                               if w <= max(cpus, 2)})
+    out: dict = {"gateway_cpus": cpus,
+                 "gateway_workers_swept": list(workers_list)}
+    size = obj_mib << 20
+    data = np.random.default_rng(11).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+    base_dir = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    per: dict[int, tuple[float, float]] = {}
+    for n in workers_list:
+        tmp = tempfile.mkdtemp(prefix=f"gt_gw{n}_", dir=base_dir)
+        srv = Server(tmp)
+        with open(srv.config_path) as f:
+            cfg = f.read()
+        cfg = cfg.replace("block_size = 65536",
+                          "block_size = 1048576")
+        cfg += f"\n[gateway]\nworkers = {n}\nlease_interval_s = 0.5\n"
+        with open(srv.config_path, "w") as f:
+            f.write(cfg)
+        os.environ.setdefault("GARAGE_TPU_DEVICE", "off")
+        try:
+            srv.start()
+            srv.setup_layout_and_key()
+            cli = S3Client("127.0.0.1", srv.s3_port, srv.key_id,
+                           srv.secret)
+            st, _, body = cli.request("PUT", "/gwbench")
+            assert st == 200, body[:200]
+            # cache OFF: this sweep measures the frontend + store
+            # path, and the tuning POST fans out to every worker
+            rq = urllib.request.Request(
+                f"http://127.0.0.1:{srv.admin_port}/v1/s3/tuning",
+                data=_json.dumps(
+                    {"read_cache_max_bytes": 0}).encode(),
+                method="POST",
+                headers={"authorization": "Bearer test-admin-token"})
+            urllib.request.urlopen(rq, timeout=10).read()
+
+            def put(i):
+                st, _, b = cli.request(
+                    "PUT", f"/gwbench/o{i}", body=data,
+                    unsigned_payload=True, timeout=60.0)
+                assert st == 200, b[:200]
+
+            def get(i):
+                st, _, b = cli.request("GET", f"/gwbench/o{i}",
+                                       timeout=60.0)
+                assert st == 200 and len(b) == size
+
+            put(0)  # warm
+            best_put = best_get = 0.0
+            threads = max(4, 2 * n)
+            with concurrent.futures.ThreadPoolExecutor(threads) as pool:
+                for _rep in range(2):
+                    t0 = time.perf_counter()
+                    list(pool.map(put, range(nobj)))
+                    dt = time.perf_counter() - t0
+                    best_put = max(best_put, nobj * size / dt / 1e9)
+                    t0 = time.perf_counter()
+                    list(pool.map(get, range(nobj)))
+                    dt = time.perf_counter() - t0
+                    best_get = max(best_get, nobj * size / dt / 1e9)
+            per[n] = (best_put, best_get)
+            out[f"s3_put_gbps_w{n}"] = round(best_put, 3)
+            out[f"s3_get_gbps_w{n}"] = round(best_get, 3)
+        except Exception as e:  # one worker count never kills the line
+            out[f"gateway_w{n}_error"] = f"{type(e).__name__}: {e}"[:300]
+        finally:
+            srv.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+    if 1 in per and len(per) > 1:
+        base_put, base_get = per[1]
+        best_n = max(per, key=lambda k: per[k][0])
+        out["gateway_best_workers"] = best_n
+        out["gateway_scaling_put"] = round(
+            per[best_n][0] / max(base_put, 1e-9), 2)
+        out["gateway_scaling_get"] = round(
+            max(g for _, g in per.values()) / max(base_get, 1e-9), 2)
+
+    # lease-rebalance convergence: the broker under a deterministic
+    # 10:1:1:1 demand skew (simulated renews at the production
+    # interval) — rounds until the hot worker holds >= 90% of its
+    # demand-proportional share
+    from garage_tpu.gateway.lease import BudgetLeaseBroker
+
+    t = [1000.0]
+    broker = BudgetLeaseBroker(1000.0, min_share=0.05, ttl_s=3.0,
+                               expected_workers=4,
+                               clock=lambda: t[0])
+    interval = 1.0
+    names = [f"w{i}" for i in range(4)]
+    for _ in range(5):  # settle at equal demand
+        t[0] += interval
+        for w in names:
+            broker.renew(w, demand_rps=100.0)
+    demands = {w: (1000.0 if w == "w0" else 100.0) for w in names}
+    target = None
+    rounds = 0
+    for rounds in range(1, 31):
+        t[0] += interval
+        for w in names:
+            broker.renew(w, demand_rps=demands[w])
+        assert broker.conservation_ok
+        hot = broker.granted("w0")[0] or 0.0
+        # demand-proportional share (floor-adjusted) of the budget
+        if target is None:
+            floor = 0.05 * 250.0
+            target = floor + (1000.0 - 4 * floor) * (1000.0 / 1300.0)
+        if hot >= 0.9 * target:
+            break
+    out["lease_rebalance_convergence_s"] = round(rounds * interval, 2)
+    return out
+
+
 def bench_native_blake3() -> float:
     """The native host BLAKE3 kernel (b3gf.c, AVX2 8-way) — what the
     product actually hashes with on the host path."""
@@ -1625,6 +1763,13 @@ def main() -> None:
         extra.update(bench_metadata())
     except Exception as e:
         extra["metadata_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # multi-core gateway (ISSUE 8): s3_put/get swept over worker
+    # counts; gateway_scaling_put is the per-core frontend claim
+    try:
+        extra.update(bench_gateway())
+    except Exception as e:
+        extra["gateway_error"] = f"{type(e).__name__}: {e}"[:300]
     if platform == "cpu":
         maybe_reexec_on_device()
 
@@ -1702,6 +1847,25 @@ if __name__ == "__main__":
             "metric": "bench_metadata",
             **bench_metadata(keys=a.keys,
                              engines=tuple(a.engines.split(","))),
+        }), flush=True)
+        os._exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "bench_gateway":
+        # standalone scenario (CI smoke / operator runs):
+        # python bench.py bench_gateway --workers 1,2,4 --nobj 16
+        import argparse
+
+        ap = argparse.ArgumentParser()
+        ap.add_argument("cmd")
+        ap.add_argument("--workers", default="")
+        ap.add_argument("--nobj", type=int, default=16)
+        ap.add_argument("--obj-mib", type=int, default=2)
+        a = ap.parse_args()
+        wl = ([int(w) for w in a.workers.split(",") if w]
+              or None)
+        print(json.dumps({
+            "metric": "bench_gateway",
+            **bench_gateway(nobj=a.nobj, obj_mib=a.obj_mib,
+                            workers_list=wl),
         }), flush=True)
         os._exit(0)
     main()

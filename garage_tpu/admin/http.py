@@ -23,6 +23,132 @@ def _json(body, status: int = 200) -> Response:
                     json.dumps(body, default=str).encode())
 
 
+# ---- runtime-knob application ------------------------------------------
+# Module-level so BOTH operator surfaces share one implementation: the
+# admin HTTP routes below, and the gateway worker RPC handler
+# (gateway/worker.py) that the supervisor fans /v1/s3/tuning, /v1/qos
+# and /v1/chaos writes out through — runtime knobs keep working when
+# the frontend is N worker processes instead of one.
+
+def apply_s3_tuning(garage, spec: dict) -> dict:
+    """Validate-then-apply the S3 data-plane knobs; returns the live
+    state (the GET payload). A 400 must never leave half the update
+    applied on a live node."""
+    cfg = garage.config
+    cache = garage.block_manager.cache
+    bounds = {"get_readahead_blocks": (0, 64),
+              "put_blocks_max_parallel": (1, 64),
+              # hot-block read cache (block/cache.py): size + admission
+              # knobs, live-resizable so bench sweeps flip the cache
+              # on/off without a server restart (0 = disabled)
+              "read_cache_max_bytes": (0, 1 << 40),
+              "read_cache_probation_pct": (1, 90)}
+    validated = {}
+    for k, raw in spec.items():
+        if k not in bounds:
+            raise BadRequest(f"unknown s3 tuning knob {k!r}")
+        lo, hi = bounds[k]
+        v = int(raw)
+        if v < lo or v > hi:
+            raise BadRequest(f"{k} must be in [{lo}, {hi}]")
+        validated[k] = v
+    for k, v in validated.items():
+        if k == "read_cache_max_bytes":
+            cfg.block_read_cache_max_bytes = v
+            cache.configure(max_bytes=v)
+        elif k == "read_cache_probation_pct":
+            cache.configure(probation_pct=v)
+        else:
+            setattr(cfg, "s3_" + k, v)
+    return s3_tuning_state(garage)
+
+
+def s3_tuning_state(garage) -> dict:
+    from ..api.http import DRAIN_HIGH_WATER
+
+    cache = garage.block_manager.cache
+    return {
+        "get_readahead_blocks": garage.config.s3_get_readahead_blocks,
+        "put_blocks_max_parallel":
+            garage.config.s3_put_blocks_max_parallel,
+        "drain_high_water": DRAIN_HIGH_WATER,
+        "read_cache_max_bytes": cache.max_bytes,
+        "read_cache_probation_pct": cache.probation_pct,
+        "read_cache": cache.stats(),
+    }
+
+
+def apply_chaos_spec(spec: dict) -> dict:
+    """Validate-then-apply a fault-injection spec against THIS
+    process's chaos controller; returns its state."""
+    from ..chaos import injector as chaos_inj
+
+    ctl = chaos_inj.controller()
+    allowed = {"kind", "prob", "count", "node", "peer", "endpoint",
+               "hash_prefix", "delay_s", "rate_bps"}
+    # validate EVERYTHING before the first mutation — a 400 must never
+    # leave the live controller half-updated (cleared, reseeded, or
+    # with only some faults armed)
+    new_faults = []
+    for f in spec.get("faults", []):
+        bad = set(f) - allowed
+        if bad:
+            raise BadRequest(f"unknown fault field(s): {sorted(bad)}")
+        if f.get("kind") not in chaos_inj.ALL_KINDS:
+            raise BadRequest(
+                f"unknown fault kind {f.get('kind')!r} "
+                f"(kinds: {', '.join(chaos_inj.ALL_KINDS)})")
+        fs = chaos_inj.FaultSpec(
+            kind=f["kind"],
+            prob=float(f.get("prob", 1.0)),
+            count=(int(f["count"])
+                   if f.get("count") is not None else None),
+            node=str(f.get("node", "")),
+            peer=str(f.get("peer", "")),
+            endpoint=str(f.get("endpoint", "")),
+            hash_prefix=str(f.get("hash_prefix", "")),
+            delay_s=float(f.get("delay_s", 0.05)),
+            rate_bps=float(f.get("rate_bps", 1 << 20)))
+        if not 0.0 <= fs.prob <= 1.0:
+            raise BadRequest("prob must be in [0, 1]")
+        new_faults.append(fs)
+    seed = int(spec["seed"]) if "seed" in spec else None
+    if spec.get("clear"):
+        ctl.clear()
+    if seed is not None:
+        ctl.reseed(seed)
+    for fs in new_faults:
+        ctl.add(fs)
+    if "enabled" in spec:
+        if spec["enabled"]:
+            chaos_inj.arm()
+        else:
+            chaos_inj.disarm(clear=False)
+    elif new_faults:
+        chaos_inj.arm()  # arming faults implies enabling
+    return ctl.state()
+
+
+def relabel_metrics(text: str, worker: str) -> list[str]:
+    """Stamp a `worker` label onto every sample line of a worker's
+    Prometheus text exposition (HELP/TYPE lines dropped — the store's
+    own render already carries them once). Merging N workers' renders
+    this way is what makes per-worker series addressable
+    (`api_request_duration_seconds_count{api="s3",worker="1"}`)."""
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name_labels, _, value = line.rpartition(" ")
+        if not name_labels:
+            continue
+        if name_labels.endswith("}"):
+            out.append(f'{name_labels[:-1]},worker="{worker}"}} {value}')
+        else:
+            out.append(f'{name_labels}{{worker="{worker}"}} {value}')
+    return out
+
+
 class AdminHttpServer:
     def __init__(self, garage, admin_rpc=None):
         self.garage = garage
@@ -89,6 +215,18 @@ class AdminHttpServer:
             # the metadata engine_stats() are COUNT(*) scans on sqlite —
             # at millions of rows a scrape must not stall the loop
             body = await asyncio.to_thread(self.render_metrics)
+            sup = getattr(self.garage, "gateway_supervisor", None)
+            if sup is not None:
+                # aggregate the worker processes' series under a
+                # `worker` label (best-effort: a worker mid-respawn is
+                # skipped, its absence shows in gateway_worker_up)
+                lines = []
+                for idx, res in (await sup.fanout({"op": "metrics"},
+                                                  timeout=15.0)).items():
+                    if isinstance(res, dict) and "text" in res:
+                        lines.extend(relabel_metrics(res["text"],
+                                                     str(idx)))
+                body += "\n".join(lines) + ("\n" if lines else "")
             return Response(200,
                             [("content-type",
                               "text/plain; version=0.0.4")],
@@ -153,51 +291,20 @@ class AdminHttpServer:
             # S3 data-plane knobs (README "S3 data-plane tuning"):
             # runtime-readable AND writable so bench sweeps don't need a
             # server restart per setting. Writes touch plain ints read
-            # fresh on every request — safe on a live node.
-            cfg = self.garage.config
-            cache = self.garage.block_manager.cache
+            # fresh on every request — safe on a live node. In gateway
+            # mode the write fans out to every worker process (theirs
+            # are the caches/configs actually serving traffic).
             if m == "POST":
                 spec = await body_json() or {}
-                # validate EVERYTHING before the first setattr — a 400
-                # must never leave half the update applied on a live
-                # node (same rule as the bucket-update handler below)
-                bounds = {"get_readahead_blocks": (0, 64),
-                          "put_blocks_max_parallel": (1, 64),
-                          # hot-block read cache (block/cache.py):
-                          # size + admission knobs, live-resizable so
-                          # bench sweeps flip the cache on/off without
-                          # a server restart (0 = disabled)
-                          "read_cache_max_bytes": (0, 1 << 40),
-                          "read_cache_probation_pct": (1, 90)}
-                validated = {}
-                for k, raw in spec.items():
-                    if k not in bounds:
-                        raise BadRequest(f"unknown s3 tuning knob {k!r}")
-                    lo, hi = bounds[k]
-                    v = int(raw)
-                    if v < lo or v > hi:
-                        raise BadRequest(f"{k} must be in [{lo}, {hi}]")
-                    validated[k] = v
-                for k, v in validated.items():
-                    if k == "read_cache_max_bytes":
-                        cfg.block_read_cache_max_bytes = v
-                        cache.configure(max_bytes=v)
-                    elif k == "read_cache_probation_pct":
-                        cache.configure(probation_pct=v)
-                    else:
-                        setattr(cfg, "s3_" + k, v)
+                state = apply_s3_tuning(self.garage, spec)
+                sup = getattr(self.garage, "gateway_supervisor", None)
+                if sup is not None and spec:
+                    state["workers"] = await sup.fanout(
+                        {"op": "tuning", "spec": spec})
+                return _json(state)
             elif m != "GET":
                 return None
-            from ..api.http import DRAIN_HIGH_WATER
-
-            return _json({
-                "get_readahead_blocks": cfg.s3_get_readahead_blocks,
-                "put_blocks_max_parallel": cfg.s3_put_blocks_max_parallel,
-                "drain_high_water": DRAIN_HIGH_WATER,
-                "read_cache_max_bytes": cache.max_bytes,
-                "read_cache_probation_pct": cache.probation_pct,
-                "read_cache": cache.stats(),
-            })
+            return _json(s3_tuning_state(self.garage))
 
         if path == "/v1/chaos":
             # fault injection control plane (garage_tpu/chaos/): GET
@@ -206,58 +313,22 @@ class AdminHttpServer:
             #        "faults": [{kind, prob, count, node, peer,
             #                    endpoint, hash_prefix, delay_s,
             #                    rate_bps}, ...]}
+            # Gateway mode: the spec ALSO fans out to every worker
+            # process — net/rpc faults scoped at the API side must fire
+            # in the processes actually making those calls.
             from ..chaos import injector as chaos_inj
 
-            ctl = chaos_inj.controller()
             if m == "POST":
                 spec = await body_json() or {}
-                allowed = {"kind", "prob", "count", "node", "peer",
-                           "endpoint", "hash_prefix", "delay_s",
-                           "rate_bps"}
-                # validate EVERYTHING before the first mutation — a 400
-                # must never leave the live controller half-updated
-                # (cleared, reseeded, or with only some faults armed)
-                new_faults = []
-                for f in spec.get("faults", []):
-                    bad = set(f) - allowed
-                    if bad:
-                        raise BadRequest(
-                            f"unknown fault field(s): {sorted(bad)}")
-                    if f.get("kind") not in chaos_inj.ALL_KINDS:
-                        raise BadRequest(
-                            f"unknown fault kind {f.get('kind')!r} "
-                            f"(kinds: {', '.join(chaos_inj.ALL_KINDS)})")
-                    fs = chaos_inj.FaultSpec(
-                        kind=f["kind"],
-                        prob=float(f.get("prob", 1.0)),
-                        count=(int(f["count"])
-                               if f.get("count") is not None else None),
-                        node=str(f.get("node", "")),
-                        peer=str(f.get("peer", "")),
-                        endpoint=str(f.get("endpoint", "")),
-                        hash_prefix=str(f.get("hash_prefix", "")),
-                        delay_s=float(f.get("delay_s", 0.05)),
-                        rate_bps=float(f.get("rate_bps", 1 << 20)))
-                    if not 0.0 <= fs.prob <= 1.0:
-                        raise BadRequest("prob must be in [0, 1]")
-                    new_faults.append(fs)
-                seed = int(spec["seed"]) if "seed" in spec else None
-                if spec.get("clear"):
-                    ctl.clear()
-                if seed is not None:
-                    ctl.reseed(seed)
-                for fs in new_faults:
-                    ctl.add(fs)
-                if "enabled" in spec:
-                    if spec["enabled"]:
-                        chaos_inj.arm()
-                    else:
-                        chaos_inj.disarm(clear=False)
-                elif new_faults:
-                    chaos_inj.arm()  # arming faults implies enabling
+                state = apply_chaos_spec(spec)
+                sup = getattr(self.garage, "gateway_supervisor", None)
+                if sup is not None:
+                    state["workers"] = await sup.fanout(
+                        {"op": "chaos", "spec": spec})
+                return _json(state)
             elif m != "GET":
                 return None
-            return _json(ctl.state())
+            return _json(chaos_inj.controller().state())
 
         if path == "/v1/metadata" and m == "GET":
             # metadata-engine observability (README "Metadata at
@@ -304,8 +375,38 @@ class AdminHttpServer:
                 raise BadRequest("qos engine not available")
             gov = getattr(self.garage, "qos_governor", None)
             gov_spec = spec.pop("governor", None)
+            sup = getattr(self.garage, "gateway_supervisor", None)
+            if sup is not None:
+                bad = sorted(k for k in spec
+                             if k in ("global_burst",
+                                      "global_bytes_burst"))
+                if bad:
+                    # not silently droppable: leases re-derive burst as
+                    # 1s of each worker's granted rate on every renew,
+                    # so a fanned-out burst would be overwritten within
+                    # one lease interval. Reject before applying
+                    # anything so the operator learns the limitation.
+                    raise BadRequest(
+                        f"{', '.join(bad)} cannot be set in gateway "
+                        "mode: worker burst is leased as 1s of each "
+                        "worker's granted rate (set global_rps / "
+                        "global_bytes_per_s instead)")
             if spec:
                 qos.update_limits(spec)
+            if sup is not None and spec:
+                # node-wide budgets feed the lease broker (each worker
+                # learns its new share at its next renew — conservation
+                # holds through the change); every other limit applies
+                # per worker process and fans out directly
+                if "global_rps" in spec:
+                    sup.broker.set_totals(rps=spec["global_rps"])
+                if "global_bytes_per_s" in spec:
+                    sup.broker.set_totals(
+                        bytes_per_s=spec["global_bytes_per_s"])
+                worker_spec = {k: v for k, v in spec.items()
+                               if not k.startswith("global_")}
+                if worker_spec:
+                    await sup.fanout({"op": "qos", "spec": worker_spec})
             if gov_spec is not None:
                 if gov is None:
                     raise BadRequest("governor not running "
@@ -326,6 +427,22 @@ class AdminHttpServer:
                     lo, hi = map(float, gov_spec["resync_range"])
                     gov.resync_range = (lo, hi)
             return _json(self._qos_state())
+
+        if path == "/v1/gateway" and m == "GET":
+            # multi-process gateway observability (gateway/supervisor):
+            # worker pids/liveness/restarts, per-worker leases, broker
+            # conservation. ?detail=1 additionally pulls each live
+            # worker's qos + tuning snapshots over RPC.
+            sup = getattr(self.garage, "gateway_supervisor", None)
+            if sup is None:
+                return _json({"enabled": False, "workers": []})
+            state = sup.state()
+            if q.get("detail"):
+                state["worker_qos"] = await sup.fanout(
+                    {"op": "qos_state"})
+                state["worker_tuning"] = await sup.fanout(
+                    {"op": "tuning_state"})
+            return _json(state)
 
         if path in ("/status", "/v1/status") and m == "GET":
             r = await self.rpc.op_status({})
@@ -557,6 +674,9 @@ class AdminHttpServer:
         gov = getattr(self.garage, "qos_governor", None)
         out = qos.state() if qos is not None else {}
         out["governor"] = gov.state() if gov is not None else None
+        sup = getattr(self.garage, "gateway_supervisor", None)
+        if sup is not None:
+            out["gateway_leases"] = sup.broker.state()
         return out
 
     async def _check_domain(self, req: Request) -> Response:
@@ -748,6 +868,33 @@ class AdminHttpServer:
             if st["p99_s"] is not None:
                 gauge("rpc_peer_p99_seconds", round(st["p99_s"], 6),
                       node=nid)
+
+        # multi-process gateway supervisor (gateway/supervisor.py):
+        # worker liveness + lease ledger. conservation_ok == 1 is the
+        # smoke/soak assertion that Σ(worker leases) never exceeded the
+        # node budget, including across worker kills.
+        sup = getattr(g, "gateway_supervisor", None)
+        if sup is not None:
+            st = sup.state()
+            gauge("gateway_workers_configured", st["workers_configured"],
+                  "Gateway worker processes configured")
+            gauge("gateway_workers_alive", st["workers_alive"])
+            gauge("gateway_worker_restarts_total", st["restarts_total"],
+                  "Worker processes respawned after a crash")
+            gauge("gateway_lease_conservation_ok",
+                  1 if st["broker"]["conservation_ok"] else 0,
+                  "Whether sum(worker leases) <= node budget held")
+            for dim, metric in (("rps", "gateway_lease_rps"),
+                                ("bytes_per_s",
+                                 "gateway_lease_bytes_per_s")):
+                d = st["broker"][dim]
+                for w, v in d["granted"].items():
+                    gauge(metric, v, worker=w.lstrip("w"))
+                if d["pool_free"] is not None:
+                    gauge(metric + "_free", d["pool_free"])
+            for w in st["workers"]:
+                gauge("gateway_worker_up", 1 if w["alive"] else 0,
+                      worker=str(w["index"]))
 
         # op counters/durations from the process-wide registry
         # (rpc/table/api/block series; ref: rpc/metrics.rs etc.)

@@ -15,6 +15,11 @@ Rules (each encodes an invariant an earlier PR established by hand):
   GL06 await-holding-lock       RPC awaited inside `async with lock:`
   GL07 unregistered-metric      dynamic / off-scheme metric names
   GL08 config-knob-drift        code<->utils/config.py key drift
+  GL09 cross-worker-state       module-level mutable state in the
+                                request plane (api/ qos/ gateway/ web/)
+                                mutated from function scope — process-
+                                local but semantically node-wide (the
+                                multi-process gateway's bug class)
   GL00 (framework)              stale waivers, stale baseline entries,
                                 unparseable files — cannot be waived
 
@@ -30,7 +35,8 @@ from .baseline import (DEFAULT_BASELINE, apply_baseline, load_baseline,
 from .core import META_RULE, FileContext, ProjectState, Rule, Violation
 from .rules_async import (AwaitHoldingLock, BlockingCallInAsync,
                           OrphanTask, SwallowedException)
-from .rules_project import ConfigKnobDrift, UnregisteredMetric
+from .rules_project import (ConfigKnobDrift, CrossWorkerState,
+                            UnregisteredMetric)
 from .rules_rpc import HedgeOnMutation, SsecCacheLeak
 from .walker import analyze_paths, analyze_source
 
@@ -43,6 +49,7 @@ RULE_CLASSES = [
     AwaitHoldingLock,      # GL06
     UnregisteredMetric,    # GL07
     ConfigKnobDrift,       # GL08
+    CrossWorkerState,      # GL09
 ]
 
 

@@ -71,9 +71,9 @@ class UnregisteredMetric(Rule):
 
 CONFIG_RECEIVERS = {"cfg", "config"}
 SECTION_ATTRS = {"tpu": "TpuConfig", "qos": "QosConfig",
-                 "chaos": "ChaosConfig"}
+                 "chaos": "ChaosConfig", "gateway": "GatewayConfig"}
 CONFIG_CLASSES = ("Config", "TpuConfig", "QosConfig", "ChaosConfig",
-                  "DataDir")
+                  "GatewayConfig", "DataDir")
 
 
 def _config_receiver(node: ast.AST) -> bool:
@@ -214,6 +214,150 @@ class ConfigKnobDrift(Rule):
                             "documented in README (dead knob?)",
                     context=cls))
         return out
+
+
+# ---- GL09 --------------------------------------------------------------
+
+# request-plane packages where module-level mutable state is
+# process-local but SEMANTICALLY node-wide: with the multi-process
+# gateway, N workers each get their own copy of such state, so counters
+# silently read 1/N, caches duplicate, and limits admit N× (exactly the
+# bug class ISSUE 8 creates). Node-wide state must live on an instance
+# wired through Garage (one per process, aggregated by the supervisor)
+# or be brokered (the qos lease protocol).
+CROSS_WORKER_DIRS = ("api/", "qos/", "gateway/", "web/")
+
+MUTATING_METHODS = {"append", "extend", "add", "update", "pop",
+                    "popitem", "clear", "remove", "discard",
+                    "setdefault", "insert", "appendleft", "__setitem__"}
+
+MUTABLE_CONSTRUCTORS = {"dict", "list", "set", "OrderedDict",
+                        "defaultdict", "deque", "Counter", "bytearray"}
+
+
+class CrossWorkerState(Rule):
+    id = "GL09"
+    name = "cross-worker-state"
+    summary = ("module-level mutable state in a request-plane package "
+               "mutated from function scope: process-local but "
+               "semantically node-wide — each gateway worker gets its "
+               "own copy (counters read 1/N, limits admit N×)")
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        if ctx.is_test:
+            return False
+        rel = ctx.rel_path
+        for d in CROSS_WORKER_DIRS:
+            if f"garage_tpu/{d}" in rel or rel.startswith(d):
+                return True
+        return False
+
+    def finish_file(self, ctx: FileContext) -> None:
+        # 1) module-level names bound to mutable containers
+        mutable: dict[str, ast.AST] = {}
+        for stmt in ctx.tree.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = [t for t in stmt.targets
+                           if isinstance(t, ast.Name)]
+                value = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Name) \
+                    and stmt.value is not None:
+                targets = [stmt.target]
+                value = stmt.value
+            else:
+                continue
+            is_mut = isinstance(value, (ast.Dict, ast.List, ast.Set)) \
+                or (isinstance(value, ast.Call)
+                    and call_name(value) in MUTABLE_CONSTRUCTORS)
+            if not is_mut:
+                continue
+            for t in targets:
+                mutable[t.id] = stmt
+        if not mutable:
+            return
+        # 2) ... that any function in the module mutates. Module-level
+        # init-time mutation (building a constant table at import) is
+        # fine; mutation from function scope is cross-request state.
+        flagged: set[str] = set()
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            for sub in _walk_own_scope(fn):
+                name = None
+                if isinstance(sub, (ast.Assign, ast.AugAssign,
+                                    ast.Delete)):
+                    tgts = (sub.targets
+                            if isinstance(sub, (ast.Assign, ast.Delete))
+                            else [sub.target])
+                    for t in tgts:
+                        if isinstance(t, ast.Subscript) \
+                                and isinstance(t.value, ast.Name):
+                            name = t.value.id
+                elif isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr in MUTATING_METHODS \
+                        and isinstance(sub.func.value, ast.Name):
+                    name = sub.func.value.id
+                if name in mutable and name not in flagged \
+                        and name not in _locally_bound(fn, name):
+                    flagged.add(name)
+                    ctx.report(
+                        self.id, mutable[name],
+                        f"module-level mutable `{name}` is mutated "
+                        f"from `{fn.name}`: process-local state that "
+                        "reads as node-wide — under the multi-process "
+                        "gateway every worker holds its own copy. "
+                        "Move it onto an instance wired through "
+                        "Garage, or lease/aggregate it via gateway/")
+
+    def finish_project(self, project: ProjectState) -> list[Violation]:
+        return []
+
+
+def _walk_own_scope(fn: ast.AST):
+    """Walk fn's body WITHOUT descending into nested def/lambda scopes
+    — a nested function's locals and mutations belong to the nested
+    function's own check, and letting them leak into the enclosing
+    scope both hides real module-state mutations (a nested
+    `NAME = {}` would shadow NAME for the whole outer body) and
+    invents false ones."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _locally_bound(fn: ast.AST, name: str) -> set[str]:
+    """Names shadowed inside fn (params or direct assignment) — a local
+    `queues = {}` mutated in the same function is not module state. An
+    explicit `global` declaration un-shadows: that IS module state.
+    Nested def/lambda scopes are excluded (their locals are theirs)."""
+    bound: set[str] = set()
+    declared_global: set[str] = set()
+    args = fn.args
+    for a in (args.args + args.kwonlyargs + args.posonlyargs
+              + ([args.vararg] if args.vararg else [])
+              + ([args.kwarg] if args.kwarg else [])):
+        bound.add(a.arg)
+    for sub in _walk_own_scope(fn):
+        if isinstance(sub, ast.Assign):
+            for t in sub.targets:
+                if isinstance(t, ast.Name):
+                    bound.add(t.id)
+        elif isinstance(sub, (ast.AnnAssign, ast.AugAssign)) \
+                and isinstance(sub.target, ast.Name):
+            bound.add(sub.target.id)
+        elif isinstance(sub, ast.Global):
+            declared_global |= set(sub.names)
+    bound -= declared_global
+    return bound if name in bound else set()
 
 
 def _parse_config_schema(tree: ast.Module) -> dict:

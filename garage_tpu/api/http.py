@@ -339,11 +339,20 @@ class HttpServer:
         self.bound_port: Optional[int] = None
         self.metrics = {"requests": 0, "errors": 0}
 
-    async def start(self, host: str, port: int) -> None:
+    async def start(self, host: str, port: int,
+                    reuse_port: bool = False) -> None:
         # default StreamReader limit is 64 KiB, which caps body reads
         # and costs ~16 loop iterations per 1 MiB block on the PUT path
+        #
+        # reuse_port=True is the multi-process gateway's accept loop:
+        # every worker binds the same port with SO_REUSEPORT and the
+        # kernel balances incoming connections across them (the
+        # nginx/Envoy worker model; gateway/worker.py)
+        kwargs = {"limit": 1 << 20}
+        if reuse_port:
+            kwargs["reuse_port"] = True
         self._server = await asyncio.start_server(self._conn, host, port,
-                                                  limit=1 << 20)
+                                                  **kwargs)
         self.bound_port = self._server.sockets[0].getsockname()[1]
         log.info("%s server listening on %s:%d", self.name, host, self.bound_port)
 

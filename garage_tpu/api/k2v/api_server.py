@@ -51,13 +51,15 @@ class K2VApiServer:
         self.region = region or garage.config.s3_region
         self.http = HttpServer(self.handle, name="k2v")
 
-    async def start(self, host: str, port=None) -> None:
+    async def start(self, host: str, port=None,
+                    reuse_port: bool = False) -> None:
         # a path (port None) binds a Unix-domain socket, like the
-        # reference's UnixOrTCPSocketAddress bind addresses
+        # reference's UnixOrTCPSocketAddress bind addresses; reuse_port
+        # is the gateway workers' SO_REUSEPORT shared accept loop
         if port is None:
             await self.http.start_unix(host)
         else:
-            await self.http.start(host, port)
+            await self.http.start(host, port, reuse_port=reuse_port)
 
     async def stop(self) -> None:
         await self.http.stop()

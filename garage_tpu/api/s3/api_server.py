@@ -11,9 +11,10 @@ import logging
 from typing import Optional
 
 from ...model.helper import GarageHelper
-from ...utils.error import BadRequest, NoSuchBucket, NoSuchKey
+from ...utils.error import (BadRequest, NoSuchBucket, NoSuchKey,
+                            QuorumError)
 from ..http import HttpError, HttpServer, Request, Response
-from ...qos.limiter import SlowDown
+from ...qos.limiter import CURRENT_QOS_KEY, SlowDown
 from ..signature import verify_request, wrap_body
 from . import bucket as bucket_handlers
 from . import delete as delete_handlers
@@ -66,13 +67,15 @@ class S3ApiServer:
         self.root_domain = root_domain or garage.config.root_domain
         self.http = HttpServer(self.handle, name="s3")
 
-    async def start(self, host: str, port=None) -> None:
+    async def start(self, host: str, port=None,
+                    reuse_port: bool = False) -> None:
         # a path (port None) binds a Unix-domain socket, like the
-        # reference's UnixOrTCPSocketAddress bind addresses
+        # reference's UnixOrTCPSocketAddress bind addresses; reuse_port
+        # is the gateway workers' SO_REUSEPORT shared accept loop
         if port is None:
             await self.http.start_unix(host)
         else:
-            await self.http.start(host, port)
+            await self.http.start(host, port, reuse_port=reuse_port)
 
     async def stop(self) -> None:
         await self.http.stop()
@@ -91,6 +94,9 @@ class S3ApiServer:
         return bucket, (key or None)
 
     async def handle(self, req: Request) -> Response:
+        # one conn task serves many keep-alive requests: the fairness
+        # key must never leak from one request into the next
+        qos_key_token = CURRENT_QOS_KEY.set(None)
         try:
             # global admission (qos/): requests/s + declared body bytes
             # + bounded concurrency, BEFORE SigV4 — shedding must stay
@@ -114,6 +120,15 @@ class S3ApiServer:
             return S3Error("NoSuchKey", 404, str(e)).response()
         except BadRequest as e:
             return S3Error("InvalidRequest", 400, str(e)).response()
+        except QuorumError as e:
+            # not enough replicas answered (node overload, a partition,
+            # or a gateway worker whose store is slow): a retryable 503,
+            # not an "internal error" — SDKs back off and retry 503s
+            return S3Error(
+                "ServiceUnavailable", 503,
+                f"quorum not reached: {e}").response()
+        finally:
+            CURRENT_QOS_KEY.reset(qos_key_token)
 
     async def _handle(self, req: Request) -> Response:
         verified = await verify_request(req, self.region,
@@ -132,6 +147,10 @@ class S3ApiServer:
             await qos.admit_scoped(
                 key_id=api_key.key_id if api_key is not None else None,
                 bucket=bucket_name)
+        if api_key is not None:
+            # fairness identity for every downstream byte charge (block
+            # reads, chunk shaping); reset by handle() per request
+            CURRENT_QOS_KEY.set(api_key.key_id)
 
         if bucket_name is None:
             if req.method == "GET":

@@ -220,6 +220,12 @@ class BlockManager:
         # served from RAM or from the store (background resync/scrub
         # reads don't come through rpc_get_block and stay uncharged)
         self.read_qos_charge = None
+        # worker-sharded read cache (gateway/): when set (gateway API
+        # workers only), cacheable reads are routed to the rendezvous-
+        # hash OWNER worker over loopback RPC so the node holds one
+        # decoded copy of a hot block instead of one per worker. The
+        # router duck-type is {owner_of(h), owns(h), forward(owner, h)}.
+        self.cache_router = None
         self.endpoint = system.netapp.endpoint("garage_tpu/block").set_handler(
             self._handle
         )
@@ -227,6 +233,9 @@ class BlockManager:
 
         self.resync = BlockResyncManager(
             self, db, breaker_aware=resync_breaker_aware)
+        # set by spawn_workers; pre-set so API-only processes (gateway
+        # workers never spawn block workers) can render metrics/state
+        self.scrub_worker = None
         self.metrics = {"bytes_read": 0, "bytes_written": 0,
                         "corruptions": 0, "resync_sent": 0,
                         "resync_recv": 0, "resync_bytes": 0}
@@ -340,8 +349,13 @@ class BlockManager:
             # reads (read-after-write). `data` is exactly the decoded
             # payload rpc_get_block returns. SSE-C callers pass
             # cacheable=False — never cache payloads the node cannot
-            # re-derive without the client's key.
-            if cacheable:
+            # re-derive without the client's key. Under a sharded
+            # gateway cache only the OWNER worker keeps the copy (a
+            # non-owner write-through would recreate the N-duplicates
+            # problem the sharding exists to kill; the owner fills on
+            # first read instead).
+            if cacheable and (self.cache_router is None
+                              or self.cache_router.owns(hash32)):
                 self.cache.insert(hash32, data)
         finally:
             self._ram_sem.release(len(data))
@@ -414,26 +428,53 @@ class BlockManager:
     # ==== cluster read path (ref: manager.rs:243-363) ===================
 
     async def rpc_get_block(self, hash32: bytes,
-                            cacheable: bool = True) -> bytes:
+                            cacheable: bool = True, route: bool = True,
+                            charge: bool = True) -> bytes:
         """Decoded block payload. A read-cache hit returns without any
         block RPC — in erasure mode that means the whole shard gather +
         RS decode + verify is skipped. `cacheable=False` (SSE-C) both
-        bypasses the lookup and suppresses the miss fill."""
-        charge = self.read_qos_charge
+        bypasses the lookup and suppresses the miss fill — and, on a
+        gateway worker, also skips cross-worker routing, so an SSE-C
+        payload never crosses a worker boundary.
+
+        `route=False` serves locally even when a gateway cache router
+        is installed (the owner-side handler of a forwarded read uses
+        it — one hop, never a chain). `charge=False` skips the qos byte
+        charge (the FORWARDING worker charges its own lease for bytes
+        it serves to its client; the owner must not double-charge)."""
+        charge_fn = self.read_qos_charge if charge else None
+        fill = cacheable
         if cacheable:
             data = self.cache.get(hash32)
             if data is not None:
-                if charge is not None:
-                    await charge(len(data))
+                if charge_fn is not None:
+                    await charge_fn(len(data))
                 return data
+            # routing exists to exploit the OWNER's cache; with the
+            # cache disabled (read_cache_max_bytes = 0) a forward is a
+            # guaranteed miss plus a second loopback hop — skip it
+            router = (self.cache_router
+                      if route and self.cache.max_bytes > 0 else None)
+            if router is not None:
+                owner = router.owner_of(hash32)
+                if owner is not None:
+                    data = await router.forward(owner, hash32)
+                    if data is not None:
+                        if charge_fn is not None:
+                            await charge_fn(len(data))
+                        return data
+                    # owner unreachable: serve from the store directly,
+                    # WITHOUT filling our cache — a transient forward
+                    # failure must not seed duplicate copies
+                    fill = False
         data = await self._get_uncached(hash32)
-        if cacheable:
+        if fill:
             self.cache.insert(hash32, data)
-        if charge is not None:
+        if charge_fn is not None:
             # charged symmetrically with the hit path above: a byte
             # budget that only priced one of RAM/store reads would
             # invert the cache's advantage (or let hot sets ride free)
-            await charge(len(data))
+            await charge_fn(len(data))
         return data
 
     async def _get_uncached(self, hash32: bytes) -> bytes:

@@ -62,10 +62,34 @@ async def run_server(cfg_path: str) -> None:
             host, port = parse_addr(bind)
             await srv.start(host, port)
 
+    # multi-process gateway ([gateway] workers != 1 with at least one
+    # TCP frontend bind): this process becomes the store node +
+    # supervisor — it keeps RPC, tables, block/resync/scrub workers and
+    # the admin API, while N forked workers bind the S3/K2V/web ports
+    # with SO_REUSEPORT (gateway/). workers = 1 (the default) keeps the
+    # single-process frontends below, byte-compatible with before.
+    from ..gateway.supervisor import GatewaySupervisor, resolve_workers
+
+    n_workers = resolve_workers(cfg.gateway.workers)
+    gateway_mode = n_workers > 1 and any(
+        b and not b.startswith("/")
+        for b in (cfg.s3_api_bind_addr, cfg.k2v_api_bind_addr,
+                  cfg.web_bind_addr))
+    if n_workers > 1 and not gateway_mode:
+        # same misconfiguration class GatewaySupervisor.start rejects
+        # loudly for MIXED unix+TCP binds — the all-unix (or no-
+        # frontend) shape must not silently run single-process while
+        # the operator believes they have N workers
+        log.warning(
+            "[gateway] workers = %d ignored: no TCP frontend binds "
+            "(SO_REUSEPORT does not apply to unix sockets); running "
+            "the single-process frontend", n_workers)
+
     system_task = asyncio.create_task(garage.run())
     servers = []
+    supervisor = None
     s3 = None
-    if cfg.s3_api_bind_addr:
+    if cfg.s3_api_bind_addr and not gateway_mode:
         s3 = S3ApiServer(garage)
         await start_frontend(s3, cfg.s3_api_bind_addr)
         servers.append(s3)
@@ -75,24 +99,30 @@ async def run_server(cfg_path: str) -> None:
         ad = AdminHttpServer(garage, admin_rpc=admin)
         await start_frontend(ad, cfg.admin_api_bind_addr)
         servers.append(ad)
-    if cfg.k2v_api_bind_addr:
+    if cfg.k2v_api_bind_addr and not gateway_mode:
         from ..api.k2v.api_server import K2VApiServer
 
         k2v = K2VApiServer(garage)
         await start_frontend(k2v, cfg.k2v_api_bind_addr)
         servers.append(k2v)
-    if cfg.web_bind_addr:
+    if cfg.web_bind_addr and not gateway_mode:
         from ..web.server import WebServer
 
         web = WebServer(garage, s3)
         await start_frontend(web, cfg.web_bind_addr)
         servers.append(web)
+    if gateway_mode:
+        supervisor = GatewaySupervisor(garage, cfg_path,
+                                       n_workers=n_workers)
+        await supervisor.start()
 
     log.info("node %s up (rpc %s)", garage.system.id.hex()[:16],
              cfg.rpc_bind_addr)
     print(f"garage_tpu node {garage.system.id.hex()} ready", flush=True)
     await stop.wait()
     log.info("shutting down")
+    if supervisor is not None:
+        await supervisor.stop()
     for s in servers:
         await s.stop()
     await garage.stop()

@@ -198,7 +198,7 @@ class Garage:
             per_key_rps=qc.per_key_rps,
             per_bucket_rps=qc.per_bucket_rps,
             max_concurrent=qc.max_concurrent, max_queue=qc.max_queue,
-            max_wait_s=qc.max_wait_s,
+            max_wait_s=qc.max_wait_s, fair_keys=qc.fair_keys,
         ))
         # foreground block-read bytes (cache hit AND store miss alike)
         # consume the qos bytes budget (shape_bytes never sheds, it

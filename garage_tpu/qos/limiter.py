@@ -21,10 +21,21 @@ sleeping.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import math
 import time
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
+
+# identity of the request currently executing on this task, set by the
+# API frontends once auth resolves (api/s3/api_server.py). Charges deep
+# in the stack (block reads, chunk shaping) read it so per-key fairness
+# works without threading a key argument through every seam; tasks
+# spawned by a request (readahead prefetch) inherit it by contextvar
+# copy semantics.
+CURRENT_QOS_KEY: contextvars.ContextVar[Optional[str]] = \
+    contextvars.ContextVar("garage_qos_key", default=None)
 
 
 class SlowDown(Exception):
@@ -200,6 +211,9 @@ class QosLimits:
     max_queue: int = 64
     # the bounded wait an admission may spend queued before shedding
     max_wait_s: float = 0.5
+    # deficit round-robin across per-key queues when the bytes bucket
+    # is contended (see DeficitRoundRobin below)
+    fair_keys: bool = True
 
     def to_dict(self) -> dict:
         return {k: getattr(self, k) for k in self.__dataclass_fields__}
@@ -224,6 +238,10 @@ class QosCounters:
     queued_waits: int = 0
     queued_seconds: float = 0.0
     shaped_bytes: int = 0
+    # bytes the node was ASKED to move (declared at admission or shaped
+    # mid-stream), admitted or not: this is offered load, the demand
+    # signal the gateway lease broker rebalances worker budgets by
+    offered_bytes: int = 0
     shed_by_scope: dict = field(default_factory=dict)
     # WHO is being shed, not just how much (ROADMAP "503 retry
     # ergonomics"): per-key and per-bucket shed counts, surfaced top-N
@@ -248,10 +266,113 @@ class QosCounters:
             "queued_waits": self.queued_waits,
             "queued_seconds": round(self.queued_seconds, 6),
             "shaped_bytes": self.shaped_bytes,
+            "offered_bytes": self.offered_bytes,
             "shed_by_scope": dict(self.shed_by_scope),
             "top_shed_keys": self._top(self.shed_by_key, top_n),
             "top_shed_buckets": self._top(self.shed_by_bucket, top_n),
         }
+
+
+class DeficitRoundRobin:
+    """Per-key fairness inside one shared TokenBucket (Shreedhar &
+    Varghese deficit round-robin, applied to the qos bytes budget).
+
+    Uncontended, this is invisible: a submit with no queued work and a
+    bucket that can grant right now takes the fast path (one
+    try_acquire, no task, no future). Under contention, draws queue
+    per-key and a pump task drains the queues round-robin: each sweep
+    credits every active key one `quantum` of deficit and releases that
+    key's FIFO head(s) while the deficit and the bucket cover them — so
+    K backlogged keys each get ~1/K of the drain rate regardless of how
+    much one of them has queued (the bounded-share property pinned by
+    tests/test_gateway.py).
+
+    Never sheds: shaping applies to requests that were already
+    admitted (the concurrency limiter bounds how many of those exist,
+    which bounds the queues). Cancellation-safe: a waiter abandoned
+    mid-queue is skipped at grant time and its bytes are never drawn.
+    `sleep` is injectable so tests drive the pump on a fake clock.
+    """
+
+    def __init__(self, bucket: TokenBucket, quantum: float = 64 * 1024,
+                 sleep=asyncio.sleep):
+        self.bucket = bucket
+        self.quantum = float(quantum)
+        self.sleep = sleep
+        # key -> FIFO of (nbytes, future); OrderedDict = round-robin
+        # order (a drained key re-registers at the tail)
+        self._queues: "OrderedDict[str, deque]" = OrderedDict()
+        self._deficit: dict[str, float] = {}
+        self._pump_task: Optional[asyncio.Task] = None
+        self.granted = 0
+        self.sweeps = 0
+
+    @property
+    def queued(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    async def submit(self, key: str, n: float) -> None:
+        if not self._queues and self.bucket.try_acquire(n):
+            return  # fast path: no backlog, tokens on hand
+        fut = asyncio.get_running_loop().create_future()
+        q = self._queues.get(key)
+        if q is None:
+            q = self._queues[key] = deque()
+            self._deficit.setdefault(key, 0.0)
+        q.append((float(n), fut))
+        if self._pump_task is None or self._pump_task.done():
+            from ..utils.background import spawn
+
+            self._pump_task = spawn(self._pump(), "qos-drr-pump")
+        try:
+            await fut
+        except BaseException:
+            # abandoned waiter: leave the entry in place (cheap), the
+            # pump skips cancelled futures without drawing their bytes
+            raise
+
+    async def _pump(self) -> None:
+        # rotating round-robin: each iteration visits the HEAD key and
+        # moves it to the tail, so after a token-exhaustion sleep the
+        # next refill goes to the NEXT key in the circle, not back to
+        # the same front-runner — this rotation is what makes one
+        # refill-sized trickle still split evenly across keys
+        while self._queues:
+            key = next(iter(self._queues))
+            self._queues.move_to_end(key)
+            q = self._queues[key]
+            # deficit grows one quantum per visit, capped so an idle
+            # spell cannot bank unbounded burst (but always enough to
+            # eventually cover the key's largest queued draw)
+            self._deficit[key] = min(
+                self._deficit[key] + self.quantum,
+                self.quantum + max((n for n, _ in q), default=0.0))
+            blocked: Optional[float] = None
+            while q:
+                n, fut = q[0]
+                if fut.cancelled():
+                    q.popleft()
+                    continue
+                if n > self._deficit[key]:
+                    break  # deficit-capped: more credit next visit
+                if not self.bucket.try_acquire(n):
+                    blocked = n
+                    break
+                q.popleft()
+                self._deficit[key] -= n
+                self.granted += 1
+                if not fut.done():
+                    fut.set_result(None)
+            if not q:
+                del self._queues[key]
+                del self._deficit[key]
+            self.sweeps += 1
+            if blocked is not None:
+                # out of tokens: sleep until the blocked head could be
+                # granted; the rotation already put us at the tail, so
+                # the refill is offered to the next key first
+                await self.sleep(max(self.bucket.wait_for(blocked),
+                                     0.001))
 
 
 class QosEngine:
@@ -273,6 +394,7 @@ class QosEngine:
         self._conc: Optional[ConcurrencyLimiter] = None
         self._key_buckets: dict[str, TokenBucket] = {}
         self._bucket_buckets: dict[str, TokenBucket] = {}
+        self._fair: Optional[DeficitRoundRobin] = None
         self.limits = QosLimits()
         self.set_limits(limits or QosLimits())
 
@@ -299,6 +421,12 @@ class QosEngine:
                                              burst)
         else:
             self._bytes_bucket = None
+        if self._bytes_bucket is not None and limits.fair_keys:
+            if self._fair is None or self._fair.bucket \
+                    is not self._bytes_bucket:
+                self._fair = DeficitRoundRobin(self._bytes_bucket)
+        else:
+            self._fair = None
         if limits.max_concurrent is not None:
             if self._conc is None:
                 self._conc = ConcurrencyLimiter(limits.max_concurrent,
@@ -399,17 +527,31 @@ class QosEngine:
         cache[key] = b  # re-insert = move to MRU position
         return b
 
-    async def shape_bytes(self, n: int) -> None:
+    async def shape_bytes(self, n: int, key: Optional[str] = None) -> None:
         """Mid-stream byte shaping for bodies whose length was unknown
-        at admission (chunked uploads): never sheds — the request was
-        already accepted and aborting it would waste the work done — it
-        just slows the read loop to the configured byte rate."""
+        at admission (chunked uploads) and for block reads served from
+        cache or store: never sheds — the request was already accepted
+        and aborting it would waste the work done — it just slows the
+        read loop to the configured byte rate.
+
+        With `fair_keys` on and an identity in hand (the `key` argument
+        or the request's CURRENT_QOS_KEY contextvar), contended draws
+        go through the deficit round-robin so every active key gets an
+        equal share of the drain instead of whoever queued first."""
         b = self._bytes_bucket
         if b is None or n <= 0:
             return
+        self.counters.shaped_bytes += n
+        self.counters.offered_bytes += n
+        fair = self._fair
+        if fair is not None:
+            if key is None:
+                key = CURRENT_QOS_KEY.get()
+            if key is not None:
+                await fair.submit(key, float(n))
+                return
         wait = b.wait_for(float(n))
         b.tokens -= float(n)
-        self.counters.shaped_bytes += n
         if wait > 0:
             await asyncio.sleep(wait)
 
@@ -440,6 +582,12 @@ class _Admission:
         eng, lim = self.eng, self.eng.limits
         from ..utils.metrics import registry
 
+        # offered load is counted whether or not admission succeeds:
+        # the gateway lease broker rebalances worker budgets by what
+        # was ASKED of each worker, and a shedding worker is exactly
+        # the one whose lease needs to grow
+        if self.nbytes:
+            eng.counters.offered_bytes += self.nbytes
         # stages debited so far, refunded when a LATER stage sheds —
         # a rejected request must not consume the budgets it passed
         debits: list = []

@@ -118,8 +118,14 @@ class TableFullReplication(TableReplication):
         return self._all_nodes()
 
     def read_nodes(self, hash32):
-        # reads are served locally: this node always has a full copy
-        return [self.system.id]
+        # reads are served locally: a STORAGE node always has a full
+        # copy. A gateway node (capacity-less; e.g. a multi-process
+        # gateway API worker) holds none — it reads from the holders
+        # over RPC instead of answering from its empty local table.
+        nodes = self._all_nodes()
+        if self.system.id in nodes:
+            return [self.system.id]
+        return nodes
 
     def read_quorum(self):
         return 1

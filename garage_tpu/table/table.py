@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Optional
 
 from ..net.message import PRIO_NORMAL
@@ -23,10 +24,19 @@ from ..rpc.rpc_helper import RequestStrategy, RpcHelper
 from ..utils.error import QuorumError
 from .data import TableData
 from .merkle import MerkleUpdater
-from .replication import TableReplication
+from .replication import TableFullReplication, TableReplication
 from .schema import Entry, TableSchema, partition_hash
 
 log = logging.getLogger("garage_tpu.table")
+
+# gateway-node control-table read cache: staleness bound (seconds) for
+# full-copy rows read over RPC by a node that holds no local copy. The
+# bound mirrors what storage nodes already tolerate (anti-entropy lag);
+# a worker's OWN writes invalidate immediately.
+GATEWAY_READ_TTL = 2.0
+GATEWAY_READ_CACHE_MAX = 1024
+
+_MISS = object()
 
 
 class Table:
@@ -44,6 +54,14 @@ class Table:
         ).set_handler(self._handle)
         # background read-repair tasks (kept so tests/shutdown can drain)
         self._repairs: set[asyncio.Task] = set()
+        # (pk, sk) -> (expiry, entry|None); only populated on gateway
+        # nodes reading full-copy tables over RPC (see _get_traced)
+        self._control_cache: dict[tuple, tuple[float, Optional[Entry]]] \
+            = {}
+        # (pk, sk) -> monotonic time of this node's last completed
+        # write: a read that BEGAN before that write may carry the
+        # pre-write row and must not repopulate the cache
+        self._control_inval: dict[tuple, float] = {}
 
     def spawn_workers(self, runner) -> None:
         from .gc import TableGc
@@ -58,6 +76,51 @@ class Table:
 
     # ---- client ops ----------------------------------------------------
 
+    def _control_cache_get(self, key: tuple):
+        hit = self._control_cache.get(key)
+        if hit is None:
+            return _MISS
+        expiry, entry = hit
+        if expiry < time.monotonic():
+            del self._control_cache[key]
+            return _MISS
+        return entry
+
+    def _control_cache_put(self, key: tuple, entry: Optional[Entry],
+                           read_started: float) -> None:
+        if self._control_inval.get(key, -1.0) >= read_started:
+            # this node completed a write to the key after the read
+            # began: the fetched row may predate the write — caching it
+            # would break read-your-writes for a full TTL
+            return
+        if len(self._control_cache) >= GATEWAY_READ_CACHE_MAX:
+            # wholesale reset beats tracking LRU for a cache this small
+            # and this short-lived
+            self._control_cache.clear()
+        self._control_cache[key] = (time.monotonic() + GATEWAY_READ_TTL,
+                                    entry)
+
+    def _control_invalidate(self, key: tuple) -> None:
+        # fencing only exists where the cache does: gateway nodes
+        # reading a full-copy table over RPC. Everywhere else —
+        # including the hot sharded-table bulk-insert path and storage
+        # nodes' control writes — this must stay O(1) and must not
+        # accumulate state (an unconditional record would grow the
+        # inval map with every insert and rebuild it per write once
+        # past the cap).
+        rep = self.replication
+        if not isinstance(rep, TableFullReplication) \
+                or self.rpc.system.id in rep.storage_nodes(b""):
+            return
+        self._control_cache.pop(key, None)
+        now = time.monotonic()
+        if len(self._control_inval) >= GATEWAY_READ_CACHE_MAX:
+            # entries only matter for one TTL: prune instead of growing
+            self._control_inval = {
+                k: t for k, t in self._control_inval.items()
+                if t > now - GATEWAY_READ_TTL}
+        self._control_inval[key] = now
+
     async def insert(self, entry: Entry) -> None:
         """ref: table/table.rs:106-144."""
         from ..utils.metrics import registry
@@ -66,6 +129,13 @@ class Table:
         registry().inc("table_put_total", table=self.name)
         async with span("table.insert", table=self.name):
             await self._insert_traced(entry)
+        # read-your-writes through the gateway control cache: this
+        # node's own mutation must be visible on its next read. Runs
+        # AFTER the quorum write, and also fences concurrent reads
+        # that began before it (they must not repopulate the cache
+        # with the pre-write row — see _control_cache_put).
+        self._control_invalidate(
+            (entry.partition_key(), entry.sort_key()))
 
     async def _insert_traced(self, entry: Entry) -> None:
         raw = self.schema.encode_entry(entry)
@@ -112,6 +182,10 @@ class Table:
                 make_payload=lambda n: {"op": "update",
                                         "entries": per_node.get(n, [])},
             )
+        # after the quorum write, same fencing as insert()
+        for e in entries:
+            self._control_invalidate(
+                (e.partition_key(), e.sort_key()))
 
     async def get(self, pk: bytes, sk: bytes) -> Optional[Entry]:
         """Read-quorum get with CRDT merge + background read-repair.
@@ -126,6 +200,20 @@ class Table:
     async def _get_traced(self, pk: bytes, sk: bytes) -> Optional[Entry]:
         ph = partition_hash(pk)
         nodes = self.replication.read_nodes(ph)
+        # Gateway node reading a full-copy (control) table: it holds no
+        # local copy, so every auth/bucket resolve would cost an RPC to
+        # the holders — on an API worker that is 4+ round-trips per S3
+        # request for rows that change rarely. A short-TTL read-through
+        # cache bounds staleness to GATEWAY_READ_TTL seconds, the same
+        # order as the anti-entropy lag storage nodes already tolerate.
+        gateway_remote = (isinstance(self.replication,
+                                     TableFullReplication)
+                          and self.rpc.system.id not in nodes)
+        read_started = time.monotonic()
+        if gateway_remote:
+            hit = self._control_cache_get((pk, sk))
+            if hit is not _MISS:
+                return hit
         resps = await self.rpc.try_call_many(
             self.endpoint,
             nodes,
@@ -144,6 +232,8 @@ class Table:
             merged_raw = self.schema.encode_entry(ret)
             if any(r != merged_raw for r in raws):
                 self._spawn_repair([ret])
+        if gateway_remote:
+            self._control_cache_put((pk, sk), ret, read_started)
         return ret
 
     async def get_range(self, pk: bytes, start_sk: Optional[bytes] = None,

@@ -59,6 +59,12 @@ class QosConfig:
     max_concurrent: Optional[int] = None
     max_queue: int = 64
     max_wait_s: float = 0.5
+    # deficit round-robin across per-key queues INSIDE the global bytes
+    # bucket (qos/limiter.py DeficitRoundRobin): under byte-budget
+    # contention every active key gets an equal share of the drain
+    # instead of first-come-first-served, so one hot key cannot
+    # monopolize a worker's lease before per-key limits bite
+    fair_keys: bool = True
     governor: bool = True
     governor_interval: float = 2.0
     governor_target_latency: float = 0.05  # seconds
@@ -70,6 +76,33 @@ class QosConfig:
     # signal saturates (rebalance yields to foreground p99 during a
     # cluster resize; README "Cluster resize")
     resync_backlog_ref: float = 256.0
+
+
+@dataclass
+class GatewayConfig:
+    """[gateway] multi-process S3/K2V/web frontend (garage_tpu/gateway/;
+    no reference analogue; README "Multi-process gateway"). `workers`
+    selects how many API worker processes share the frontend ports via
+    SO_REUSEPORT: 1 (default) keeps today's in-process frontends —
+    byte-compatible with every prior release — and 0 means
+    auto(cpu_count). With N > 1 the main process becomes the store node
+    + supervisor (no S3 frontend of its own): it forks N API-only
+    worker nodes, rents each a lease on the node's qos budgets
+    (rebalanced by observed demand every `lease_interval_s`, reclaimed
+    `lease_ttl_s` after a worker goes silent), respawns crashed workers
+    no faster than `respawn_backoff_s`, and aggregates their /metrics
+    under a `worker` label. `cache_shard` routes cacheable block reads
+    to a consistent-hash owner worker so the node holds ONE decoded
+    copy of a hot block instead of N. `min_share` is the fraction of a
+    worker's fair share it always keeps leased even when idle (the
+    demand-discovery floor)."""
+
+    workers: int = 1
+    lease_interval_s: float = 1.0
+    lease_ttl_s: float = 3.0
+    min_share: float = 0.05
+    respawn_backoff_s: float = 2.0
+    cache_shard: bool = True
 
 
 @dataclass
@@ -191,6 +224,7 @@ class Config:
     tpu: TpuConfig = field(default_factory=TpuConfig)
     qos: QosConfig = field(default_factory=QosConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    gateway: GatewayConfig = field(default_factory=GatewayConfig)
 
     @property
     def data_dirs(self) -> list[DataDir]:
@@ -339,7 +373,7 @@ def read_config(path: str) -> Config:
 def config_from_dict(raw: dict) -> Config:
     cfg = Config()
     simple_fields = {f.name for f in dataclasses.fields(Config)} \
-        - {"data_dir", "tpu", "qos", "chaos"}
+        - {"data_dir", "tpu", "qos", "chaos", "gateway"}
     for key, val in raw.items():
         if key == "data_dir":
             cfg.data_dir = _parse_data_dir(val)
@@ -349,6 +383,8 @@ def config_from_dict(raw: dict) -> Config:
             cfg.qos = QosConfig(**val)
         elif key == "chaos" and isinstance(val, dict):
             cfg.chaos = ChaosConfig(**val)
+        elif key == "gateway" and isinstance(val, dict):
+            cfg.gateway = GatewayConfig(**val)
         elif key in ("s3_api", "k2v_api", "admin", "web", "block", "rpc",
                      "table", "metadata",
                      "consul_discovery", "kubernetes_discovery"):

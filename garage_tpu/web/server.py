@@ -50,13 +50,15 @@ class WebServer:
         self.http = HttpServer(self.handle, name="web")
         self.metrics = {"requests": 0, "errors": 0}
 
-    async def start(self, host: str, port=None) -> None:
+    async def start(self, host: str, port=None,
+                    reuse_port: bool = False) -> None:
         # a path (port None) binds a Unix-domain socket, like the
-        # reference's UnixOrTCPSocketAddress bind addresses
+        # reference's UnixOrTCPSocketAddress bind addresses; reuse_port
+        # is the gateway workers' SO_REUSEPORT shared accept loop
         if port is None:
             await self.http.start_unix(host)
         else:
-            await self.http.start(host, port)
+            await self.http.start(host, port, reuse_port=reuse_port)
 
     async def stop(self) -> None:
         await self.http.stop()

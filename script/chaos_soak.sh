@@ -50,4 +50,15 @@ say "metadata smoke: bench_metadata --keys $META_KEYS"
 JAX_PLATFORMS=cpu GARAGE_TPU_DEVICE=off "$PY" bench.py bench_metadata \
     --keys "$META_KEYS"
 
+# multi-process gateway smoke (ISSUE 8): the forked 2-worker
+# integration drill (traffic through the shared SO_REUSEPORT port,
+# worker kill + respawn, lease conservation) plus a 1-vs-2-worker
+# bench_gateway sweep so frontend-scaling regressions land in the
+# nightly trajectory. GATEWAY_WORKERS overridable for bigger boxes.
+GATEWAY_WORKERS="${GATEWAY_WORKERS:-1,2}"
+say "gateway smoke: 2-worker kill/respawn drill + bench_gateway --workers $GATEWAY_WORKERS"
+"$PY" -m pytest tests/test_gateway.py -q -p no:cacheprovider \
+    -k "end_to_end or kill_respawn"
+"$PY" bench.py bench_gateway --workers "$GATEWAY_WORKERS" --nobj 8
+
 say "chaos soak OK"

@@ -320,4 +320,113 @@ for _ in $(seq 1 40); do
 done
 [ "$BACKLOG" = "0" ] || die "resync backlog did not drain after restart ($BACKLOG)"
 
+say "gateway: 2 SO_REUSEPORT workers, kill one, zero failed retried ops (ISSUE 8)"
+# a separate single-store node with [gateway] workers = 2: the main
+# process is store + supervisor (admin only), two forked workers share
+# the S3 port. Kill one worker mid-traffic: every op (with connection-
+# error retries, as any S3 SDK does) must still succeed on the
+# survivor, the dead worker's qos lease must drain back to the pool
+# (conservation gauge stays 1), and the supervisor must respawn it.
+GWDIR="$TMP/gw"; mkdir -p "$GWDIR"
+GW_RPC=$(free_port); GW_S3=$(free_port); GW_ADM=$(free_port)
+cat > "$GWDIR/garage.toml" <<EOF
+metadata_dir = "$GWDIR/meta"
+data_dir = "$GWDIR/data"
+replication_factor = 1
+db_engine = "sqlite"
+block_size = 65536
+rpc_bind_addr = "127.0.0.1:$GW_RPC"
+rpc_public_addr = "127.0.0.1:$GW_RPC"
+
+[s3_api]
+api_bind_addr = "127.0.0.1:$GW_S3"
+s3_region = "garage"
+root_domain = ".s3.garage.test"
+
+[admin]
+api_bind_addr = "127.0.0.1:$GW_ADM"
+admin_token = "smoke-admin-token"
+
+[gateway]
+workers = 2
+lease_interval_s = 0.3
+respawn_backoff_s = 0.5
+
+[qos]
+global_rps = 500
+EOF
+"$PY" -m garage_tpu.cli.server --config "$GWDIR/garage.toml" \
+    --log-level warning > "$GWDIR/log" 2>&1 &
+PIDS+=($!)
+for _ in $(seq 1 120); do
+    grep -q ready "$GWDIR/log" 2>/dev/null && break
+    sleep 0.5
+done
+grep -q ready "$GWDIR/log" || { cat "$GWDIR/log"; die "gateway server did not come up"; }
+GWNODE=$("$PY" -m garage_tpu.cli.main --config "$GWDIR/garage.toml" status \
+    | awk '/^node id:/{print $NF}')
+"$PY" -m garage_tpu.cli.main --config "$GWDIR/garage.toml" \
+    layout assign "$GWNODE" -z dc1 -c 1G >/dev/null
+"$PY" -m garage_tpu.cli.main --config "$GWDIR/garage.toml" \
+    layout apply >/dev/null
+GWKEYS=$("$PY" -m garage_tpu.cli.main --config "$GWDIR/garage.toml" \
+    key new --name smoke-gw)
+GW_KEY=$(echo "$GWKEYS" | awk '/^Key ID:/{print $NF}')
+GW_SECRET=$(echo "$GWKEYS" | awk '/^Secret key:/{print $NF}')
+"$PY" -m garage_tpu.cli.main --config "$GWDIR/garage.toml" \
+    key allow "$GW_KEY" --create-bucket >/dev/null
+# worker-labeled metrics prove the supervisor aggregates both workers
+GWM=$(curl -sfm 20 -H "Authorization: Bearer smoke-admin-token" \
+    "http://127.0.0.1:$GW_ADM/metrics")
+echo "$GWM" | grep -q 'worker="0"' || die "no worker=0 series in gateway /metrics"
+echo "$GWM" | grep -q 'worker="1"' || die "no worker=1 series in gateway /metrics"
+echo "$GWM" | grep -q '^gateway_lease_conservation_ok 1' \
+    || die "lease conservation not asserted before kill"
+# drive PUT/GET with retries while a worker is SIGKILLed mid-loop
+WORKER_PID=$(curl -sf -H "Authorization: Bearer smoke-admin-token" \
+    "http://127.0.0.1:$GW_ADM/v1/gateway" \
+    | "$PY" -c 'import json,sys; print(json.load(sys.stdin)["workers"][0]["pid"])')
+GWFAIL=$("$PY" - "$GW_S3" "$GW_KEY" "$GW_SECRET" "$WORKER_PID" <<'PYEOF'
+import os, signal, sys, time
+sys.path.insert(0, "tests")
+from s3util import S3Client
+port, key, secret, wpid = int(sys.argv[1]), sys.argv[2], sys.argv[3], int(sys.argv[4])
+c = S3Client("127.0.0.1", port, key, secret)
+assert c.request("PUT", "/gwsmoke")[0] == 200
+data = os.urandom(100_000)
+failed = 0
+for i in range(40):
+    if i == 10:
+        os.kill(wpid, signal.SIGKILL)  # mid-loop worker kill
+    for attempt in range(4):
+        try:
+            st, _, _ = c.request("PUT", f"/gwsmoke/o{i}", body=data,
+                                 unsigned_payload=True)
+            assert st == 200
+            st, _, got = c.request("GET", f"/gwsmoke/o{i}")
+            assert st == 200 and got == data
+            break
+        except Exception:
+            if attempt == 3:
+                failed += 1
+            time.sleep(0.05)
+print(failed)
+PYEOF
+)
+[ "$GWFAIL" = "0" ] || die "$GWFAIL gateway ops failed after retries during worker kill"
+# lease drained + conserved, and the worker respawned
+for _ in $(seq 1 40); do
+    GWALIVE=$(curl -s -H "Authorization: Bearer smoke-admin-token" \
+        "http://127.0.0.1:$GW_ADM/v1/gateway" \
+        | "$PY" -c 'import json,sys; d=json.load(sys.stdin); print(d["workers_alive"], 1 if d["broker"]["conservation_ok"] else 0)' \
+        2>/dev/null || echo "0 0")
+    [ "$GWALIVE" = "2 1" ] && break
+    sleep 0.5
+done
+[ "$GWALIVE" = "2 1" ] || die "worker did not respawn with conserved leases ($GWALIVE)"
+curl -sfm 20 -H "Authorization: Bearer smoke-admin-token" \
+    "http://127.0.0.1:$GW_ADM/metrics" \
+    | grep -Eq '^gateway_worker_restarts_total [1-9]' \
+    || die "gateway respawn not counted"
+
 say "ALL SMOKE TESTS PASSED"

@@ -483,4 +483,96 @@ def test_cli_runs_clean_json(capsys):
 
 def test_every_rule_has_an_id_and_fixture_coverage():
     ids = {r.id for r in default_rules()}
-    assert ids == {f"GL0{i}" for i in range(1, 9)}
+    assert ids == {f"GL0{i}" for i in range(1, 10)}
+
+
+# ---- GL09 cross-worker-state -------------------------------------------
+
+
+def test_gl09_fires_on_module_state_mutated_in_handler():
+    vs = run("""
+        PENDING = {}
+
+        async def handle(req):
+            PENDING[req.id] = req
+    """, rel_path="garage_tpu/api/s3/foo.py")
+    assert [v.rule for v in vs] == ["GL09"]
+
+
+def test_gl09_fires_on_mutating_method_and_global_decl():
+    vs = run("""
+        SEEN = set()
+        COUNT = dict()
+
+        def note(x):
+            SEEN.add(x)
+
+        def bump():
+            global COUNT
+            COUNT["x"] = 1
+    """, rel_path="garage_tpu/gateway/foo.py")
+    assert sorted(v.rule for v in vs) == ["GL09", "GL09"]
+
+
+def test_gl09_quiet_on_readonly_tables_and_locals():
+    vs = run("""
+        STATUS = {200: "OK"}  # read-only lookup table: fine
+
+        def reason(code):
+            local = {}
+            local["x"] = 1  # local shadow, not module state
+            return STATUS.get(code)
+    """, rel_path="garage_tpu/api/http2.py")
+    assert vs == []
+
+
+def test_gl09_scoped_to_request_plane_packages():
+    src = """
+        PENDING = {}
+
+        async def handle(req):
+            PENDING[req.id] = req
+    """
+    assert run(src, rel_path="garage_tpu/block/foo.py") == []
+    assert [v.rule for v in
+            run(src, rel_path="garage_tpu/qos/foo.py")] == ["GL09"]
+    assert [v.rule for v in
+            run(src, rel_path="garage_tpu/web/foo.py")] == ["GL09"]
+
+
+def test_gl09_nested_def_does_not_shadow_outer_mutation():
+    # a nested def assigning the name locally must not hide the OUTER
+    # function's mutation of module state...
+    vs = run("""
+        CACHE = {}
+
+        def handler(x):
+            def reset():
+                CACHE = {}
+                return CACHE
+            CACHE[x] = 1
+    """, rel_path="garage_tpu/api/foo.py")
+    assert [v.rule for v in vs] == ["GL09"]
+    # ...and a nested function's mutation of its OWN local must not
+    # flag the enclosing scope
+    vs = run("""
+        CACHE = {}
+
+        def handler(x):
+            def build():
+                CACHE = {}
+                CACHE["x"] = 1
+                return CACHE
+            return build()
+    """, rel_path="garage_tpu/api/foo.py")
+    assert vs == []
+
+
+def test_gl09_waivable_with_reason():
+    vs = run("""
+        SEEN = set()  # lint: ignore[GL09] merged by the supervisor scrape
+
+        def note(x):
+            SEEN.add(x)
+    """, rel_path="garage_tpu/gateway/foo.py")
+    assert vs == []
