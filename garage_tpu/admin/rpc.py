@@ -8,6 +8,7 @@ and stats introspection. Endpoint: "garage_tpu/admin".
 
 from __future__ import annotations
 
+import asyncio
 import logging
 
 from ..model.helper import GarageHelper, allow_all
@@ -336,7 +337,9 @@ class AdminRpcHandler:
         state, at = m.rc.get(h)
         refs = []
         store = self.garage.block_ref_table.data
-        for raw in store.read_range(h, None, None, 100):
+        raws = await asyncio.to_thread(store.read_range, h, None, None,
+                                       100)
+        for raw in raws:
             e = store.decode_stored(raw)
             refs.append({"version": e.version.hex(),
                          "deleted": e.deleted.value})
@@ -355,7 +358,8 @@ class AdminRpcHandler:
             hashes = [bytes.fromhex(x) for x in p.get("hashes", [])]
         except ValueError as e:
             raise BadRequest(f"bad block hash: {e}")
-        n = res.retry_now(hashes, all_errors=bool(p.get("all")))
+        n = await asyncio.to_thread(res.retry_now, hashes,
+                                    bool(p.get("all")))
         return {"ok": True, "count": n}
 
     async def op_block_purge(self, p):
@@ -391,8 +395,9 @@ class AdminRpcHandler:
 
         for h in hashes:
             data = self.garage.block_ref_table.data
-            refs = [data.decode_stored(raw)
-                    for raw in data.read_range(h, None, None, 10000)]
+            raws = await asyncio.to_thread(data.read_range, h, None,
+                                           None, 10000)
+            refs = [data.decode_stored(raw) for raw in raws]
             for ref in refs:
                 if ref.deleted.value:
                     continue

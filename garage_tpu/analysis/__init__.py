@@ -1,27 +1,44 @@
 """garage-lint: project-invariant static analysis (stdlib-ast only).
 
 Run it:  python -m garage_tpu.analysis [--format json|text] [paths]
+         python -m garage_tpu.analysis --explain GL10
+         python -m garage_tpu.analysis --fix-waivers [--write]
 
 Rules (each encodes an invariant an earlier PR established by hand):
 
   GL01 blocking-call-in-async   blocking I/O / digest-of-data on the
                                 event loop (PR 2's fast-path class)
   GL02 hedge-on-mutation        hedged or hedge-defaulting RPC on a
-                                write endpoint (PR 4's k2v pin)
-  GL03 ssec-cache-leak          SSE-C scope reaching the block cache
-                                seam without explicit cacheable=
+                                write endpoint (PR 4's k2v pin); since
+                                ISSUE 9 strategies passed across
+                                function boundaries resolve too
+  GL03 ssec-cache-leak          SSE-C taint reaching the block cache
+                                seam without explicit cacheable= —
+                                true taint tracking across helper
+                                boundaries since ISSUE 9
   GL04 orphan-task              create_task/ensure_future result dropped
   GL05 swallowed-exception      except Exception: pass (Aspirator)
-  GL06 await-holding-lock       RPC awaited inside `async with lock:`
+  GL06 await-holding-lock       RPC awaited inside `with lock:` /
+                                `async with lock:` (sync locks count
+                                since ISSUE 9)
   GL07 unregistered-metric      dynamic / off-scheme metric names
   GL08 config-knob-drift        code<->utils/config.py key drift
   GL09 cross-worker-state       module-level mutable state in the
                                 request plane (api/ qos/ gateway/ web/)
-                                mutated from function scope — process-
-                                local but semantically node-wide (the
-                                multi-process gateway's bug class)
+                                mutated from function scope
+  GL10 blocking-reachable-from-async
+                                a sync helper that blocks, called
+                                transitively from an async def with no
+                                to_thread hop (reports the full chain)
+  GL11 leaked-budget-on-exception
+                                qos token/lease/semaphore acquire whose
+                                refund/release is not on every exit path
   GL00 (framework)              stale waivers, stale baseline entries,
                                 unparseable files — cannot be waived
+
+GL02/GL03/GL10/GL11 run on the two-pass interprocedural engine
+(dataflow.py summaries + callgraph.py resolution — see README "How
+dataflow resolution works").
 
 Waive a deliberate site inline, with a reason (checked for staleness):
 
@@ -32,24 +49,31 @@ from __future__ import annotations
 
 from .baseline import (DEFAULT_BASELINE, apply_baseline, load_baseline,
                        save_baseline)
+from .callgraph import CallGraph
 from .core import META_RULE, FileContext, ProjectState, Rule, Violation
+from .dataflow import (DataflowState, summarize_tree, summary_fingerprint,
+                       summary_json)
 from .rules_async import (AwaitHoldingLock, BlockingCallInAsync,
                           OrphanTask, SwallowedException)
+from .rules_dataflow import (BlockingReachableFromAsync,
+                             LeakedBudgetOnException)
 from .rules_project import (ConfigKnobDrift, CrossWorkerState,
                             UnregisteredMetric)
 from .rules_rpc import HedgeOnMutation, SsecCacheLeak
 from .walker import analyze_paths, analyze_source
 
 RULE_CLASSES = [
-    BlockingCallInAsync,   # GL01
-    HedgeOnMutation,       # GL02
-    SsecCacheLeak,         # GL03
-    OrphanTask,            # GL04
-    SwallowedException,    # GL05
-    AwaitHoldingLock,      # GL06
-    UnregisteredMetric,    # GL07
-    ConfigKnobDrift,       # GL08
-    CrossWorkerState,      # GL09
+    BlockingCallInAsync,        # GL01
+    HedgeOnMutation,            # GL02
+    SsecCacheLeak,              # GL03
+    OrphanTask,                 # GL04
+    SwallowedException,         # GL05
+    AwaitHoldingLock,           # GL06
+    UnregisteredMetric,         # GL07
+    ConfigKnobDrift,            # GL08
+    CrossWorkerState,           # GL09
+    BlockingReachableFromAsync,  # GL10
+    LeakedBudgetOnException,    # GL11
 ]
 
 
@@ -62,5 +86,6 @@ __all__ = [
     "analyze_paths", "analyze_source", "default_rules", "RULE_CLASSES",
     "Violation", "Rule", "FileContext", "ProjectState", "META_RULE",
     "DEFAULT_BASELINE", "load_baseline", "save_baseline",
-    "apply_baseline",
+    "apply_baseline", "CallGraph", "DataflowState", "summarize_tree",
+    "summary_fingerprint", "summary_json",
 ]

@@ -2,7 +2,17 @@
 
 Exit codes: 0 clean (waived/baselined findings allowed), 1 active
 violations, 2 bad invocation. CI's lint job is exactly
-`python -m garage_tpu.analysis --format json`.
+`python -m garage_tpu.analysis` (text output feeds the GitHub problem
+matcher; `--format json` is the machine surface).
+
+Extras (ISSUE 9):
+  --explain RULE        rule rationale + a firing and a suppressed
+                        example, straight from the rule class
+  --fix-waivers         delete stale `# lint: ignore[...]` comments
+                        GL00 flags (dry-run by default; --write applies)
+  --summary-cache PATH  reuse pass-1 dataflow summaries for files whose
+                        sha256 is unchanged (CI keys the cache on the
+                        tree hash; a miss just re-summarizes)
 """
 
 from __future__ import annotations
@@ -11,10 +21,28 @@ import argparse
 import json
 import os
 import sys
+import time
 
 from . import (DEFAULT_BASELINE, META_RULE, analyze_paths,
                apply_baseline, default_rules, load_baseline,
                save_baseline)
+from .core import WAIVER_RE
+
+# harness files included in the default scan with the scoped
+# GL04/GL05/GL07 subset (walker.HARNESS_RULES)
+HARNESS_DEFAULTS = ("tests/clusterbox.py", "tests/conftest.py",
+                    "bench.py")
+
+GL00_EXPLAIN = {
+    "rationale": (
+        "The framework's own hygiene: a waiver that suppresses nothing, "
+        "carries no reason, or names GL00 itself; a baseline entry that "
+        "matches nothing; an unparseable file. Suppressions must not "
+        "rot silently, so GL00 cannot be waived."),
+    "example_fire": 'def f():  # lint: ignore[GL05] nothing fires here\n'
+                    '    return 1',
+    "example_ok": 'risky()  # lint: ignore[GL05] best-effort telemetry',
+}
 
 
 def _repo_root() -> str:
@@ -24,13 +52,112 @@ def _repo_root() -> str:
     return os.path.dirname(pkg)
 
 
+def _explain(rule_id: str) -> int:
+    rule_id = rule_id.strip().upper()
+    if rule_id == META_RULE:
+        info, name, summary = GL00_EXPLAIN, "(framework)", \
+            "waiver/baseline hygiene"
+    else:
+        match = [r for r in default_rules() if r.id == rule_id]
+        if not match:
+            print(f"no such rule: {rule_id}", file=sys.stderr)
+            return 2
+        r = match[0]
+        name, summary = r.name, r.summary
+        info = {
+            "rationale": getattr(r, "rationale", "") or r.summary,
+            "example_fire": getattr(r, "example_fire", ""),
+            "example_ok": getattr(r, "example_ok", ""),
+        }
+    print(f"{rule_id} {name}\n")
+    print(f"  {summary}\n")
+    print("rationale:")
+    for line in info["rationale"].splitlines():
+        print(f"  {line.strip()}" if line.strip() else "")
+    if info["example_fire"]:
+        print("\nfires on:\n")
+        for line in info["example_fire"].splitlines():
+            print(f"    {line}")
+    if info["example_ok"]:
+        print("\nquiet on:\n")
+        for line in info["example_ok"].splitlines():
+            print(f"    {line}")
+    return 0
+
+
+def _fix_waivers(paths: list[str], root: str, write: bool) -> int:
+    """Delete waiver comments GL00 reports as stale. Dry-run prints
+    the edits; --write applies them. Only the comment is removed — a
+    line that becomes empty is dropped entirely."""
+    rules = default_rules()
+    violations, project = analyze_paths(paths, rules, root=root,
+                                        data=_readme_data(root))
+    stale: dict[str, list[int]] = {}
+    for v in violations:
+        if v.rule == META_RULE and "stale waiver" in v.message:
+            stale.setdefault(v.path, []).append(v.line)
+    if not stale:
+        print("no stale waivers")
+        return 0
+    edits = 0
+    for rel, lines in sorted(stale.items()):
+        ap = os.path.join(root, rel)
+        try:
+            with open(ap, "r", encoding="utf-8") as f:
+                src_lines = f.read().splitlines(keepends=True)
+        except OSError as e:
+            print(f"{rel}: unreadable ({e})", file=sys.stderr)
+            continue
+        for ln in sorted(set(lines), reverse=True):
+            if ln - 1 >= len(src_lines):
+                continue
+            line = src_lines[ln - 1]
+            stripped = WAIVER_RE.sub("", line).rstrip()
+            action = ("drop line" if not stripped.strip()
+                      else "strip comment")
+            print(f"{rel}:{ln}: {action}: {line.rstrip()}")
+            if write:
+                if stripped.strip():
+                    nl = "\n" if line.endswith("\n") else ""
+                    src_lines[ln - 1] = stripped + nl
+                else:
+                    del src_lines[ln - 1]
+            edits += 1
+        if write:
+            with open(ap, "w", encoding="utf-8") as f:
+                f.write("".join(src_lines))
+    verb = "removed" if write else "would remove (dry-run; pass --write)"
+    print(f"{edits} stale waiver(s) {verb}")
+    return 0
+
+
+def _readme_data(root: str) -> dict:
+    # GL08's reverse direction accepts README documentation as a knob's
+    # reason to exist
+    data = {}
+    readme = os.path.join(root, "README.md")
+    if os.path.exists(readme):
+        with open(readme, "r", encoding="utf-8") as f:
+            data["readme_text"] = f.read()
+    return data
+
+
+def _load_summary_cache(path: str) -> dict:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            raw = json.load(f)
+        return raw if isinstance(raw, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m garage_tpu.analysis",
         description="garage-lint: project-invariant static analysis")
     parser.add_argument("paths", nargs="*",
                         help="files/dirs to scan (default: the "
-                             "garage_tpu package)")
+                             "garage_tpu package + harness files)")
     parser.add_argument("--format", choices=("text", "json"),
                         default="text")
     parser.add_argument("--baseline", default=None,
@@ -42,10 +169,30 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--rules", default=None,
                         help="comma-separated rule ids to run "
                              "(default: all)")
+    parser.add_argument("--explain", metavar="RULE", default=None,
+                        help="print a rule's rationale + fire/suppress "
+                             "examples and exit")
+    parser.add_argument("--fix-waivers", action="store_true",
+                        help="delete stale waiver comments (dry-run "
+                             "unless --write)")
+    parser.add_argument("--write", action="store_true",
+                        help="apply --fix-waivers edits in place")
+    parser.add_argument("--summary-cache", default=None, metavar="PATH",
+                        help="pass-1 summary cache JSON, keyed on file "
+                             "sha256 (read + rewritten each run)")
     args = parser.parse_args(argv)
 
+    if args.explain:
+        return _explain(args.explain)
+
     root = _repo_root()
-    paths = args.paths or [os.path.join(root, "garage_tpu")]
+    paths = args.paths or [os.path.join(root, "garage_tpu")] + [
+        p for p in (os.path.join(root, h) for h in HARNESS_DEFAULTS)
+        if os.path.exists(p)]
+
+    if args.fix_waivers:
+        return _fix_waivers(paths, root, args.write)
+
     rules = default_rules()
     if args.rules:
         want = {r.strip().upper() for r in args.rules.split(",")}
@@ -54,16 +201,25 @@ def main(argv: list[str] | None = None) -> int:
             print(f"no such rules: {args.rules}", file=sys.stderr)
             return 2
 
-    # GL08's reverse direction accepts README documentation as a knob's
-    # reason to exist
-    data = {}
-    readme = os.path.join(root, "README.md")
-    if os.path.exists(readme):
-        with open(readme, "r", encoding="utf-8") as f:
-            data["readme_text"] = f.read()
+    data = _readme_data(root)
+    if args.summary_cache:
+        data["summary_cache"] = _load_summary_cache(args.summary_cache)
 
+    t0 = time.monotonic()
     violations, project = analyze_paths(paths, rules, root=root,
-                                        data=data)
+                                        data=data,
+                                        restricted=bool(args.rules))
+    elapsed = time.monotonic() - t0
+
+    if args.summary_cache and "_dataflow" in project.data:
+        df = project.data["_dataflow"]
+        tmp = args.summary_cache + ".tmp"
+        os.makedirs(os.path.dirname(os.path.abspath(args.summary_cache)),
+                    exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(df.cache_payload(), f, sort_keys=True,
+                      separators=(",", ":"))
+        os.replace(tmp, args.summary_cache)
 
     baseline_path = args.baseline
     if baseline_path != "none":
@@ -79,11 +235,14 @@ def main(argv: list[str] | None = None) -> int:
 
     active = [v for v in violations if v.active]
     if args.format == "json":
+        df = project.data.get("_dataflow")
         print(json.dumps({
             "violations": [v.to_dict() for v in active],
             "waived": sum(1 for v in violations if v.waived),
             "baselined": sum(1 for v in violations if v.baselined),
             "files": len(project.files),
+            "elapsed_s": round(elapsed, 3),
+            "summary_cache_hits": df.cache_hits if df else 0,
         }, indent=2))
     else:
         for v in active:
@@ -91,7 +250,7 @@ def main(argv: list[str] | None = None) -> int:
         waived = sum(1 for v in violations if v.waived)
         base = sum(1 for v in violations if v.baselined)
         print(f"{len(project.files)} files, {len(active)} violations "
-              f"({waived} waived, {base} baselined)")
+              f"({waived} waived, {base} baselined) in {elapsed:.1f}s")
     return 1 if active else 0
 
 

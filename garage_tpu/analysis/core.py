@@ -122,8 +122,11 @@ class FileContext:
         # frames: (node, name, is_async, meta-dict)
         self.func_stack: list[tuple[ast.AST, str, bool, dict]] = []
         self.class_stack: list[str] = []
-        # async-with frames whose context expression names a lock
-        self.async_lock_stack: list[ast.AsyncWith] = []
+        # with / async-with frames whose context expression names a
+        # lock (GL06 learned sync `with lock():` in ISSUE 9 — a
+        # threading lock held across an await serializes waiters just
+        # the same)
+        self.lock_stack: list[ast.AST] = []
 
     # ---- scope queries --------------------------------------------------
 
@@ -133,6 +136,16 @@ class FileContext:
         name = parts[-1]
         return ("tests" in parts or name.startswith("test_")
                 or name == "conftest.py")
+
+    @property
+    def is_harness(self) -> bool:
+        """Test-infrastructure files that opt into a scoped rule
+        subset (GL04/GL05/GL07): the cluster-in-a-box harness, the
+        shared conftest, and the bench driver — harness code orphaning
+        tasks or swallowing exceptions silently corrupts chaos-soak
+        verdicts (ISSUE 9 satellite)."""
+        name = self.rel_path.split("/")[-1]
+        return name in ("clusterbox.py", "conftest.py", "bench.py")
 
     @property
     def in_async_def(self) -> bool:
@@ -168,28 +181,41 @@ class FileContext:
 
     # ---- waivers --------------------------------------------------------
 
-    def apply_waivers(self) -> None:
+    def apply_waivers(self, active_rules: "set[str] | None" = None) -> None:
         """Mark violations covered by an inline waiver, then report
         waiver hygiene: missing reason, stale (suppresses nothing).
         A waiver covers a violation when it sits on any line the
         flagged node's statement spans (first line - 1 .. last line),
         so multi-line calls can carry the comment on any of their
-        lines."""
+        lines. `active_rules` (a --rules subset) exempts waivers for
+        rules that did not run from the staleness check — they could
+        not possibly have suppressed anything this run."""
+        # idempotent under re-settling (analyze_source with a shared
+        # project settles after every added file): drop our own prior
+        # hygiene output and recompute from scratch
+        self.violations = [v for v in self.violations
+                           if not getattr(v, "_waiver_hygiene", False)]
+        for w in self.waivers:
+            w.used = False
         spans: dict[int, list[Violation]] = {}
         for v in self.violations:
             spans.setdefault(v.line, []).append(v)
         for w in self.waivers:
             if META_RULE in w.rules:
-                self.violations.append(Violation(
+                v = Violation(
                     rule=META_RULE, path=self.rel_path, line=w.line,
-                    col=0, message="GL00 cannot be waived"))
+                    col=0, message="GL00 cannot be waived")
+                v._waiver_hygiene = True  # type: ignore[attr-defined]
+                self.violations.append(v)
                 continue
             if not w.reason:
-                self.violations.append(Violation(
+                v = Violation(
                     rule=META_RULE, path=self.rel_path, line=w.line,
                     col=0,
                     message="waiver has no reason: "
-                            "`# lint: ignore[RULE] why it is safe`"))
+                            "`# lint: ignore[RULE] why it is safe`")
+                v._waiver_hygiene = True  # type: ignore[attr-defined]
+                self.violations.append(v)
                 # a reasonless waiver still suppresses nothing
                 continue
             for v in self.violations:
@@ -199,10 +225,15 @@ class FileContext:
         for w in self.waivers:
             if w.used or not w.reason or META_RULE in w.rules:
                 continue
-            self.violations.append(Violation(
+            if active_rules is not None \
+                    and not (set(w.rules) & active_rules):
+                continue  # its rule didn't run this invocation
+            v = Violation(
                 rule=META_RULE, path=self.rel_path, line=w.line, col=0,
                 message=f"stale waiver for {','.join(w.rules)}: "
-                        "suppresses nothing on this statement"))
+                        "suppresses nothing on this statement")
+            v._waiver_hygiene = True  # type: ignore[attr-defined]
+            self.violations.append(v)
 
     def _covers(self, w: Waiver, v: Violation) -> bool:
         if w.line in (v.line, v.line - 1):
@@ -303,3 +334,28 @@ def is_const(node: Optional[ast.AST], value=...) -> bool:
     if not isinstance(node, ast.Constant):
         return False
     return True if value is ... else node.value is value
+
+
+# mutation-context detection shared by GL02 (rules_rpc) and the pass-1
+# summaries (dataflow) — one home so they can never disagree
+MUTATION_NAME_RE = re.compile(
+    r"(^|_)(insert|write|put|delete|update|remove|push|apply|store|"
+    r"flush|merge)($|_)")
+MUTATION_OP_RE = re.compile(
+    r"^(insert|write|put|delete|update|remove|push|apply|store|flush)")
+
+
+def payload_ops(node: ast.Call) -> list[str]:
+    """Constant `op` strings found anywhere in the call's payload
+    arguments (table RPCs ship {'op': 'insert_many', ...} dicts)."""
+    ops = []
+    for arg in list(node.args) + [k.value for k in node.keywords
+                                  if k.value is not None]:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Dict):
+                for k, v in zip(sub.keys, sub.values):
+                    if is_const(k) and k.value == "op" \
+                            and isinstance(v, ast.Constant) \
+                            and isinstance(v.value, str):
+                        ops.append(v.value)
+    return ops

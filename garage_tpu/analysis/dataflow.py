@@ -1,0 +1,642 @@
+"""Pass 1 of the interprocedural engine: per-function summaries.
+
+Infer-style compositional analysis (ISSUE 9): one extra walk per file
+extracts, for every function, the facts the flow rules need —
+
+  * name-level taint: which PARAMS flow into each call argument and
+    into the return value (two monotone passes over the body, enough
+    for the straight-line helper chains the rules care about);
+  * SSE-C sources in scope (sse-named params/locals, decrypt results);
+  * blocking atoms: calls that pin the event loop if reached from an
+    `async def` without a thread hop — GL01's I/O list plus the
+    project's sync db seams (`self.store.iter`, `db.transaction`, ...);
+  * call records with enough structure to build the project call graph
+    (callgraph.py): self/name/dotted/attr refs, `asyncio.to_thread` /
+    `functools.partial` / `run_in_executor` unwrapping, awaited-ness,
+    kwarg names, RequestStrategy argument classification;
+  * resource discipline: qos/lease/semaphore acquires and whether their
+    refund/release is structurally on every exit path (GL11's fact).
+
+Summaries are plain dicts of sorted primitives: `json.dumps(...,
+sort_keys=True)` over the same tree is byte-identical, which is what
+lets CI cache pass 1 keyed on file hash (`--summary-cache`).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+from typing import Optional
+
+from .rules_async import BLOCKING_CALLS as _GL01_BLOCKING
+
+from .core import (MUTATION_NAME_RE, MUTATION_OP_RE, chain_segments,
+                   dotted_name, payload_ops)
+
+# ---- blocking atoms -----------------------------------------------------
+
+# GL01's hard-I/O list IS the base (imported, not copied — the direct
+# and transitive rules must never disagree about what blocks); GL10
+# additionally treats durable-rename/fsync syscalls as atoms because
+# they hide inside sync persistence helpers. Digest helpers are
+# deliberately NOT propagated transitively: hashing a 32-byte key two
+# frames down is microseconds, and GL01 already flags digest-of-data
+# DIRECTLY in an async frame where the payload is plausibly large.
+
+IO_BLOCKING_CALLS = _GL01_BLOCKING | {
+    "os.fsync", "os.replace", "os.rename",
+}
+
+# the project's synchronous metadata seams: a non-awaited method call
+# on a db-ish receiver is a sqlite/LSM operation that belongs in a
+# worker thread when reachable from the event loop (db.py convention
+# since PR 1). Receiver segment must MATCH (not merely contain) one of
+# these so `self.store.iter(...)`, `db.transaction(...)`,
+# `self.merkle_todo.insert(...)` qualify but e.g. `self.restore.get`
+# does not.
+DB_RECEIVER_RE = r"(^|_)(store|db|tree|todo|queue|timestamp)$"
+DB_METHODS = {"get", "iter", "insert", "remove", "transaction",
+              "open_tree", "snapshot", "checkpoint"}
+
+THREAD_HOPS = {"to_thread", "run_in_executor"}
+
+SSE_NAME_RE = r"(^|_)sse"
+DECRYPT_RE = r"(^|_)(decrypt|unseal)"
+
+ACQUIRE_METHODS = {"acquire", "try_acquire"}
+RELEASE_METHODS = {"release", "refund", "give_back", "revoke"}
+
+import re as _re
+
+_DB_RECEIVER = _re.compile(DB_RECEIVER_RE)
+_SSE_NAME = _re.compile(SSE_NAME_RE, _re.IGNORECASE)
+_DECRYPT = _re.compile(DECRYPT_RE, _re.IGNORECASE)
+
+
+# bump on ANY change to the summary schema or extraction semantics —
+# cached entries from other versions are recomputed, not trusted
+SUMMARY_VERSION = 2
+
+
+def module_name_of(rel_path: str) -> str:
+    """garage_tpu/model/k2v/rpc.py -> garage_tpu.model.k2v.rpc"""
+    p = rel_path[:-3] if rel_path.endswith(".py") else rel_path
+    p = p.replace("\\", "/")
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+def _call_ref(func_expr: ast.AST) -> Optional[list]:
+    """Reference shape for a callable expression:
+       ["name", n]          bare name
+       ["self", m]          self.m / cls.m
+       ["dotted", "a.b.c"]  attribute chain rooted at a plain name
+       ["attr", m]          method on an arbitrary expression
+    Receiver segments ride separately in the call record."""
+    segs = chain_segments(func_expr)
+    if not segs:
+        return None
+    if len(segs) == 1:
+        return ["name", segs[0]]
+    if segs[0] in ("self", "cls"):
+        if len(segs) == 2:
+            return ["self", segs[1]]
+        return ["attr", segs[-1]]
+    dn = dotted_name(func_expr)
+    if dn is not None:
+        return ["dotted", dn]
+    return ["attr", segs[-1]]
+
+
+def _payload_ops(node: ast.Call) -> list[str]:
+    return sorted(set(payload_ops(node)))
+
+
+class _FunctionCollector:
+    """One bounded walk over a single function body (nested defs get
+    their own collector; we do not descend into them here)."""
+
+    def __init__(self, node: ast.AST, qualname: str, cls: Optional[str],
+                 parent: Optional[str], strategies: dict):
+        self.node = node
+        self.qualname = qualname
+        self.cls = cls
+        self.parent = parent
+        self.local_strategies = strategies  # name -> hedge pin (or None)
+        self.params: list[str] = []
+        self.calls: list[dict] = []
+        self.blocking: list[dict] = []
+        self.acquires: list[dict] = []
+        self.releases: list[dict] = []
+        self.awaits_under_lock: list[dict] = []
+        self.is_generator = False
+        self.returns_exprs: list[ast.AST] = []
+        self.escaped: set[str] = set()   # names that leave the function
+        self.taint: dict[str, set] = {}
+        self.sse_locals: set[str] = set()
+        self._lock_stack: list[str] = []
+        self._with_items: set[int] = set()  # id() of calls in with-items
+
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                        + ([a.vararg] if a.vararg else [])
+                        + ([a.kwarg] if a.kwarg else [])):
+                self.params.append(arg.arg)
+        for p in self.params:
+            self.taint[p] = {p}
+            if _SSE_NAME.search(p):
+                self.sse_locals.add(p)
+
+    # -- taint helpers ----------------------------------------------------
+
+    def _expr_taint(self, expr: Optional[ast.AST]) -> set:
+        if expr is None:
+            return set()
+        out: set = set()
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name):
+                out |= self.taint.get(sub.id, set())
+            elif isinstance(sub, ast.Call):
+                cn = chain_segments(sub.func)
+                if cn and _DECRYPT.search(cn[-1]):
+                    out.add("<decrypt>")
+        return out
+
+    def _bind(self, target: ast.AST, labels: set, from_sse_expr: bool):
+        if isinstance(target, ast.Name):
+            self.taint[target.id] = self.taint.get(target.id, set()) | labels
+            if _SSE_NAME.search(target.id) or from_sse_expr:
+                self.sse_locals.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._bind(el, labels, from_sse_expr)
+
+    # -- the walk ---------------------------------------------------------
+
+    def run(self) -> None:
+        # two monotone passes: the second pass sees bindings made later
+        # in the first (good enough for helper-chain shapes; loops in
+        # the taint lattice only ever add labels)
+        body = list(ast.iter_child_nodes(self.node))
+        for _ in range(2):
+            self.calls.clear()
+            self.blocking.clear()
+            self.acquires.clear()
+            self.releases.clear()
+            self.awaits_under_lock.clear()
+            self._lock_stack.clear()
+            self._with_items.clear()
+            for child in body:
+                self._visit(child, awaited=False)
+
+    def _visit(self, node: ast.AST, awaited: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested scopes summarized separately
+        if isinstance(node, (ast.Yield, ast.YieldFrom)):
+            self.is_generator = True
+        if isinstance(node, ast.Return) and node.value is not None:
+            self.returns_exprs.append(node.value)
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name):
+                    self.escaped.add(sub.id)
+        if isinstance(node, ast.Assign):
+            labels = self._expr_taint(node.value)
+            sse_expr = any(lb in self.sse_locals or lb == "<decrypt>"
+                           for lb in labels)
+            for t in node.targets:
+                self._bind(t, labels, sse_expr)
+                if isinstance(t, ast.Attribute):
+                    # stored on an object: ownership escapes
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Name):
+                            self.escaped.add(sub.id)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)) \
+                and node.value is not None:
+            self._bind(node.target, self._expr_taint(node.value), False)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            self._bind(node.target, self._expr_taint(node.iter), False)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            lockish = None
+            for item in node.items:
+                segs = chain_segments(item.context_expr)
+                if any("lock" in s.lower() for s in segs):
+                    lockish = ".".join(segs)
+                if isinstance(item.context_expr, ast.Call):
+                    self._with_items.add(id(item.context_expr))
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars,
+                               self._expr_taint(item.context_expr), False)
+            if lockish is not None:
+                self._lock_stack.append(lockish)
+                for item in node.items:
+                    self._visit(item.context_expr, awaited=False)
+                for child in node.body:
+                    self._visit(child, awaited=False)
+                self._lock_stack.pop()
+                return
+        elif isinstance(node, ast.Await):
+            if self._lock_stack:
+                self.awaits_under_lock.append({
+                    "line": node.lineno,
+                    "lock": self._lock_stack[-1],
+                })
+            if isinstance(node.value, ast.Call):
+                self._visit_call(node.value, awaited=True)
+                for arg in ast.iter_child_nodes(node.value):
+                    self._visit(arg, awaited=False)
+                return
+        elif isinstance(node, ast.Call):
+            self._visit_call(node, awaited=awaited)
+
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, awaited=False)
+
+    # -- call records -----------------------------------------------------
+
+    def _visit_call(self, node: ast.Call, awaited: bool) -> None:
+        ref = _call_ref(node.func)
+        segs = chain_segments(node.func)
+        name = segs[-1] if segs else ""
+        recv = segs[:-1]
+
+        # every Name argument escapes (ownership may transfer)
+        for a in list(node.args) + [k.value for k in node.keywords
+                                    if k.value is not None]:
+            if isinstance(a, ast.Name):
+                self.escaped.add(a.id)
+
+        # thread-hop / partial unwrapping: the FIRST callable argument
+        # becomes its own edge
+        if name in THREAD_HOPS or name == "partial":
+            fn_args = node.args
+            if name == "run_in_executor" and len(fn_args) >= 2:
+                fn_args = fn_args[1:]
+            if fn_args:
+                inner = _call_ref(fn_args[0])
+                if inner is not None:
+                    self.calls.append({
+                        "ref": inner, "line": node.lineno,
+                        "end_line": getattr(node, "end_lineno", node.lineno),
+                        "via_thread": name in THREAD_HOPS,
+                        "awaited": False, "name": inner[-1],
+                        "recv": [], "kwargs": [], "args": [], "kw": {},
+                        "ops": [],
+                    })
+
+        if ref is None:
+            return
+
+        rec = {
+            "ref": ref,
+            "line": node.lineno,
+            "end_line": getattr(node, "end_lineno", node.lineno),
+            "via_thread": False,
+            "awaited": awaited,
+            "name": name,
+            "recv": recv,
+            "kwargs": sorted(k.arg for k in node.keywords
+                             if k.arg is not None),
+            "args": [self._arg_desc(a) for a in node.args],
+            "kw": {k.arg: self._arg_desc(k.value)
+                   for k in node.keywords
+                   if k.arg is not None
+                   and self._arg_desc(k.value) is not None},
+            "ops": _payload_ops(node),
+        }
+        rec["kw"] = {k: v for k, v in rec["kw"].items() if v}
+        self.calls.append(rec)
+
+        # blocking atoms (non-awaited only: an awaited call is a
+        # coroutine by definition)
+        if not awaited:
+            dn = dotted_name(node.func)
+            if dn in IO_BLOCKING_CALLS:
+                self.blocking.append(
+                    {"target": dn, "line": node.lineno, "kind": "io"})
+            elif name in DB_METHODS and recv \
+                    and _DB_RECEIVER.search(recv[-1]):
+                self.blocking.append(
+                    {"target": ".".join(segs), "line": node.lineno,
+                     "kind": "db"})
+
+        # resource discipline facts
+        if name in ACQUIRE_METHODS and recv:
+            self.acquires.append({
+                "line": node.lineno, "recv": recv[-1],
+                "method": name, "awaited": awaited,
+                "in_with": id(node) in self._with_items,
+            })
+        elif name in RELEASE_METHODS and recv:
+            self.releases.append({
+                "line": node.lineno, "recv": recv[-1], "method": name})
+
+    def _arg_desc(self, expr: ast.AST) -> Optional[dict]:
+        out: dict = {}
+        tset = self._expr_taint(expr)
+        labels = set(tset) & (set(self.params) | {"<decrypt>"})
+        names_in = {sub.id for sub in ast.walk(expr)
+                    if isinstance(sub, ast.Name)}
+        # "<sse>" marks an argument built from SSE-C state in THIS
+        # scope (sse-named param/local or a decrypt result) — the
+        # interprocedural rule taints the callee's parameter outright
+        if names_in & self.sse_locals or "<decrypt>" in tset:
+            labels.add("<sse>")
+        if labels:
+            out["t"] = sorted(labels)
+        if isinstance(expr, ast.Call):
+            cn = chain_segments(expr.func)
+            if cn and cn[-1] == "RequestStrategy":
+                hedge = None
+                for k in expr.keywords:
+                    if k.arg == "hedge" and isinstance(k.value,
+                                                      ast.Constant):
+                        hedge = bool(k.value.value)
+                out["s"] = {"k": "inline", "hedge": hedge}
+        elif isinstance(expr, ast.Name):
+            if expr.id in self.local_strategies:
+                out["s"] = {"k": "local",
+                            "hedge": self.local_strategies[expr.id]}
+            elif expr.id in self.params:
+                out["s"] = {"k": "param", "name": expr.id}
+        return out or None
+
+    # -- GL11: refund-on-every-exit-path ---------------------------------
+
+    def leak_findings(self) -> list[dict]:
+        """Acquire/release pairs where the release is NOT structurally
+        exception-safe: a matching release exists on the fall-through
+        path, there is raise-capable work between acquire and release,
+        and no enclosing try protects the span with a finally- or
+        handler-release. Acquires with no release at all are NOT
+        flagged (plain token-bucket admission consumes tokens by
+        design), nor are acquires whose result/receiver escapes
+        (ownership transferred to a caller or object)."""
+        if not self.acquires or not self.releases:
+            return []
+        finally_rel, handler_rel = self._guarded_release_lines()
+        out = []
+        for acq in self.acquires:
+            if acq["in_with"]:
+                continue
+            match_names = {acq["recv"]} | self._acq_names(acq)
+            rels = [r for r in self.releases if r["recv"] in match_names]
+            if not rels:
+                continue
+            if any(r["line"] in finally_rel for r in rels):
+                continue  # try/finally: exception-safe by construction
+            plain = [r for r in rels if r["line"] not in handler_rel]
+            if not plain:
+                continue  # refund-on-failure idiom (except: refund; raise)
+            after = [r for r in plain if r["line"] > acq["line"]]
+            if not after:
+                continue
+            rel = min(after, key=lambda r: r["line"])
+            risky = self._risky_between(acq["line"], rel["line"])
+            if risky is None:
+                continue
+            out.append({
+                "line": acq["line"],
+                "recv": acq["recv"],
+                "release_line": rel["line"],
+                "risky_line": risky,
+            })
+        return out
+
+    def _acq_names(self, acq: dict) -> set:
+        """Names the acquired value was bound to (release via the
+        value: `lease = broker.acquire(); ...; lease.release()`)."""
+        names = set()
+        for sub in ast.walk(self.node):
+            if not isinstance(sub, ast.Assign):
+                continue
+            for c in ast.walk(sub.value):
+                if isinstance(c, ast.Call) and c.lineno == acq["line"]:
+                    cs = chain_segments(c.func)
+                    if cs and cs[-1] == acq["method"]:
+                        for t in sub.targets:
+                            if isinstance(t, ast.Name):
+                                names.add(t.id)
+        return names
+
+    def _guarded_release_lines(self) -> tuple[set, set]:
+        """(linenos of release calls inside `finally:` blocks, linenos
+        of release calls inside except handlers)."""
+        finally_rel: set = set()
+        handler_rel: set = set()
+        for sub in ast.walk(self.node):
+            if not isinstance(sub, ast.Try):
+                continue
+            for st in sub.finalbody:
+                for c in ast.walk(st):
+                    if isinstance(c, ast.Call):
+                        cs = chain_segments(c.func)
+                        if cs and cs[-1] in RELEASE_METHODS:
+                            finally_rel.add(c.lineno)
+            for h in sub.handlers:
+                for st in h.body:
+                    for c in ast.walk(st):
+                        if isinstance(c, ast.Call):
+                            cs = chain_segments(c.func)
+                            if cs and cs[-1] in RELEASE_METHODS:
+                                handler_rel.add(c.lineno)
+        return finally_rel, handler_rel
+
+    def _risky_between(self, lo: int, hi: int) -> Optional[int]:
+        for rec in self.calls:
+            if lo < rec["line"] < hi and rec["name"] not in RELEASE_METHODS:
+                return rec["line"]
+        return None
+
+    # -- output -----------------------------------------------------------
+
+    def summary(self, path: str, module: str, nested: dict) -> dict:
+        is_async = isinstance(self.node, ast.AsyncFunctionDef)
+        name = getattr(self.node, "name", "<lambda>")
+        param_return = sorted(
+            set().union(*[self._expr_taint(r) for r in self.returns_exprs])
+            & set(self.params)) if self.returns_exprs else []
+        return {
+            "name": name,
+            "qualname": self.qualname,
+            "class": self.cls or "",
+            "parent": self.parent or "",
+            "module": module,
+            "path": path,
+            "line": getattr(self.node, "lineno", 1),
+            "is_async": is_async,
+            "is_generator": self.is_generator,
+            "is_method": bool(self.cls) and bool(self.params)
+                         and self.params[0] in ("self", "cls"),
+            "params": list(self.params),
+            "mutation_name": bool(MUTATION_NAME_RE.search(name)),
+            "sse_sources": sorted(self.sse_locals),
+            "param_return": param_return,
+            "escaped": sorted(self.escaped),
+            "blocking": sorted(self.blocking,
+                               key=lambda b: (b["line"], b["target"])),
+            "calls": self.calls,
+            "acquires": self.acquires,
+            "releases": self.releases,
+            "awaits_under_lock": self.awaits_under_lock,
+            "leaks": self.leak_findings(),
+            "nested": {k: nested[k] for k in sorted(nested)},
+        }
+
+
+def _local_strategy_pins(fn: ast.AST) -> dict:
+    """name -> hedge pin (True/False/None) for `x = RequestStrategy(...)`
+    bindings in this function body."""
+    out: dict = {}
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+            segs = chain_segments(sub.value.func)
+            if segs and segs[-1] == "RequestStrategy":
+                hedge = None
+                for k in sub.value.keywords:
+                    if k.arg == "hedge" and isinstance(k.value,
+                                                      ast.Constant):
+                        hedge = bool(k.value.value)
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = hedge
+    return out
+
+
+def summarize_tree(tree: ast.Module, rel_path: str) -> dict:
+    """The whole pass-1 product for one file: module facts (imports,
+    classes) + per-function summaries. Pure function of the AST."""
+    module = module_name_of(rel_path)
+    # a package __init__ IS its package: `from .core import x` there
+    # resolves against the package itself, one level shallower than the
+    # same import in a sibling module
+    is_package = rel_path.replace("\\", "/").endswith("/__init__.py")
+    imports: dict[str, str] = {}
+    classes: dict[str, dict] = {}
+    functions: dict[str, dict] = {}
+
+    def handle_import(node):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                imports[alias.asname or alias.name.split(".")[0]] = \
+                    alias.name
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:
+                parts = module.split(".")
+                # `from . import x` in pkg/mod.py: level 1 = pkg;
+                # in pkg/__init__.py: level 1 = pkg too (module_name_of
+                # already collapsed the __init__ component)
+                drop = node.level - 1 if is_package else node.level
+                if drop:
+                    parts = parts[: len(parts) - drop]
+                base = ".".join(parts + ([node.module]
+                                         if node.module else []))
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                imports[alias.asname or alias.name] = \
+                    f"{base}.{alias.name}" if base else alias.name
+
+    def walk_scope(node, class_stack: list[str],
+                   parent_fn: Optional[str]) -> dict:
+        """Returns {bare_name: qualname} of functions defined directly
+        in this scope (the caller's name-resolution context)."""
+        own: dict[str, str] = {}
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.Import, ast.ImportFrom)):
+                handle_import(child)
+            elif isinstance(child, ast.ClassDef):
+                cname = ".".join(class_stack + [child.name])
+                classes[cname] = {
+                    "bases": sorted(
+                        s for b in child.bases
+                        for s in [".".join(chain_segments(b))] if s),
+                    "methods": {},
+                    "line": child.lineno,
+                }
+                methods = walk_scope(child, class_stack + [child.name],
+                                     None)
+                classes[cname]["methods"] = {
+                    k.rsplit(".", 1)[-1]: v for k, v in methods.items()}
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                qn = (f"{parent_fn}.{child.name}" if parent_fn
+                      else ".".join(class_stack + [child.name]))
+                coll = _FunctionCollector(
+                    child, qn,
+                    cls=".".join(class_stack) if class_stack else None,
+                    parent=parent_fn,
+                    strategies=_local_strategy_pins(child))
+                coll.run()
+                nested = walk_scope(child, [], qn)
+                functions[qn] = coll.summary(rel_path, module, {
+                    k.rsplit(".", 1)[-1]: v for k, v in nested.items()})
+                own[child.name] = qn
+            else:
+                # module-level statements may nest defs inside
+                # try/if blocks; recurse without changing scope kind
+                if not isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.ClassDef, ast.Lambda)):
+                    own.update(walk_scope(child, class_stack, parent_fn))
+        return own
+
+    top = walk_scope(tree, [], None)
+    return {
+        "module": module,
+        "path": rel_path,
+        "imports": {k: imports[k] for k in sorted(imports)},
+        "classes": {k: classes[k] for k in sorted(classes)},
+        "top_functions": {k: top[k] for k in sorted(top)},
+        "functions": {k: functions[k] for k in sorted(functions)},
+    }
+
+
+def summary_fingerprint(source: str) -> str:
+    return hashlib.sha256(source.encode("utf-8", "replace")).hexdigest()
+
+
+def summary_json(file_summary: dict) -> str:
+    """Canonical byte form (the determinism + cache contract)."""
+    return json.dumps(file_summary, sort_keys=True, separators=(",", ":"))
+
+
+class DataflowState:
+    """Pass-1 product for a whole project: file summaries (cache-aware)
+    plus the resolved call graph. Built once per analyze run, shared by
+    every `needs_dataflow` rule via project.data["_dataflow"]."""
+
+    def __init__(self, file_contexts, summary_cache: Optional[dict] = None):
+        from .callgraph import CallGraph
+
+        cache = summary_cache or {}
+        self.summaries: dict[str, dict] = {}
+        self.fingerprints: dict[str, str] = {}
+        self.cache_hits = 0
+        for ctx in file_contexts:
+            fp = summary_fingerprint(ctx.source)
+            self.fingerprints[ctx.rel_path] = fp
+            ent = cache.get(ctx.rel_path)
+            # the engine version gates reuse too: CI's restore-keys
+            # fallback serves a PREVIOUS tree's cache after any
+            # analyzer change, and per-file hashes alone would then
+            # happily feed old-schema summaries to new rules
+            if ent is not None and ent.get("sha256") == fp \
+                    and ent.get("v") == SUMMARY_VERSION:
+                self.summaries[ctx.rel_path] = ent["summary"]
+                self.cache_hits += 1
+            else:
+                self.summaries[ctx.rel_path] = summarize_tree(
+                    ctx.tree, ctx.rel_path)
+        self.graph = CallGraph(self.summaries)
+
+    def cache_payload(self) -> dict:
+        """What --summary-cache persists: per-file hash + engine
+        version + summary."""
+        return {rel: {"sha256": self.fingerprints[rel],
+                      "v": SUMMARY_VERSION, "summary": s}
+                for rel, s in sorted(self.summaries.items())}

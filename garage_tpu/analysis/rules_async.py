@@ -48,6 +48,19 @@ class BlockingCallInAsync(Rule):
     summary = ("blocking I/O or digest-of-data directly inside an "
                "`async def` — the PR 2 regression class; move it off "
                "the loop with asyncio.to_thread")
+    rationale = (
+        "One blocking call on the event loop stalls EVERY in-flight "
+        "request, not just its own — the PR 2 fast-path work moved "
+        "SigV4 hashing, sqlite and file I/O into worker threads, and "
+        "this rule keeps them there. The escape hatch is the "
+        "codebase's own idiom: wrap the work in a sync def and run it "
+        "via asyncio.to_thread (the sync frame is automatically "
+        "exempt). GL10 covers the same atoms one or more helpers "
+        "down the call graph.")
+    example_fire = ("async def handler(req):\n"
+                    "    time.sleep(0.1)            # stalls the loop")
+    example_ok = ("async def handler(req):\n"
+                  "    await asyncio.to_thread(time.sleep, 0.1)")
 
     def on_call(self, node: ast.Call, ctx: FileContext) -> None:
         if not ctx.in_async_def:
@@ -81,6 +94,15 @@ class OrphanTask(Rule):
                "un-retained task can be garbage-collected mid-flight "
                "and its exception is never observed; store it, await "
                "it, or add_done_callback")
+    rationale = (
+        "CPython keeps only a weak reference to scheduled tasks: a "
+        "dropped create_task result can be garbage-collected MID-"
+        "FLIGHT, and its exception is silently lost either way. PR 5 "
+        "converted 8 such sites to utils.background.spawn (retained "
+        "until done, exception logged). Runs on harness files too — "
+        "an orphaned task in clusterbox corrupts chaos-soak verdicts.")
+    example_fire = "asyncio.create_task(self._flush())   # dropped"
+    example_ok = "self._task = spawn(self._flush(), 'flush')"
 
     def on_expr_stmt(self, node: ast.Expr, ctx: FileContext) -> None:
         call = node.value
@@ -125,6 +147,18 @@ class SwallowedException(Rule):
                "passes/continues/returns None — the Aspirator check "
                "(Yuan et al., OSDI '14); log and count it, or waive "
                "with the reason the swallow is safe")
+    rationale = (
+        "Yuan et al. (OSDI '14) traced the majority of catastrophic "
+        "distributed-storage failures to exactly these do-nothing "
+        "handlers — the failure was DETECTED and then discarded. Log "
+        "it, count it, or waive it with the reason the swallow is "
+        "provably safe. Runs on harness files too: a swallowed "
+        "exception in the workload driver turns a real failure into "
+        "a passing soak.")
+    example_fire = ("try:\n    push(peer)\nexcept Exception:\n"
+                    "    pass                    # failure discarded")
+    example_ok = ("try:\n    push(peer)\nexcept Exception as e:\n"
+                  "    log.debug('push to %s failed: %s', peer, e)")
 
     def on_except(self, node: ast.ExceptHandler, ctx: FileContext) -> None:
         t = node.type
@@ -152,16 +186,29 @@ GL06_DIRS = re.compile(r"(^|/)(table|block)/")
 class AwaitHoldingLock(Rule):
     id = "GL06"
     name = "await-holding-lock"
-    summary = ("awaiting a network/RPC call inside an `async with "
-               "<lock>:` body in table/ or block/ — the lock is held "
-               "across the whole remote round-trip and serializes "
-               "every other waiter behind a peer's tail latency")
+    summary = ("awaiting a network/RPC call inside a `with <lock>:` / "
+               "`async with <lock>:` body in table/ or block/ — the "
+               "lock is held across the whole remote round-trip and "
+               "serializes every other waiter behind a peer's tail "
+               "latency (sync threading locks count since ISSUE 9: "
+               "they stall the WHOLE loop, not just one task)")
+    rationale = (
+        "A lock held across a network await couples local concurrency "
+        "to a PEER's tail latency: one slow replica and every other "
+        "task queues behind the lock for seconds. Since ISSUE 9 sync "
+        "`with lock():` frames count too. Deliberate holds (e.g. the "
+        "layout write_lock, which is a version PIN, not mutual "
+        "exclusion) carry reasoned waivers.")
+    example_fire = ("async with self._lock:\n"
+                    "    await self.rpc.try_call_many(...)")
+    example_ok = ("async with self._lock:\n    payload = build()\n"
+                  "await self.rpc.try_call_many(...)")
 
     def applies_to(self, ctx: FileContext) -> bool:
         return (not ctx.is_test) and bool(GL06_DIRS.search(ctx.rel_path))
 
     def on_await(self, node: ast.Await, ctx: FileContext) -> None:
-        if not ctx.async_lock_stack:
+        if not ctx.lock_stack:
             return
         call = node.value
         if not isinstance(call, ast.Call):

@@ -1,0 +1,189 @@
+"""Flow rules that only exist because of the pass-1 summaries:
+GL10 blocking-reachable-from-async, GL11 leaked-budget-on-exception.
+
+GL10 closes GL01's interprocedural hole: GL01 sees `time.sleep` typed
+directly inside an `async def`, but the PR 2 regression class more
+often hides one helper down (`async def handler` -> `def scan` ->
+sqlite). Pass 2 walks the call graph from every async function through
+sync project frames (skipping `to_thread` hops, async callees — their
+own GL01 problem — and generators, whose call runs nothing) to a
+blocking atom, and reports the FULL chain so the fix site is obvious.
+Atoms are GL01's hard-I/O list plus the project's sync db seams
+(`self.store.iter(...)`, `db.transaction(...)`: receiver matching
+store/db/tree/todo/queue/timestamp with a db-verb method, non-awaited)
+— digest helpers are deliberately excluded transitively (hashing a
+32-byte key two frames down is noise; GL01 still flags digests typed
+directly in an async frame).
+
+GL11 is the shape of PR 8's lease-conservation bugs (and Aspirator's
+error-path blindness, Yuan et al. OSDI '14): a qos token / lease /
+semaphore acquire whose refund sits on the happy path only — any
+raise-capable call between acquire and release leaks the budget
+permanently. Safe shapes are recognized structurally: `with`-statement
+acquires, releases in a `finally:`, the failure-refund idiom
+(`except: refund; raise`), acquires with no release at all (plain
+admission consumes tokens by design), and acquires whose value
+escapes (ownership transferred to the caller)."""
+
+from __future__ import annotations
+
+from .core import ProjectState, Rule, Violation
+from .dataflow import IO_BLOCKING_CALLS
+from .rules_async import BLOCKING_CALLS as _GL01_BLOCKING
+
+# atoms GL10 adds beyond GL01's list: typed DIRECTLY in an async frame
+# they are GL10's to report (GL01 would not fire), so inlining a
+# flagged helper cannot make the finding disappear
+_EXTRA_IO = IO_BLOCKING_CALLS - _GL01_BLOCKING
+
+
+def _dataflow(project: ProjectState):
+    return project.data.get("_dataflow")
+
+
+def _is_checked_file(project: ProjectState, rel_path: str) -> bool:
+    """GL10/GL11 run on production code only (harness files opt into
+    the GL04/GL05/GL07 subset, not the flow rules)."""
+    for ctx in project.files:
+        if ctx.rel_path == rel_path:
+            return not ctx.is_test and not ctx.is_harness
+    return False
+
+
+class BlockingReachableFromAsync(Rule):
+    id = "GL10"
+    name = "blocking-reachable-from-async"
+    needs_dataflow = True
+    summary = ("a sync helper that blocks (I/O, sqlite/LSM db seam) is "
+               "reachable from an `async def` with no asyncio.to_thread "
+               "frame on the path — the event loop stalls for the whole "
+               "operation; the report names the full call chain")
+    rationale = (
+        "GL01 sees `time.sleep` typed directly in an async def; the "
+        "PR 2 regression class more often hides one helper down "
+        "(async handler -> def scan -> sqlite). Pass 2 walks the "
+        "call graph from every async function through sync project "
+        "frames to a blocking atom — GL01's hard-I/O list plus the "
+        "project's sync db seams (store/db/tree/todo/queue receivers "
+        "with a db-verb method) — skipping to_thread hops, async "
+        "callees and generators, and reports the FULL chain. The "
+        "ISSUE 9 sweep fixed ~30 real on-loop db calls this found "
+        "(table sync/gc/queue, resync, k2v poll, RPC handlers).")
+    example_fire = ("def scan(path):\n"
+                    "    return sqlite3.connect(path)\n"
+                    "async def handler(path):\n"
+                    "    return scan(path)      # chain reported")
+    example_ok = ("async def handler(path):\n"
+                  "    return await asyncio.to_thread(scan, path)")
+
+    def finish_project(self, project: ProjectState) -> list[Violation]:
+        df = _dataflow(project)
+        if df is None:
+            return []
+        out: list[Violation] = []
+        file_ok: dict[str, bool] = {}
+        for fid in sorted(df.graph.functions):
+            fn = df.graph.functions[fid]
+            if not fn["is_async"]:
+                continue
+            path = fn["path"]
+            if path not in file_ok:
+                file_ok[path] = _is_checked_file(project, path)
+            if not file_ok[path]:
+                continue
+            # direct atoms in the async frame itself that GL01 does
+            # not own: the db seams, and the fsync/rename syscalls
+            # only GL10's list carries
+            for atom in fn["blocking"]:
+                if atom["kind"] == "db":
+                    msg = (f"sync db call `{atom['target']}(...)` "
+                           "directly on the event loop; wrap in "
+                           "asyncio.to_thread")
+                elif atom["target"] in _EXTRA_IO:
+                    msg = (f"blocking `{atom['target']}(...)` directly "
+                           "on the event loop; wrap in "
+                           "asyncio.to_thread")
+                else:
+                    continue  # GL01's direct hard-I/O list
+                out.append(self._violation(path, atom["line"], fn, msg))
+            reported: set[str] = set()
+            for chain in df.graph.blocking_chains(fid):
+                atom = chain[-1]
+                frames = chain[:-1]
+                atom_fid = frames[-1][0]
+                if atom_fid in reported:
+                    continue
+                reported.add(atom_fid)
+                first_rec = frames[0][1]
+                hops = " -> ".join(
+                    [fn["qualname"]]
+                    + [df.graph.functions[cid]["qualname"]
+                       for cid, _ in frames])
+                atom_fn = df.graph.functions[atom_fid]
+                out.append(self._violation(
+                    path, first_rec["line"], fn,
+                    f"blocking `{atom['target']}` reachable from this "
+                    f"async frame with no to_thread hop: {hops} "
+                    f"(atom at {atom_fn['path']}:{atom['line']}); move "
+                    "the sync frame into asyncio.to_thread",
+                    end_line=first_rec.get("end_line")))
+        return out
+
+    def _violation(self, path: str, line: int, fn: dict, msg: str,
+                   end_line=None) -> Violation:
+        v = Violation(rule=self.id, path=path, line=line, col=0,
+                      message=msg, context=fn["qualname"])
+        v._end_line = end_line  # type: ignore[attr-defined]
+        return v
+
+
+class LeakedBudgetOnException(Rule):
+    id = "GL11"
+    name = "leaked-budget-on-exception"
+    needs_dataflow = True
+    summary = ("qos token / lease / semaphore acquire whose refund or "
+               "release is not on every exit path — a raise between "
+               "acquire and the happy-path release leaks the budget "
+               "(PR 8's lease-conservation bug class); move the release "
+               "into a finally: or the except-reraise refund idiom")
+    rationale = (
+        "The exact shape of PR 8's lease-conservation bugs (and "
+        "Aspirator's error-path blindness): acquire, do raise-capable "
+        "work, release — the release never runs on the exception "
+        "path and the budget leaks permanently. Recognized-safe "
+        "shapes: `with` acquires, release in a finally:, the "
+        "failure-refund idiom (except: refund; raise), acquires with "
+        "no release at all (plain admission consumes by design), and "
+        "acquires whose value escapes (ownership transferred).")
+    example_fire = ("tok = await bucket.acquire(n)\n"
+                    "resp = await upstream()     # raise leaks tok\n"
+                    "bucket.refund(n)")
+    example_ok = ("tok = await bucket.acquire(n)\n"
+                  "try:\n    resp = await upstream()\n"
+                  "finally:\n    bucket.refund(n)")
+
+    def finish_project(self, project: ProjectState) -> list[Violation]:
+        df = _dataflow(project)
+        if df is None:
+            return []
+        out: list[Violation] = []
+        file_ok: dict[str, bool] = {}
+        for fid in sorted(df.graph.functions):
+            fn = df.graph.functions[fid]
+            path = fn["path"]
+            if path not in file_ok:
+                file_ok[path] = _is_checked_file(project, path)
+            if not file_ok[path]:
+                continue
+            for leak in fn["leaks"]:
+                v = Violation(
+                    rule=self.id, path=path, line=leak["line"], col=0,
+                    message=(
+                        f"`{leak['recv']}` acquire here is released at "
+                        f"line {leak['release_line']} only on the happy "
+                        f"path — the call at line {leak['risky_line']} "
+                        "can raise and leak the budget; release in a "
+                        "finally: (or refund in an except: ... raise)"),
+                    context=fn["qualname"])
+                out.append(v)
+        return out

@@ -40,6 +40,16 @@ class UnregisteredMetric(Rule):
     summary = ("metric name is dynamic or breaks the "
                "<subsystem>_<snake_case> scheme; the runtime strict "
                "check (utils/metrics.py) enforces the same regex")
+    rationale = (
+        "Dynamically built metric names are unbounded cardinality — a "
+        "slow memory leak and an ungreppable dashboard. Names must be "
+        "string literals matching the shared METRIC_NAME_RE; the "
+        "runtime rejects the same names at registration under "
+        "GARAGE_METRICS_STRICT=1, so the static and runtime checks "
+        "agree by construction. Runs on harness files (bench emits "
+        "metric names into reports).")
+    example_fire = 'registry().inc(f"qos_{key}_total")   # per-key series'
+    example_ok = 'registry().inc("qos_shed_requests", scope=key)'
 
     def applies_to(self, ctx: FileContext) -> bool:
         # the registry implementation itself passes names through
@@ -93,6 +103,16 @@ class ConfigKnobDrift(Rule):
     summary = ("config key read in code but absent from utils/config.py "
                "defaults, or a default that nothing reads and the "
                "README never documents")
+    rationale = (
+        "Both directions of knob drift are silent failures: a key "
+        "read in code with no default is an AttributeError waiting "
+        "for the one deployment that exercises it; a default nothing "
+        "reads is a feature that quietly lost its wiring (PR 5 found "
+        "two: metadata_fsync ignored, [tpu] batch_blocks dead). The "
+        "rule reconciles every cfg.X / section alias / getattr read "
+        "against the dataclass schema, cross-file.")
+    example_fire = "return cfg.block_sizze    # typo: not a Config field"
+    example_ok = "return cfg.block_size"
 
     def applies_to(self, ctx: FileContext) -> bool:
         return not ctx.is_test
@@ -242,6 +262,20 @@ class CrossWorkerState(Rule):
                "mutated from function scope: process-local but "
                "semantically node-wide — each gateway worker gets its "
                "own copy (counters read 1/N, limits admit N×)")
+    rationale = (
+        "Under the multi-process gateway (PR 8) every worker holds "
+        "its own copy of module-level state in api/ qos/ gateway/ "
+        "web/ — counters silently read 1/N, caches duplicate, limits "
+        "admit N×. Node-wide state belongs on instances wired "
+        "through Garage (aggregated by the supervisor) or leased via "
+        "the broker. Read-only lookup tables and import-time "
+        "construction are exempt.")
+    example_fire = ("PENDING = {}\n"
+                    "async def handle(req):\n"
+                    "    PENDING[req.id] = req   # per-worker copy")
+    example_ok = ("STATUS = {200: 'OK'}          # read-only table\n"
+                  "def reason(code):\n"
+                  "    return STATUS.get(code)")
 
     def applies_to(self, ctx: FileContext) -> bool:
         if ctx.is_test:

@@ -22,6 +22,13 @@ from .core import (FileContext, META_RULE, ProjectState, Rule, Violation,
 # violations on purpose)
 EXCLUDE_DIRS = {"__pycache__", ".git", "fixtures"}
 
+# harness files (FileContext.is_harness: clusterbox / conftest / bench)
+# run exactly this subset — harness code orphaning tasks or swallowing
+# exceptions silently corrupts chaos-soak verdicts, but the
+# production-invariant rules (async hygiene, hedge/SSE-C flow, config
+# drift) do not apply to driver code (ISSUE 9 satellite)
+HARNESS_RULES = {"GL04", "GL05", "GL07"}
+
 LOCK_HINT = "lock"
 
 
@@ -77,7 +84,10 @@ class FileAnalyzer:
         """Single traversal; waiver application is the CALLER's step
         (after cross-file rules settle, so their violations are
         waivable too)."""
-        rules = [r for r in self.rules if r.applies_to(ctx)]
+        if ctx.is_harness:
+            rules = [r for r in self.rules if r.id in HARNESS_RULES]
+        else:
+            rules = [r for r in self.rules if r.applies_to(ctx)]
         if not rules:
             return
         hooks = {
@@ -108,10 +118,13 @@ class FileAnalyzer:
         elif isinstance(node, ast.ClassDef):
             ctx.class_stack.append(node.name)
             push_class = True
-        elif isinstance(node, ast.AsyncWith):
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            # sync `with lock():` counts too (ISSUE 9): a threading
+            # lock held across an await inside an async frame blocks
+            # every other task on the loop exactly like an async lock
             if any(_looks_like_lock(item.context_expr)
                    for item in node.items):
-                ctx.async_lock_stack.append(node)
+                ctx.lock_stack.append(node)
                 push_lock = True
         elif isinstance(node, ast.Call):
             for r in hooks["call"]:
@@ -137,7 +150,7 @@ class FileAnalyzer:
         if push_class:
             ctx.class_stack.pop()
         if push_lock:
-            ctx.async_lock_stack.pop()
+            ctx.lock_stack.pop()
 
 
 def iter_python_files(paths: list[str]) -> list[str]:
@@ -156,11 +169,66 @@ def iter_python_files(paths: list[str]) -> list[str]:
     return out
 
 
+def _needs_dataflow(rules: list[Rule]) -> bool:
+    return any(getattr(r, "needs_dataflow", False) for r in rules)
+
+
+def _build_dataflow(project: ProjectState, rules: list[Rule]) -> None:
+    """Pass 1 of the interprocedural engine (ISSUE 9): per-function
+    summaries + call graph, shared by every needs_dataflow rule.
+    Cache-aware: project.data["summary_cache"] (loaded by the CLI from
+    --summary-cache) short-circuits the summary walk for files whose
+    hash is unchanged."""
+    if not _needs_dataflow(rules):
+        return
+    files = [c for c in project.files if c.tree is not None]
+    if "_dataflow" in project.data \
+            and project.data.get("_dataflow_files") == len(files):
+        return
+    from .dataflow import DataflowState
+
+    project.data["_dataflow"] = DataflowState(
+        files, summary_cache=project.data.get("summary_cache"))
+    project.data["_dataflow_files"] = len(files)
+
+
+def _settle_project(project: ProjectState, rules: list[Rule],
+                    restricted: bool = False) -> list[Violation]:
+    """Run cross-file rules, attach their violations to the owning
+    file context (so they are waivable at the line they land on), then
+    apply waivers everywhere. Returns violations that matched no
+    scanned file (stray). `restricted` marks a --rules subset run:
+    waivers for rules that did not run are exempt from the staleness
+    check (a full run still checks every waiver, typos included)."""
+    _build_dataflow(project, rules)
+    by_rel = {c.rel_path: c for c in project.files}
+    # idempotent under re-settling: a repeated finish_project (shared
+    # project across analyze_source calls) must not duplicate findings
+    seen = {v.key() for c in project.files for v in c.violations}
+    stray: list[Violation] = []
+    for r in rules:
+        for v in r.finish_project(project):
+            if v.key() in seen:
+                continue
+            seen.add(v.key())
+            ctx = by_rel.get(v.path)
+            if ctx is not None:
+                ctx.violations.append(v)
+            else:
+                stray.append(v)
+    active = {r.id for r in rules} if restricted else None
+    for c in project.files:
+        c.apply_waivers(active_rules=active)
+    return stray
+
+
 def analyze_source(source: str, rules: list[Rule],
                    rel_path: str = "<memory>.py",
                    project: ProjectState | None = None) -> FileContext:
-    """Analyze one in-memory module (the fixture-test entry point).
-    Parse failures surface as a GL00 violation, never an exception."""
+    """Analyze one in-memory module (the fixture-test entry point)
+    through the FULL pipeline, cross-file/dataflow rules included —
+    the mini-project contains just this file. Parse failures surface
+    as a GL00 violation, never an exception."""
     if project is None:
         project = ProjectState()
     try:
@@ -168,6 +236,7 @@ def analyze_source(source: str, rules: list[Rule],
     except SyntaxError as e:
         ctx = FileContext(rel_path, rel_path, "", ast.Module(body=[],
                                                              type_ignores=[]))
+        ctx.tree = None
         ctx.violations.append(Violation(
             rule=META_RULE, path=rel_path, line=e.lineno or 1,
             col=e.offset or 0, message=f"unparseable: {e.msg}"))
@@ -175,14 +244,15 @@ def analyze_source(source: str, rules: list[Rule],
         return ctx
     ctx = FileContext(rel_path, rel_path, source, tree)
     FileAnalyzer(rules).run(ctx)
-    ctx.apply_waivers()
     project.files.append(ctx)
+    _settle_project(project, rules)
     return ctx
 
 
 def analyze_paths(paths: list[str], rules: list[Rule],
                   root: str | None = None,
-                  data: dict | None = None) -> tuple[list[Violation],
+                  data: dict | None = None,
+                  restricted: bool = False) -> tuple[list[Violation],
                                                      ProjectState]:
     """Analyze every .py under `paths`; returns (violations, project).
     Violations include waived/baselined-candidate ones — the caller
@@ -214,17 +284,7 @@ def analyze_paths(paths: list[str], rules: list[Rule],
     # cross-file rules settle BEFORE waivers, so their violations are
     # waivable at the line they land on (e.g. a config.py field read
     # only via getattr carries its own inline waiver)
-    by_rel = {c.rel_path: c for c in project.files}
-    stray: list[Violation] = []
-    for r in rules:
-        for v in r.finish_project(project):
-            ctx = by_rel.get(v.path)
-            if ctx is not None:
-                ctx.violations.append(v)
-            else:
-                stray.append(v)
-    for c in project.files:
-        c.apply_waivers()
+    stray = _settle_project(project, rules, restricted=restricted)
     violations = [v for c in project.files for v in c.violations] + stray
     violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return violations, project

@@ -342,6 +342,7 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
             # read-your-writes is preserved
             from ...table.table import queue_insert_local_many
 
+            # lint: ignore[GL10] measured (ISSUE 9): this deliberately tiny two-row tx (see comment above) costs less than the to_thread handoff on the per-block PUT path
             vk, bk = queue_insert_local_many([
                 (garage.version_table, v),
                 (garage.block_ref_table, BlockRef.new(h, version.uuid)),

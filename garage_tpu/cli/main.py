@@ -61,7 +61,7 @@ class AdminClient:
 async def cmd(args) -> int:
     if args.cmd in ("convert-db", "repair-offline"):
         return await _offline(args)  # no server connection
-    cfg = read_config(args.config)
+    cfg = await asyncio.to_thread(read_config, args.config)
     cli = AdminClient(cfg)
     try:
         await cli.connect()
@@ -142,7 +142,7 @@ async def _offline(args) -> int:
                 lockfile.release(fd)
         return 0
     if args.cmd == "repair-offline":
-        cfg = read_config(args.config)
+        cfg = await asyncio.to_thread(read_config, args.config)
         from ..model.garage import Garage
         from ..utils import lockfile
 
@@ -156,11 +156,16 @@ async def _offline(args) -> int:
         try:
             garage = Garage(cfg)
             if args.what == "object-counters":
-                n = garage.object_counter.recount(garage.object_table.data)
-                n += garage.mpu_counter.recount(garage.mpu_table.data)
+                n = await asyncio.to_thread(
+                    garage.object_counter.recount,
+                    garage.object_table.data)
+                n += await asyncio.to_thread(
+                    garage.mpu_counter.recount, garage.mpu_table.data)
                 print(f"recomputed {n} object/mpu counter rows")
             elif args.what == "k2v-counters":
-                n = garage.k2v_counter.recount(garage.k2v_item_table.data)
+                n = await asyncio.to_thread(
+                    garage.k2v_counter.recount,
+                    garage.k2v_item_table.data)
                 print(f"recomputed {n} k2v counter rows")
             else:
                 print(f"unknown offline repair {args.what!r}",

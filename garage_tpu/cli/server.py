@@ -26,12 +26,22 @@ async def run_server(cfg_path: str) -> None:
     from ..utils.runtime import tune
 
     tune()
-    cfg = read_config(cfg_path)
+    cfg = await asyncio.to_thread(read_config, cfg_path)
     from ..utils import lockfile
 
     # held for the server's lifetime; repair-offline/convert-db take the
     # same lock, so offline maintenance can't race a live node
     lock_fd = lockfile.acquire(cfg.metadata_dir, "server")
+    try:
+        await _run_server_locked(cfg, cfg_path)
+    finally:
+        # released on EVERY exit (GL11): a failed Garage boot or
+        # frontend bind must not leave the lock held when the caller
+        # (tests, repair-offline in the same process) survives us
+        lockfile.release(lock_fd)
+
+
+async def _run_server_locked(cfg, cfg_path: str) -> None:
     garage = Garage(cfg)
     admin = AdminRpcHandler(garage)
     otlp = None
@@ -129,7 +139,6 @@ async def run_server(cfg_path: str) -> None:
     system_task.cancel()
     if otlp is not None:
         otlp.stop()
-    lockfile.release(lock_fd)
 
 
 def main() -> None:

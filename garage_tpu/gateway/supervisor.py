@@ -128,7 +128,7 @@ class GatewaySupervisor:
                     "(SO_REUSEPORT does not apply to unix sockets): "
                     f"{bind}")
         for i in range(self.n):
-            self._spawn(i)
+            await asyncio.to_thread(self._spawn, i)
         self._monitor_task = spawn(self._monitor_loop(),
                                    "gateway-supervisor-monitor")
         deadline = time.monotonic() + ready_timeout
@@ -165,7 +165,10 @@ class GatewaySupervisor:
                 if time.monotonic() - wp.last_spawn >= backoff:
                     wp.restarts += 1
                     self.restarts_total += 1
-                    self._spawn(wp.index)
+                    # fork+exec off the loop: a slow spawn (cold page
+                    # cache, cgroup pressure) must not stall the
+                    # supervisor's own frontends
+                    await asyncio.to_thread(self._spawn, wp.index)
 
     async def stop(self) -> None:
         self._stopping = True

@@ -293,8 +293,8 @@ async def run_worker(cfg_path: str, index: int, workers: int,
     from ..utils.runtime import tune
 
     tune()
-    cfg = read_config(cfg_path)
-    from ..model.garage import Garage, parse_peer
+    cfg = await asyncio.to_thread(read_config, cfg_path)
+    from ..model.garage import parse_peer
 
     store_addr, store_id = parse_peer(store)
     if store_id is None:
@@ -304,6 +304,19 @@ async def run_worker(cfg_path: str, index: int, workers: int,
     from ..utils import lockfile
 
     lock_fd = lockfile.acquire(wcfg.metadata_dir, "server")
+    try:
+        await _run_worker_locked(cfg, wcfg, index, store_id)
+    finally:
+        # released on EVERY exit (GL11): a worker that dies during
+        # boot must not wedge its per-index lockfile for the respawn
+        # (the PR 8 orphan-worker failure shape)
+        lockfile.release(lock_fd)
+
+
+async def _run_worker_locked(cfg, wcfg, index: int,
+                             store_id: bytes) -> None:
+    from ..model.garage import Garage
+
     garage = Garage(wcfg)
     stop = asyncio.Event()
     loop = asyncio.get_event_loop()
@@ -355,7 +368,6 @@ async def run_worker(cfg_path: str, index: int, workers: int,
         await s.stop()
     await garage.stop()
     system_task.cancel()
-    lockfile.release(lock_fd)
 
 
 def main() -> None:

@@ -45,9 +45,13 @@ class _TableRepairWorker(Worker):
         self.done = False
 
     async def work(self):
+        import asyncio
+
         store = self.table.data.store
-        batch = list(store.iter(start=self._pos + b"\x00" if self._pos
-                                else None, limit=BATCH))
+        batch = await asyncio.to_thread(
+            lambda: list(store.iter(
+                start=self._pos + b"\x00" if self._pos else None,
+                limit=BATCH)))
         if not batch:
             log.info("%s: finished, examined %d, fixed %d", self.name,
                      self.counter, self.repairs)
@@ -152,10 +156,10 @@ class BlockRcRepair(Worker):
         import asyncio
 
         rc = self.garage.block_manager.rc
-        hashes = []
-        for h in rc.tree.iter(start=self._cursor + b"\x00"
-                              if self._cursor else None, limit=BATCH):
-            hashes.append(h[0])
+        hashes = await asyncio.to_thread(
+            lambda: [h[0] for h in rc.tree.iter(
+                start=self._cursor + b"\x00" if self._cursor else None,
+                limit=BATCH)])
         if not hashes:
             log.info("block rc repair: finished, %d recalculated",
                      self.counter)

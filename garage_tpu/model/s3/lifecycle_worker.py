@@ -96,9 +96,12 @@ class LifecycleWorker(Worker):
             self._expired = self._aborted = 0
             log.info("lifecycle pass starting for %s", self._running_date)
 
+        import asyncio
+
         store = self.garage.object_table.data.store
-        batch = list(store.iter(start=self._next_start or None,
-                                limit=BATCH))
+        batch = await asyncio.to_thread(
+            lambda: list(store.iter(start=self._next_start or None,
+                                    limit=BATCH)))
         if not batch:
             log.info("lifecycle pass for %s done: %d expired, %d mpu "
                      "aborted", self._running_date, self._expired,

@@ -159,19 +159,24 @@ class KubernetesDiscovery(DiscoveryProvider):
             "spec": {"hostname": addr[0], "port": addr[1],
                      "nodeId": node_id.hex()},
         }
+        # _headers() reads the service-account token file — build it
+        # INSIDE the worker thread (as a to_thread argument it would
+        # evaluate on the loop before the hop)
         status, body = await asyncio.to_thread(
-            _http, "PUT", self._url(name), cr, self._headers(), self._ssl())
+            lambda: _http("PUT", self._url(name), cr, self._headers(),
+                          self._ssl()))
         if status == 404:  # CR does not exist yet: create
             status, body = await asyncio.to_thread(
-                _http, "POST", self._url(), cr, self._headers(),
-                self._ssl())
+                lambda: _http("POST", self._url(), cr, self._headers(),
+                              self._ssl()))
         if status not in (200, 201):
             raise RuntimeError(
                 f"kubernetes register failed: {status} {body[:200]!r}")
 
     async def get_peers(self) -> list[Peer]:
         status, body = await asyncio.to_thread(
-            _http, "GET", self._url(), None, self._headers(), self._ssl())
+            lambda: _http("GET", self._url(), None, self._headers(),
+                          self._ssl()))
         if status != 200:
             raise RuntimeError(
                 f"kubernetes list failed: {status} {body[:200]!r}")

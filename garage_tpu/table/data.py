@@ -81,24 +81,8 @@ class TableData:
         on a bucket with a million keys the old unbounded iter()
         materialized every row after the start key just to return the
         first page."""
-        prefix = tree_key(pk, b"")
-        part_end = _prefix_upper_bound(prefix)
-        lo, hi = prefix, part_end
-        if prefix_sk is not None:
-            lo = tree_key(pk, prefix_sk)
-            hi = _prefix_upper_bound(lo) or part_end
-        if not reverse:
-            if start_sk is not None:
-                lo = max(lo, tree_key(pk, start_sk))
-            if end_sk is not None:
-                hi = min(hi, tree_key(pk, end_sk))
-        else:
-            # reverse: start_sk = inclusive upper start; end_sk =
-            # exclusive lower stop (keys must stay > end_sk)
-            if start_sk is not None:
-                hi = min(hi, tree_key(pk, start_sk) + b"\x00")
-            if end_sk is not None:
-                lo = max(lo, tree_key(pk, end_sk) + b"\x00")
+        lo, hi, prefix = self._range_bounds(pk, start_sk, reverse,
+                                            prefix_sk, end_sk)
         out = []
         while len(out) < limit:
             # filtered scans over-fetch a little so sparse matches don't
@@ -129,6 +113,61 @@ class TableData:
             else:
                 hi = batch[-1][0]
         return out
+
+    def _range_bounds(self, pk: bytes, start_sk: Optional[bytes],
+                      reverse: bool, prefix_sk: Optional[bytes],
+                      end_sk: Optional[bytes]
+                      ) -> tuple[bytes, Optional[bytes], bytes]:
+        """(lo, hi, partition prefix) engine bounds shared by
+        read_range and read_range_raw."""
+        prefix = tree_key(pk, b"")
+        part_end = _prefix_upper_bound(prefix)
+        lo, hi = prefix, part_end
+        if prefix_sk is not None:
+            lo = tree_key(pk, prefix_sk)
+            hi = _prefix_upper_bound(lo) or part_end
+        if not reverse:
+            if start_sk is not None:
+                lo = max(lo, tree_key(pk, start_sk))
+            if end_sk is not None:
+                hi = min(hi, tree_key(pk, end_sk))
+        else:
+            # reverse: start_sk = inclusive upper start; end_sk =
+            # exclusive lower stop (keys must stay > end_sk)
+            if start_sk is not None:
+                hi = min(hi, tree_key(pk, start_sk) + b"\x00")
+            if end_sk is not None:
+                lo = max(lo, tree_key(pk, end_sk) + b"\x00")
+        return lo, hi, prefix
+
+    def read_range_raw(self, pk: bytes, start_sk: Optional[bytes],
+                       limit: int, prefix_sk: Optional[bytes] = None,
+                       end_sk: Optional[bytes] = None
+                       ) -> tuple[list[tuple[bytes, bytes]],
+                                  Optional[bytes]]:
+        """Raw-cursor page (ISSUE 9): up to `limit` (sort_key, raw row)
+        pairs of one partition, forward order, NO per-row decode — the
+        sort key comes straight off the engine key, so callers that
+        page a range (k2v poll_range) advance their cursor without
+        decoding a single row they end up skipping. Returns
+        (rows, next_start_sk): next_start_sk is the sort key to resume
+        AFTER the last returned row, or None when the range is
+        exhausted."""
+        lo, hi, prefix = self._range_bounds(pk, start_sk, False,
+                                            prefix_sk, end_sk)
+        rows: list[tuple[bytes, bytes]] = []
+        plen = len(prefix)
+        while len(rows) < limit:
+            want = limit - len(rows)
+            batch = list(self.store.iter(start=lo, end=hi, limit=want))
+            for k, v in batch:
+                if not k.startswith(prefix):
+                    return rows, None
+                rows.append((k[plen:], v))
+            if len(batch) < want:
+                return rows, None
+            lo = batch[-1][0] + b"\x00"
+        return rows, (rows[-1][0] + b"\x00" if rows else None)
 
     def iter_all(self) -> Iterator[tuple[bytes, bytes]]:
         return self.store.iter()

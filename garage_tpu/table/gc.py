@@ -67,14 +67,19 @@ class TableGc(Worker):
 
     async def work(self):
         now_ms = int(time.time() * 1000)
-        batch: list[GcTodoEntry] = []
-        for k, v in self.data.gc_todo.iter():
-            e = GcTodoEntry.parse(k, v)
-            if e.deadline_ms > now_ms:
-                break
-            batch.append(e)
-            if len(batch) >= TABLE_GC_BATCH_SIZE:
-                break
+
+        def collect() -> list[GcTodoEntry]:
+            batch: list[GcTodoEntry] = []
+            for k, v in self.data.gc_todo.iter():
+                e = GcTodoEntry.parse(k, v)
+                if e.deadline_ms > now_ms:
+                    break
+                batch.append(e)
+                if len(batch) >= TABLE_GC_BATCH_SIZE:
+                    break
+            return batch
+
+        batch = await asyncio.to_thread(collect)
         if not batch:
             return WState.IDLE
         await self.gc_batch(batch)
@@ -109,7 +114,9 @@ class TableGc(Worker):
             by_nodes.setdefault(nodes, []).append(e)
 
         for nodes, entries in by_nodes.items():
-            raws = [self.data.store.get(e.row_key) for e in entries]
+            raws = await asyncio.to_thread(
+                lambda es=entries: [self.data.store.get(e.row_key)
+                                    for e in es])
             pairs = [(e, r) for e, r in zip(entries, raws) if r is not None]
             if not pairs:
                 continue
@@ -126,14 +133,16 @@ class TableGc(Worker):
                 items = [(e.row_key, e.value_hash) for e, _ in pairs]
                 for n in nodes:
                     if n == me:
-                        self._delete_if_eq(items)
+                        await asyncio.to_thread(self._delete_if_eq, items)
                     else:
                         await self.endpoint.call(
                             n, {"op": "delete_if_eq", "items": items},
                             PRIO_BACKGROUND,
                         )
-                for e, _ in pairs:
-                    self.data.gc_todo.remove(e.todo_key())
+                await asyncio.to_thread(
+                    lambda ps=pairs: [
+                        self.data.gc_todo.remove(e.todo_key())
+                        for e, _ in ps])
             except Exception as ex:
                 log.info("%s: gc batch failed (will retry): %s", self.name, ex)
 

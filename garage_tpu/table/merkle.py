@@ -383,7 +383,8 @@ class MerkleUpdater(Worker):
 
         # bounded cursor read: a deep backlog (bulk load, resync storm)
         # must not be materialized whole just to take the first BATCH
-        todo = list(self.data.merkle_todo.iter(limit=self.BATCH))
+        todo = await asyncio.to_thread(
+            lambda: list(self.data.merkle_todo.iter(limit=self.BATCH)))
         if not todo:
             return WState.IDLE
 

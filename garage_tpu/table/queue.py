@@ -26,7 +26,8 @@ class InsertQueueWorker(Worker):
         self.name = f"{table.name} queue"
 
     async def work(self):
-        batch = list(self.data.insert_queue.iter())[:BATCH_SIZE]
+        batch = await asyncio.to_thread(
+            lambda: list(self.data.insert_queue.iter())[:BATCH_SIZE])
         if not batch:
             return WState.IDLE
         await self.table.propagate_queue_batch(batch)

@@ -137,7 +137,10 @@ class TableSyncer(Worker):
 
     async def sync_partition_with(self, partition: int, peer: bytes) -> None:
         """Push items the peer is missing/behind on (ref: sync.rs:275-405)."""
-        if self.merkle.read_node(partition, b"").is_empty():
+        empty, my_root = await asyncio.to_thread(
+            lambda: (self.merkle.read_node(partition, b"").is_empty(),
+                     self.merkle.root_hash(partition)))
+        if empty:
             # nothing to push from an empty partition — and sync is
             # push-based, so the peer's own round covers the reverse
             # direction. With 256 partitions x every table x every
@@ -145,7 +148,6 @@ class TableSyncer(Worker):
             # ones is the difference between a resize round of ~10^2
             # and ~10^5 RPCs on a sparse table.
             return
-        my_root = self.merkle.root_hash(partition)
         resp = await self.endpoint.call(
             peer, {"op": "root_ck", "partition": partition}, PRIO_BACKGROUND
         )
@@ -155,7 +157,8 @@ class TableSyncer(Worker):
         await self._descend(partition, b"", peer)
 
     async def _descend(self, partition: int, prefix: bytes, peer: bytes) -> None:
-        mine = self.merkle.read_node(partition, prefix)
+        mine = await asyncio.to_thread(self.merkle.read_node,
+                                       partition, prefix)
         if mine.is_empty():
             return
         resp = await self.endpoint.call(
@@ -180,9 +183,13 @@ class TableSyncer(Worker):
                                 peer: bytes) -> None:
         """Push every row under a trie prefix; the trie's own leaves
         enumerate them (ref: sync.rs walks the merkle subtree)."""
-        row_keys = self.merkle.leaf_rows(partition, prefix)
-        items = [v for v in (self.data.store.get(k) for k in row_keys)
-                 if v is not None]
+        def read_rows():
+            row_keys = self.merkle.leaf_rows(partition, prefix)
+            return [v for v in (self.data.store.get(k)
+                                for k in row_keys)
+                    if v is not None]
+
+        items = await asyncio.to_thread(read_rows)
         for i in range(0, len(items), 64):
             await self.endpoint.call(
                 peer, {"op": "items", "entries": items[i:i + 64]},
@@ -199,7 +206,7 @@ class TableSyncer(Worker):
         if not new_owners:
             return
         while True:
-            batch = self._partition_rows(sp, limit=256)
+            batch = await asyncio.to_thread(self._partition_rows, sp, 256)
             if not batch:
                 return
             keys, vals = zip(*batch)
@@ -220,7 +227,7 @@ class TableSyncer(Worker):
                     tx.on_commit(
                         lambda: self.data._apply_bytes_delta(-freed))
 
-            self.data.db.transaction(body)
+            await asyncio.to_thread(self.data.db.transaction, body)
             self.data.merkle_todo_notify.set()
 
     def _partition_rows(self, sp, limit: int) -> list[tuple[bytes, bytes]]:
@@ -238,9 +245,13 @@ class TableSyncer(Worker):
     async def _handle(self, from_node: bytes, payload, stream):
         op = payload["op"]
         if op == "root_ck":
-            return {"hash": self.merkle.root_hash(payload["partition"])}
+            h = await asyncio.to_thread(self.merkle.root_hash,
+                                        payload["partition"])
+            return {"hash": h}
         if op == "get_node":
-            n = self.merkle.read_node(payload["partition"], payload["prefix"])
+            n = await asyncio.to_thread(self.merkle.read_node,
+                                        payload["partition"],
+                                        payload["prefix"])
             return {"node": n.pack()}
         if op == "items":
             await asyncio.to_thread(self.data.update_many, payload["entries"])

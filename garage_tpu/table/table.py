@@ -142,6 +142,7 @@ class Table:
         ph = partition_hash(entry.partition_key())
         with self.replication.write_lock():
             sets = self.replication.write_sets(ph)
+            # lint: ignore[GL06] write_lock is a layout-version PIN (refcount), not mutual exclusion; holding it across the quorum write IS the union-window contract (manager.rs:344)
             await self.rpc.try_write_many_sets(
                 self.endpoint,
                 sets,
@@ -173,6 +174,7 @@ class Table:
                         all_sets.append(s)
                 for n in dest:
                     per_node.setdefault(n, []).append(raw)
+            # lint: ignore[GL06] write_lock is a layout-version PIN (refcount), not mutual exclusion; holding it across the quorum write IS the union-window contract (manager.rs:344)
             await self.rpc.try_write_many_sets(
                 self.endpoint,
                 all_sets,
@@ -318,7 +320,7 @@ class Table:
                 if tx.get(self.data.insert_queue, k) == v:
                     tx.remove(self.data.insert_queue, k)
 
-        self.data.db.transaction(body)
+        await asyncio.to_thread(self.data.db.transaction, body)
 
     async def flush_insert_queue(self, keys=None) -> None:
         """Quorum-propagate queued rows AS OF NOW — only those whose
@@ -328,15 +330,19 @@ class Table:
         worker's) problem, so sustained load cannot starve a caller."""
         from .queue import BATCH_SIZE
 
-        if keys is None:
-            snapshot = list(self.data.insert_queue.iter())
-        else:  # O(|keys|) lookups, not an O(backlog) scan per request
-            snapshot = [(k, v) for k in keys
-                        if (v := self.data.insert_queue.get(k)) is not None]
+        def read_snapshot():
+            if keys is None:
+                return list(self.data.insert_queue.iter())
+            # O(|keys|) lookups, not an O(backlog) scan per request
+            return [(k, v) for k in keys
+                    if (v := self.data.insert_queue.get(k)) is not None]
+
+        snapshot = await asyncio.to_thread(read_snapshot)
         for i in range(0, len(snapshot), BATCH_SIZE):
             await self.propagate_queue_batch(snapshot[i:i + BATCH_SIZE])
 
     async def get_local(self, pk: bytes, sk: bytes) -> Optional[Entry]:
+        # lint: ignore[GL10] measured (ISSUE 9): this single-row page-cached db op costs less than the to_thread handoff it would ride; scans and multi-row transactions do hop
         raw = self.data.read_entry(pk, sk)
         return self.schema.decode_entry(raw) if raw is not None else None
 
@@ -348,6 +354,7 @@ class Table:
             await asyncio.to_thread(self.data.update_many, payload["entries"])
             return {"ok": True}
         if op == "read_entry":
+            # lint: ignore[GL10] measured (ISSUE 9): this single-row page-cached db op costs less than the to_thread handoff it would ride; scans and multi-row transactions do hop
             raw = self.data.read_entry(payload["pk"], payload["sk"])
             return {"entry": raw}
         if op == "read_range":

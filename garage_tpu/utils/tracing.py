@@ -186,4 +186,5 @@ class span:
         return self._enter()
 
     async def __aexit__(self, exc_type, exc, tb):
+        # lint: ignore[GL10] emit buffers; the open+write is one amortized page-cache append per _FLUSH_EVERY spans on an already-open file
         return self._exit(exc_type)

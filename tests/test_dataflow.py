@@ -1,0 +1,603 @@
+"""ISSUE 9: interprocedural dataflow engine tests — call-graph units
+(self-method / import / partial / to_thread edges, cycle tolerance),
+GL10/GL11 fire+suppress fixtures, upgraded GL02/GL03/GL06 fixtures,
+summary determinism (same tree -> byte-identical JSON), and the three
+acceptance regression pins (each re-introduced bug shape fails the CLI
+with exit 1)."""
+
+import ast
+import os
+import textwrap
+
+from garage_tpu.analysis import (CallGraph, analyze_source,
+                                 default_rules, summarize_tree,
+                                 summary_json)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(src: str, rel_path: str = "garage_tpu/fake/mod.py"):
+    ctx = analyze_source(textwrap.dedent(src), default_rules(),
+                         rel_path=rel_path)
+    return [v for v in ctx.violations if v.active]
+
+
+def rules_of(violations):
+    return sorted({v.rule for v in violations})
+
+
+def graph_of(src: str, rel="garage_tpu/fake/mod.py") -> CallGraph:
+    tree = ast.parse(textwrap.dedent(src))
+    return CallGraph({rel: summarize_tree(tree, rel)})
+
+
+# ---- call graph units ---------------------------------------------------
+
+def test_callgraph_self_method_edge():
+    g = graph_of("""
+        class A:
+            def helper(self):
+                return 1
+            def top(self):
+                return self.helper()
+    """)
+    edges = g.edges_from("garage_tpu.fake.mod:A.top")
+    assert [e[0] for e in edges] == ["garage_tpu.fake.mod:A.helper"]
+
+
+def test_callgraph_to_thread_edge_is_via_thread():
+    g = graph_of("""
+        import asyncio
+        def work():
+            return 1
+        async def top():
+            return await asyncio.to_thread(work)
+    """)
+    edges = g.edges_from("garage_tpu.fake.mod:top")
+    hits = [(c, r["via_thread"]) for c, r in edges
+            if c.endswith(":work")]
+    assert hits == [("garage_tpu.fake.mod:work", True)]
+
+
+def test_callgraph_partial_unwrap_edge():
+    g = graph_of("""
+        from functools import partial
+        def work(x):
+            return x
+        def top(x):
+            f = partial(work, x)
+            return f()
+    """)
+    edges = g.edges_from("garage_tpu.fake.mod:top")
+    assert any(c.endswith(":work") and not r["via_thread"]
+               for c, r in edges)
+
+
+def test_callgraph_nested_def_resolves_before_module_level():
+    g = graph_of("""
+        def work():
+            return "module"
+        def top():
+            def work():
+                return "nested"
+            return work()
+    """)
+    edges = g.edges_from("garage_tpu.fake.mod:top")
+    assert [c for c, _ in edges] == ["garage_tpu.fake.mod:top.work"]
+
+
+def test_callgraph_cycle_tolerance():
+    g = graph_of("""
+        import time
+        def a(n):
+            return b(n)
+        def b(n):
+            if n:
+                return a(n - 1)
+            time.sleep(1)
+    """)
+    # reachability over the a <-> b cycle terminates and still finds
+    # the atom in b
+    chains = list(g.blocking_chains("garage_tpu.fake.mod:a"))
+    assert any(chain[-1]["target"] == "time.sleep" for chain in chains)
+
+
+def test_callgraph_unique_method_cha():
+    g = graph_of("""
+        class Store:
+            def read_rows(self):
+                return []
+        class User:
+            def go(self, store):
+                return store.read_rows()
+    """)
+    edges = g.edges_from("garage_tpu.fake.mod:User.go")
+    assert [c for c, _ in edges] == ["garage_tpu.fake.mod:Store.read_rows"]
+
+
+def test_callgraph_ambiguous_method_yields_no_edge():
+    g = graph_of("""
+        class A:
+            def read_rows(self):
+                return []
+        class B:
+            def read_rows(self):
+                return []
+        class User:
+            def go(self, x):
+                return x.read_rows()
+    """)
+    assert g.edges_from("garage_tpu.fake.mod:User.go") == []
+
+
+def test_callgraph_base_class_method_edge():
+    g = graph_of("""
+        class Base:
+            def helper(self):
+                return 1
+        class Child(Base):
+            def top(self):
+                return self.helper()
+    """)
+    edges = g.edges_from("garage_tpu.fake.mod:Child.top")
+    assert [c for c, _ in edges] == ["garage_tpu.fake.mod:Base.helper"]
+
+
+# ---- GL10 blocking-reachable-from-async ---------------------------------
+
+def test_gl10_fires_two_frames_down_with_chain():
+    vs = run("""
+        import sqlite3
+        def scan(path):
+            return sqlite3.connect(path)
+        def outer(path):
+            return scan(path)
+        async def handler(path):
+            return outer(path)
+    """)
+    assert rules_of(vs) == ["GL10"]
+    assert "handler -> outer -> scan" in vs[0].message
+    assert "sqlite3.connect" in vs[0].message
+
+
+def test_gl10_quiet_when_hopped_through_to_thread():
+    vs = run("""
+        import asyncio, sqlite3
+        def scan(path):
+            return sqlite3.connect(path)
+        async def handler(path):
+            return await asyncio.to_thread(scan, path)
+    """)
+    assert vs == []
+
+
+def test_gl10_quiet_for_sync_only_callers_and_generators():
+    vs = run("""
+        import sqlite3
+        def scan(path):
+            return sqlite3.connect(path)
+        def sync_caller(path):
+            return scan(path)
+        def gen(path):
+            yield sqlite3.connect(path)
+        async def uses_gen(path):
+            return gen(path)          # calling a generator runs nothing
+    """)
+    assert vs == []
+
+
+def test_gl10_direct_blocking_is_gl01_not_gl10():
+    vs = run("""
+        import time
+        async def handler():
+            time.sleep(1)
+    """)
+    assert rules_of(vs) == ["GL01"]
+
+
+def test_gl10_db_seam_direct_in_async():
+    vs = run("""
+        async def handler(self, pk):
+            return self.store.get(pk)
+    """)
+    assert rules_of(vs) == ["GL10"]
+    assert "sync db call" in vs[0].message
+
+
+def test_gl10_waivable_with_reason():
+    vs = run("""
+        import sqlite3
+        def scan(path):
+            return sqlite3.connect(path)
+        async def handler(path):
+            # lint: ignore[GL10] one-shot startup path, loop not serving yet
+            return scan(path)
+    """)
+    assert vs == []
+
+
+# ---- GL11 leaked-budget-on-exception ------------------------------------
+
+def test_gl11_fires_on_happy_path_refund():
+    vs = run("""
+        async def handle(self, n):
+            tok = await self.bucket.acquire(n)
+            resp = await self.upstream(n)
+            self.bucket.refund(n)
+            return resp
+    """)
+    assert rules_of(vs) == ["GL11"]
+    assert "happy" in vs[0].message
+
+
+def test_gl11_quiet_on_safe_shapes():
+    vs = run("""
+        async def with_finally(self, n):
+            await self.bucket.acquire(n)
+            try:
+                return await self.upstream(n)
+            finally:
+                self.bucket.refund(n)
+        async def refund_on_failure(self, n):
+            await self.bucket.acquire(n)
+            try:
+                return await self.upstream(n)
+            except Exception:
+                self.bucket.refund(n)
+                raise
+        async def plain_admission(self, n):
+            await self.bucket.acquire(n)
+            return await self.upstream(n)
+        async def context_manager(self, n):
+            async with self.sem.acquire():
+                return await self.upstream(n)
+    """)
+    assert vs == []
+
+
+def test_gl11_release_via_bound_value():
+    vs = run("""
+        async def handle(self, n):
+            lease = await self.broker.acquire(n)
+            resp = await self.upstream(n)
+            lease.release()
+            return resp
+    """)
+    assert rules_of(vs) == ["GL11"]
+
+
+# ---- upgraded GL02: interprocedural strategies --------------------------
+
+GL02_HELPER = """
+    class H:
+        async def _call_any(self, who, payload, strategy):
+            await self.rpc.try_call_many(self.ep, who, payload, strategy)
+
+        async def insert(self, who, payload):
+            await self._call_any(who, payload, %s)
+"""
+
+
+def test_gl02_unpinned_strategy_through_helper_fires_at_caller():
+    vs = run(GL02_HELPER % "RequestStrategy(quorum=1)")
+    assert rules_of(vs) == ["GL02"]
+    assert "hedge-sensitive" in vs[0].message
+    assert vs[0].line == 7  # the CALLER's call site
+
+
+def test_gl02_pinned_strategy_through_helper_is_quiet():
+    assert run(GL02_HELPER % "RequestStrategy(quorum=1, hedge=False)") \
+        == []
+
+
+def test_gl02_read_context_caller_is_quiet():
+    vs = run("""
+        class H:
+            async def _call_any(self, who, payload, strategy):
+                await self.rpc.try_call_many(self.ep, who, payload,
+                                             strategy)
+
+            async def get_traced(self, who, payload):
+                await self._call_any(who, payload,
+                                     RequestStrategy(quorum=1))
+    """)
+    assert vs == []
+
+
+def test_gl02_mutating_helper_fires_for_any_caller():
+    vs = run("""
+        class H:
+            async def insert_rpc(self, who, payload, strategy):
+                await self.rpc.try_call_many(self.ep, who, payload,
+                                             strategy)
+
+            async def kick(self, who, payload):
+                await self.insert_rpc(who, payload,
+                                      RequestStrategy(quorum=1))
+    """)
+    assert rules_of(vs) == ["GL02"]
+
+
+def test_gl02_helper_with_strategy_param_no_longer_fires_at_helper():
+    # PR 5's syntactic rule flagged the helper itself (unresolvable
+    # strategy in mutation context); the dataflow engine blames callers
+    vs = run("""
+        class H:
+            async def insert_rpc(self, who, payload, strategy):
+                await self.rpc.try_call_many(self.ep, who, payload,
+                                             strategy)
+    """)
+    assert vs == []
+
+
+# ---- upgraded GL03: taint across helpers --------------------------------
+
+S3 = "garage_tpu/api/s3/fake_get.py"
+
+
+def test_gl03_taint_crosses_one_helper_hop():
+    vs = run("""
+        async def helper(mgr, h, key):
+            return await mgr.rpc_get_block(h)
+
+        async def stream(mgr, h, sse_key):
+            return await helper(mgr, h, sse_key)
+    """, rel_path=S3)
+    assert rules_of(vs) == ["GL03"]
+    assert "tainted via stream" in vs[0].message
+
+
+def test_gl03_taint_crosses_two_hops():
+    vs = run("""
+        async def inner(mgr, h, k2):
+            return await mgr.rpc_get_block(h)
+
+        async def helper(mgr, h, k1):
+            return await inner(mgr, h, k1)
+
+        async def stream(mgr, h, sse_key):
+            return await helper(mgr, h, sse_key)
+    """, rel_path=S3)
+    assert rules_of(vs) == ["GL03"]
+
+
+def test_gl03_quiet_with_cacheable_at_helper_or_untainted():
+    vs = run("""
+        async def helper(mgr, h, key):
+            return await mgr.rpc_get_block(h, cacheable=key is None)
+
+        async def stream(mgr, h, sse_key):
+            return await helper(mgr, h, sse_key)
+
+        async def plain(mgr, h, color):
+            return await helper2(mgr, h, color)
+
+        async def helper2(mgr, h, key):
+            return await mgr.rpc_get_block(h)
+    """, rel_path=S3)
+    assert vs == []
+
+
+def test_gl03_decrypt_result_is_a_source():
+    vs = run("""
+        async def reseal(mgr, h, wrapped):
+            plain = decrypt_block(wrapped)
+            await mgr.rpc_put_block(h, plain)
+    """, rel_path=S3)
+    assert rules_of(vs) == ["GL03"]
+
+
+def test_gl03_tainted_payload_into_cache_insert():
+    vs = run("""
+        def fill(cache, h, sse_payload):
+            cache.insert(h, sse_payload)
+    """, rel_path="garage_tpu/block/fake.py")
+    assert rules_of(vs) == ["GL03"]
+    assert "cache" in vs[0].message
+
+
+def test_gl03_gateway_forwards_in_scope():
+    vs = run("""
+        async def forward(mgr, h, sse_key):
+            return await mgr.rpc_get_block(h)
+    """, rel_path="garage_tpu/gateway/fake.py")
+    assert rules_of(vs) == ["GL03"]
+
+
+# ---- upgraded GL06: sync with-lock --------------------------------------
+
+def test_gl06_sync_with_lock_across_await_fires():
+    vs = run("""
+        async def refresh(self, payload):
+            with self._lock:
+                await self.rpc.try_call_many(self.ep, self.nodes,
+                                             payload, st)
+    """, rel_path="garage_tpu/block/fake.py")
+    assert rules_of(vs) == ["GL06"]
+
+
+def test_gl06_sync_lock_in_sync_fn_quiet():
+    vs = run("""
+        def compute(self):
+            with self._lock:
+                return self.table[0]
+    """, rel_path="garage_tpu/block/fake.py")
+    assert vs == []
+
+
+def test_gl02_unpinned_strategy_through_non_self_receiver():
+    """`await c.call_write(...)` (CHA-resolved dotted ref) must shift
+    the bound self exactly like `self.call_write(...)` — positional
+    args land on the right parameters."""
+    vs = run("""
+        class Caller:
+            async def call_write(self, ep, who, payload, strategy):
+                await self.rpc.try_call_many(ep, who, payload, strategy)
+
+        class User:
+            async def insert(self, c, ep, who, payload):
+                await c.call_write(ep, who, payload,
+                                   RequestStrategy(quorum=1))
+    """)
+    assert rules_of(vs) == ["GL02"]
+
+
+def test_gl10_extra_io_atom_direct_in_async_frame():
+    # os.replace is GL10's atom, not GL01's: typed directly in the
+    # async frame it must STILL fire (inlining a flagged helper must
+    # not make the finding disappear)
+    vs = run("""
+        import os
+        async def commit(a, b):
+            os.replace(a, b)
+    """)
+    assert rules_of(vs) == ["GL10"]
+    assert "directly on the event loop" in vs[0].message
+
+
+def test_shared_project_resettles_idempotently():
+    """analyze_source with a shared ProjectState must not duplicate
+    stale-waiver hygiene or finish_project findings, and later files
+    must still be analyzed by the dataflow rules."""
+    from garage_tpu.analysis import ProjectState
+
+    p = ProjectState()
+    rules = default_rules()
+    ctx1 = analyze_source(textwrap.dedent("""
+        def f():  # lint: ignore[GL05] nothing fires here
+            return 1
+    """), rules, rel_path="garage_tpu/a.py", project=p)
+    ctx2 = analyze_source(textwrap.dedent("""
+        import sqlite3
+        def scan(path):
+            return sqlite3.connect(path)
+        async def handler(path):
+            return scan(path)
+    """), rules, rel_path="garage_tpu/b.py", project=p)
+    stale = [v for v in ctx1.violations if "stale waiver" in v.message]
+    assert len(stale) == 1  # not duplicated by the second settle
+    assert [v.rule for v in ctx2.violations if v.active] == ["GL10"]
+
+
+# ---- module / import resolution -----------------------------------------
+
+def test_callgraph_relative_import_in_package_init():
+    """`from .core import helper` inside pkg/__init__.py resolves
+    against pkg itself, not pkg's parent (the __init__ component is
+    already collapsed out of the module name)."""
+    core_src = textwrap.dedent("""
+        import sqlite3
+        def helper(path):
+            return sqlite3.connect(path)
+    """)
+    init_src = textwrap.dedent("""
+        from .core import helper
+        async def top(path):
+            return helper(path)
+    """)
+    g = CallGraph({
+        "garage_tpu/pkg/core.py": summarize_tree(
+            ast.parse(core_src), "garage_tpu/pkg/core.py"),
+        "garage_tpu/pkg/__init__.py": summarize_tree(
+            ast.parse(init_src), "garage_tpu/pkg/__init__.py"),
+    })
+    edges = g.edges_from("garage_tpu.pkg:top")
+    assert [c for c, _ in edges] == ["garage_tpu.pkg.core:helper"]
+    chains = list(g.blocking_chains("garage_tpu.pkg:top"))
+    assert any(c[-1]["target"] == "sqlite3.connect" for c in chains)
+
+
+def test_summary_cache_rejects_other_engine_versions():
+    from garage_tpu.analysis import DataflowState
+    from garage_tpu.analysis.core import FileContext
+    from garage_tpu.analysis.dataflow import SUMMARY_VERSION
+
+    src = "def f():\n    return 1\n"
+    ctx = FileContext("m.py", "garage_tpu/m.py", src, ast.parse(src))
+    fresh = DataflowState([ctx])
+    good = fresh.cache_payload()
+    assert good["garage_tpu/m.py"]["v"] == SUMMARY_VERSION
+    hit = DataflowState([ctx], summary_cache=good)
+    assert hit.cache_hits == 1
+    stale = {k: dict(v, v=SUMMARY_VERSION - 1) for k, v in good.items()}
+    miss = DataflowState([ctx], summary_cache=stale)
+    assert miss.cache_hits == 0
+    assert miss.summaries == fresh.summaries  # recomputed, not trusted
+
+
+# ---- summary determinism -------------------------------------------------
+
+def test_summary_cache_determinism_same_tree_byte_identical():
+    src = open(os.path.join(REPO, "garage_tpu/table/data.py"),
+               encoding="utf-8").read()
+    a = summary_json(summarize_tree(ast.parse(src),
+                                    "garage_tpu/table/data.py"))
+    b = summary_json(summarize_tree(ast.parse(src),
+                                    "garage_tpu/table/data.py"))
+    assert a == b
+    assert a  # non-trivial
+
+
+def test_summary_determinism_across_the_analysis_package():
+    pkg = os.path.join(REPO, "garage_tpu", "analysis")
+    for f in sorted(os.listdir(pkg)):
+        if not f.endswith(".py"):
+            continue
+        src = open(os.path.join(pkg, f), encoding="utf-8").read()
+        rel = f"garage_tpu/analysis/{f}"
+        assert summary_json(summarize_tree(ast.parse(src), rel)) == \
+            summary_json(summarize_tree(ast.parse(src), rel)), f
+
+
+# ---- acceptance regression pins (ISSUE 9) -------------------------------
+
+def _cli_rc_on(tmp_path, source: str, rel: str) -> int:
+    from garage_tpu.analysis.__main__ import main
+
+    target = tmp_path / rel
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(textwrap.dedent(source))
+    return main(["--baseline", "none", str(target)])
+
+
+def test_regression_a_ssec_through_helper_exits_1(tmp_path, capsys):
+    rc = _cli_rc_on(tmp_path, """
+        async def helper(mgr, h, key):
+            return await mgr.rpc_get_block(h)
+
+        async def stream(mgr, h, sse_key):
+            return await helper(mgr, h, sse_key)
+    """, "garage_tpu/api/s3/get2.py")
+    assert rc == 1
+    assert "GL03" in capsys.readouterr().out
+
+
+def test_regression_b_sqlite_two_frames_below_async_exits_1(
+        tmp_path, capsys):
+    rc = _cli_rc_on(tmp_path, """
+        import sqlite3
+
+        def read_row(path, k):
+            return sqlite3.connect(path).execute(
+                "select v from t where k=?", (k,)).fetchone()
+
+        def lookup(path, k):
+            return read_row(path, k)
+
+        async def handler(path, k):
+            return lookup(path, k)
+    """, "garage_tpu/table/fake_srv.py")
+    assert rc == 1
+    assert "GL10" in capsys.readouterr().out
+
+
+def test_regression_c_happy_path_refund_exits_1(tmp_path, capsys):
+    rc = _cli_rc_on(tmp_path, """
+        async def admit(self, n):
+            tok = await self.bucket.acquire(n)
+            resp = await self.forward(n)
+            self.bucket.refund(n)
+            return resp
+    """, "garage_tpu/qos/fake_admit.py")
+    assert rc == 1
+    assert "GL11" in capsys.readouterr().out

@@ -121,6 +121,34 @@ def test_read_range_and_limits(tmp_path, db_engine):
     assert data.decode_stored(rows[0]).sk == b"k19"
 
 
+def test_read_range_raw_cursor_pages_without_decode(tmp_path, db_engine):
+    """ISSUE 9: the raw-cursor variant pages a partition with sort keys
+    sliced off the engine key — no per-row decode — and agrees with the
+    decoded read_range. k2v poll_range pages through this."""
+    data = make_data(tmp_path, engine=db_engine)
+    for i in range(20):
+        data.update_entry_decoded(KvEntry.new(b"p", b"k%02d" % i, i))
+    data.update_entry_decoded(KvEntry.new(b"other", b"x", 99))
+
+    rows, cur = data.read_range_raw(b"p", None, 5)
+    assert [sk for sk, _ in rows] == [b"k00", b"k01", b"k02", b"k03",
+                                      b"k04"]
+    assert cur == b"k04\x00"
+    # resume from the returned cursor; raw values decode identically
+    rows2, cur2 = data.read_range_raw(b"p", cur, 100)
+    assert [sk for sk, _ in rows2] == [b"k%02d" % i for i in range(5, 20)]
+    assert cur2 is None  # range exhausted
+    assert [data.decode_stored(v).sk for _, v in rows2] == \
+        [sk for sk, _ in rows2]
+    # prefix / end bounds match read_range semantics
+    rows3, _ = data.read_range_raw(b"p", None, 100, prefix_sk=b"k1",
+                                   end_sk=b"k15")
+    assert [sk for sk, _ in rows3] == [b"k10", b"k11", b"k12", b"k13",
+                                       b"k14"]
+    # the sibling partition never bleeds in
+    assert all(not sk.startswith(b"x") for sk, _ in rows + rows2)
+
+
 def test_merkle_root_order_independent(tmp_path, db_engine):
     d1 = make_data(tmp_path, "a", engine=db_engine)
     d2 = make_data(tmp_path, "b", engine=db_engine)
