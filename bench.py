@@ -362,6 +362,7 @@ async def _put_cluster_bench(tmp: str, platform: str, nblocks: int,
         scrub_bps = max(scrub_bps, nblocks / (time.perf_counter() - t0))
 
     feeder_stats = dict(managers[0].feeder.stats)
+    feeder_pipe = managers[0].feeder.pipeline_stats()
     feeder_perf = {**managers[0].feeder.perf_summary(),
                    **{f"scrub_{k2}": v for k2, v in
                       mgr1.feeder.perf_summary().items()}}
@@ -382,6 +383,18 @@ async def _put_cluster_bench(tmp: str, platform: str, nblocks: int,
         "feeder_device_items": feeder_stats["device_items"],
         "feeder_max_batch": feeder_stats["max_batch"],
         "feeder_mbps": feeder_perf,
+        # staged-pipeline engagement: device-busy/wall (> 1.0 means
+        # transfer really overlapped compute), the padding tax of
+        # fixed-shape launches, and how many XLA programs were built —
+        # so the next BENCH_r*.json distinguishes "tunnel down" from
+        # "pipeline not overlapping"
+        "feeder_overlap_efficiency": feeder_pipe["overlap_efficiency"],
+        "feeder_pad_waste_pct": round(
+            100.0 * feeder_stats["pad_waste_bytes"]
+            / max(feeder_stats["pad_waste_bytes"]
+                  + feeder_stats["device_bytes"], 1), 2),
+        "feeder_recompiles": feeder_stats["recompiles"],
+        "feeder_mesh_batches": feeder_stats["mesh_batches"],
     }
 
 
@@ -682,16 +695,37 @@ def bench_s3_put(nobj: int, obj_mib: int = 4, device: bool = False) -> dict:
                     f"http://127.0.0.1:{srv.admin_port}/metrics",
                     timeout=10) as r:
                 metrics = r.read().decode()
-            items = batches = 0
+            scr: dict[str, float] = {}
             for line in metrics.splitlines():
-                if line.startswith("feeder_device_items"):
-                    items = int(float(line.split()[-1]))
-                elif line.startswith("feeder_device_batches"):
-                    batches = int(float(line.split()[-1]))
+                if not line.startswith("feeder_"):
+                    continue
+                name = line.split()[0].split("{")[0]
+                # labeled series (pipeline busy per stage) sum up
+                scr[name] = scr.get(name, 0.0) + float(line.split()[-1])
+            waste = scr.get("feeder_pad_waste_bytes", 0.0)
+            devbytes = scr.get("feeder_device_bytes", 0.0)
             out = {"s3_device_put_gbps": out["s3_put_gbps"],
                    "s3_device_get_gbps": out["s3_get_gbps"],
-                   "s3_feeder_device_items": items,
-                   "s3_feeder_device_batches": batches}
+                   "s3_feeder_device_items":
+                       int(scr.get("feeder_device_items", 0)),
+                   "s3_feeder_device_batches":
+                       int(scr.get("feeder_device_batches", 0)),
+                   # pipeline engagement next to the proof counter:
+                   # "tunnel down" reads as device_items == 0, while
+                   # "engaged but serial" reads as items > 0 with
+                   # overlap_efficiency <= 1.0
+                   "s3_feeder_overlap_efficiency":
+                       scr.get("feeder_overlap_efficiency", 0.0),
+                   "s3_feeder_pipeline_busy_s": round(
+                       scr.get("feeder_pipeline_busy_seconds", 0.0), 3),
+                   "s3_feeder_pipeline_wall_s": round(
+                       scr.get("feeder_pipeline_wall_seconds", 0.0), 3),
+                   "s3_feeder_pad_waste_pct": round(
+                       100.0 * waste / max(waste + devbytes, 1.0), 2),
+                   "s3_feeder_recompiles":
+                       int(scr.get("feeder_recompiles", 0)),
+                   "s3_feeder_mesh_batches":
+                       int(scr.get("feeder_mesh_batches", 0))}
         return out
     finally:
         srv.stop()

@@ -24,6 +24,21 @@ faster one, re-probing the loser periodically. On a real TPU host
 tunneled dev chip where host<->device moves at tens of MB/s the native C
 kernels win — measured, not guessed (a fixed threshold was wrong on both
 ends: this box's tunnel does ~300 MB/s h2d but ~15 MB/s d2h).
+
+The device route is a STAGED PIPELINE (block/device_backend.py): each
+batch flows h2d -> compute -> d2h through three dedicated worker
+threads, and the dispatcher keeps up to `[tpu] inflight_batches`
+(default 2) batches in flight — while batch N computes, batch N+1's
+bytes are already moving h2d and batch N-1's results are reading back,
+and the event loop spends the meantime draining the queue and forming
+the next batch instead of idling on one blocking hop. Launch shapes
+are padded to a small bucket set so XLA compiles a handful of programs
+(`feeder_pad_waste_bytes` / `feeder_recompiles` price that trade), and
+batches of >= `[tpu] mesh_min_items` items shard across every visible
+chip via parallel/mesh.py. The watchdog covers every in-flight stage:
+a hang anywhere abandons the stage threads, disables the device path,
+poisons the probe cache, and re-runs ALL in-flight batches host-side —
+no caller future is ever lost.
 """
 
 from __future__ import annotations
@@ -40,6 +55,10 @@ import time
 from typing import Optional
 
 import numpy as np
+
+from .device_backend import (DEFAULT_PAD_BUCKETS, STAGES, DevicePipeline,
+                             JaxDeviceBackend, StubDeviceBackend,
+                             group_bytes)
 
 log = logging.getLogger("garage_tpu.block.feeder")
 
@@ -185,6 +204,11 @@ def _verify_matches(digs: list, items: list) -> list[bool]:
             for dg, (h, d) in zip(digs, items)]
 
 
+class _DeviceHang(Exception):
+    """A device pipeline stage hung (or a sibling batch's stage did and
+    aborted the generation): re-run the affected legs host-side."""
+
+
 class _Item:
     __slots__ = ("op", "data", "future", "extra")
 
@@ -201,10 +225,47 @@ class DeviceFeeder:
     raise if probe fails — bench/test use)."""
 
     def __init__(self, codec=None, mode: str = "auto",
-                 max_batch: int = 256):
+                 max_batch: int = 256, tpu_cfg=None, backend=None):
         self.codec = codec
         # greedy-drain cap: blocks per device batch ([tpu] batch_blocks)
         self.max_batch = max(1, int(max_batch))
+
+        # [tpu] knobs (utils/config.py TpuConfig); the module constants
+        # stay as defaults so direct-constructed feeders (tests, bench)
+        # behave exactly as before. Runtime-tunable via the admin
+        # GET/POST /v1/s3/tuning endpoint like the s3 knobs.
+        def knob(name, default):
+            v = getattr(tpu_cfg, name, None) if tpu_cfg is not None else None
+            return default if v is None else v
+
+        self.device_min_bytes = int(knob("device_min_bytes",
+                                         _DEVICE_MIN_BYTES))
+        self.device_min_items = int(knob("device_min_items",
+                                         _DEVICE_MIN_ITEMS))
+        self.trial_max_items = int(knob("trial_max_items",
+                                        _TRIAL_MAX_ITEMS))
+        self.trial_items_cap = int(knob("trial_items_cap",
+                                        _TRIAL_ITEMS_CAP))
+        self.trial_max_bytes = int(knob("trial_max_bytes",
+                                        _TRIAL_MAX_BYTES))
+        # staged-pipeline depth: batches concurrently in flight through
+        # the h2d/compute/d2h stages (2 = classic double buffering)
+        self.inflight_batches = max(1, int(knob("inflight_batches", 2)))
+        self.pad_buckets = tuple(
+            int(b) for b in knob("pad_buckets", DEFAULT_PAD_BUCKETS))
+        self.mesh_min_items = int(knob("mesh_min_items", 8))
+        # per-batch watchdog budget, instance-level so tests can shrink
+        # it without patching every co-located feeder
+        self.batch_timeout = float(knob("batch_timeout_s", _BATCH_TIMEOUT))
+        # device backend: "jax" (real accelerator), "stub"
+        # (deterministic latency emulator — CI), or a ready object
+        if backend is None:
+            backend = (os.environ.get("GARAGE_TPU_DEVICE_BACKEND")
+                       or knob("device_backend", "jax"))
+        self._backend_sel = backend
+        self._backend = None
+        self._backend_lock = threading.Lock()
+
         env_mode = os.environ.get("GARAGE_TPU_DEVICE")
         if mode == "auto" and env_mode == "off":
             # test/CI kill-switch: never probe, never spawn calibration
@@ -225,7 +286,20 @@ class DeviceFeeder:
         self._probing = False
         self._calibrating = False
         self.stats = {"batches": 0, "items": 0, "device_batches": 0,
-                      "device_items": 0, "inline_items": 0, "max_batch": 0}
+                      "device_items": 0, "device_bytes": 0,
+                      "inline_items": 0, "max_batch": 0,
+                      "pad_waste_bytes": 0, "recompiles": 0,
+                      "mesh_batches": 0}
+        # staged pipeline state: the current executor generation, the
+        # batches in flight, per-stage busy seconds and the wall-clock
+        # union of windows with >= 1 device leg in flight (overlap
+        # efficiency = sum(busy) / wall; > 1.0 means stages overlap)
+        self._pl: Optional[DevicePipeline] = None
+        self._pl_busy: dict[str, float] = {s: 0.0 for s in STAGES}
+        self._pl_wall = 0.0
+        self._win_open = 0
+        self._win_t0 = 0.0
+        self._inflight_tasks: set = set()
         # PUT streams currently inside read_and_put_blocks: sizes the
         # hash_md5 gather window (one block hash in flight per stream)
         self.active_streams = 0
@@ -260,6 +334,35 @@ class DeviceFeeder:
             self._task = asyncio.create_task(self._run(), name="device-feeder")
         if self.mode == "off":
             self._device_ok = False
+        elif self._device_ok is None and self._backend_is_stub():
+            # the stub emulator needs no probe (there is no tunnel to
+            # hang on) — the device verdict is immediately positive
+            self._device_ok = True
+
+    def _backend_is_stub(self) -> bool:
+        sel = self._backend_sel
+        return (sel == "stub" if isinstance(sel, str)
+                else getattr(sel, "name", "") == "stub")
+
+    def _get_backend(self):
+        """The staged device backend, built lazily from a pipeline
+        worker thread (jax import / device discovery never run on the
+        event loop, and both sit under the batch watchdog)."""
+        with self._backend_lock:
+            if self._backend is None:
+                sel = self._backend_sel
+                if not isinstance(sel, str):
+                    self._backend = sel
+                    if getattr(sel, "feeder", False) is None:
+                        sel.feeder = self  # test-built stubs wire back
+                elif sel == "stub":
+                    self._backend = StubDeviceBackend(self)
+                else:
+                    self._backend = JaxDeviceBackend(
+                        codec=self.codec, pad_buckets=self.pad_buckets,
+                        mesh_min_items=self.mesh_min_items,
+                        stats=self.stats)
+            return self._backend
 
     async def _require_probe(self) -> None:
         """Resolve the device verdict for mode="require" WITHOUT
@@ -270,6 +373,9 @@ class DeviceFeeder:
             self._require_lock = asyncio.Lock()
         async with self._require_lock:
             if self._device_ok is not None:
+                return
+            if self._backend_is_stub():
+                self._device_ok = True
                 return
             if self._require_err is not None:
                 # fail fast on a recent verdict: without this, every
@@ -306,6 +412,15 @@ class DeviceFeeder:
             except (asyncio.CancelledError, Exception):
                 pass
             self._task = None
+        # cancel every in-flight pipelined batch: each _finish_batch
+        # fails its items' futures on the way out, so no caller hangs
+        # on a batch that was mid-stage when the feeder stopped
+        for t in list(self._inflight_tasks):
+            t.cancel()
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
         # fail anything still queued so no caller awaits forever
         if self._q is not None:
             while not self._q.empty():
@@ -315,6 +430,10 @@ class DeviceFeeder:
 
     def _maybe_start_probe(self) -> None:
         """Kick the backend probe in a thread; host path until it lands."""
+        if self._backend_is_stub():
+            if self._device_ok is None:
+                self._device_ok = True  # no tunnel, no probe needed
+            return  # but a watchdog-disabled stub stays disabled
         if self._device_ok is not None or self._probing or self.mode != "auto":
             return
         self._probing = True
@@ -631,37 +750,20 @@ class DeviceFeeder:
                         if item.op == "hash_md5":
                             n_md5 += 1
                 self._maybe_start_probe()
-                try:
-                    results = await asyncio.wait_for(
-                        asyncio.to_thread(self._run_batch, batch),
-                        _BATCH_TIMEOUT)
-                except asyncio.TimeoutError:
-                    # hung device call: the stuck thread is abandoned,
-                    # the device path disabled, the batch re-run on the
-                    # host (native kernels) in a fresh thread
-                    log.error("feeder batch stuck >%ss; disabling device "
-                              "path and re-running host-side",
-                              _BATCH_TIMEOUT)
-                    self._device_ok = False
-                    if self.mode != "require":
-                        # thread: poison blocks on _probe_lock if a
-                        # probe is mid-flight, and this is the loop
-                        threading.Thread(
-                            target=poison_probe_cache,
-                            args=(f"device batch stuck "
-                                  f">{_BATCH_TIMEOUT}s",),
-                            daemon=True).start()
-                    # bounded too: if even the JAX-free host path stalls,
-                    # fail this batch instead of wedging the dispatcher
-                    results = await asyncio.wait_for(
-                        asyncio.to_thread(self._run_batch, batch, True),
-                        _BATCH_TIMEOUT)
-                for item, res in zip(batch, results):
-                    if not item.future.done():
-                        if isinstance(res, BaseException):
-                            item.future.set_exception(res)
-                        else:
-                            item.future.set_result(res)
+                # bounded in-flight depth: the dispatcher hands the
+                # batch to the staged pipeline and goes straight back
+                # to draining the queue / forming the next batch —
+                # while batch N computes, batch N+1 stages h2d and
+                # batch N-1 reads back. Depth is live-tunable
+                # ([tpu] inflight_batches via /v1/s3/tuning).
+                while len(self._inflight_tasks) >= max(
+                        1, self.inflight_batches):
+                    await asyncio.wait(self._inflight_tasks,
+                                       return_when=asyncio.FIRST_COMPLETED)
+                t = asyncio.create_task(self._finish_batch(batch),
+                                        name="feeder-batch")
+                self._inflight_tasks.add(t)
+                t.add_done_callback(self._inflight_tasks.discard)
             except BaseException as e:
                 for item in batch:
                     if not item.future.done():
@@ -670,6 +772,215 @@ class DeviceFeeder:
                             else RuntimeError("feeder stopped"))
                 if isinstance(e, asyncio.CancelledError):
                     raise
+
+    async def _finish_batch(self, batch: list) -> None:
+        """Run one batch through plan + execution and resolve every
+        item future — the one owner of a batch's futures, whatever the
+        route (host thread, staged device pipeline, hang fallback)."""
+        try:
+            results = await self._run_batch_staged(batch)
+            for item, res in zip(batch, results):
+                if not item.future.done():
+                    if isinstance(res, BaseException):
+                        item.future.set_exception(res)
+                    else:
+                        item.future.set_result(res)
+        except BaseException as e:
+            for item in batch:
+                if not item.future.done():
+                    item.future.set_exception(
+                        e if not isinstance(e, asyncio.CancelledError)
+                        else RuntimeError("feeder stopped"))
+            if isinstance(e, asyncio.CancelledError):
+                raise
+
+    async def _run_batch_staged(self, batch: list) -> list:
+        """Plan the batch, then execute host legs in a worker thread
+        and device legs through the staged pipeline, concurrently."""
+        self.stats["batches"] += 1
+        self.stats["items"] += len(batch)
+        self.stats["max_batch"] = max(self.stats["max_batch"], len(batch))
+        results: list = [None] * len(batch)
+        legs = self._plan_batch(batch)
+        host_legs = [leg for leg in legs if leg[3] != "device"]
+        device_legs = [leg for leg in legs if leg[3] == "device"]
+        if not device_legs:
+            # pure host batch: exactly the pre-pipeline behavior (one
+            # thread hop), still bounded so a stalled host path fails
+            # the batch instead of wedging a pipeline slot forever
+            await asyncio.wait_for(
+                asyncio.to_thread(self._exec_legs, batch, legs, results),
+                self.batch_timeout)
+            return results
+        tasks = [asyncio.create_task(
+            self._exec_device_leg(op, perf_op, batch, idxs, results))
+            for op, perf_op, idxs, _b in device_legs]
+        if host_legs:
+            tasks.append(asyncio.create_task(asyncio.wait_for(
+                asyncio.to_thread(self._exec_legs, batch, host_legs,
+                                  results),
+                self.batch_timeout)))
+        try:
+            await asyncio.gather(*tasks)
+        except BaseException:
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            raise
+        return results
+
+    async def _exec_device_leg(self, op: str, perf_op: str, batch: list,
+                               idxs: list, results: list) -> None:
+        """One device-routed op group: staged h2d -> compute -> d2h
+        with the watchdog over ALL stages; a hang disables the device
+        path and re-runs this group host-side, a plain device failure
+        (dead tunnel, OOM, XLA error) falls back to the host with a
+        calibration penalty — either way every item gets a result."""
+        blobs = [batch[i].data for i in idxs]
+        total = group_bytes(op, blobs)
+        self._window_open()
+        try:
+            try:
+                out, busy = await asyncio.wait_for(
+                    self._staged_op(op, blobs), self.batch_timeout)
+            except (asyncio.TimeoutError, _DeviceHang):
+                # hung device stage (the axon tunnel can block inside
+                # XLA calls): abandon the stuck stage threads, disable
+                # the device path, and re-run EVERY in-flight batch's
+                # device legs host-side — the abort event makes
+                # sibling batches take this same branch immediately
+                # instead of each waiting out its own watchdog.
+                self._on_device_hang()
+                await asyncio.wait_for(
+                    asyncio.to_thread(self._exec_group, op, perf_op,
+                                      batch, idxs, "host", results),
+                    self.batch_timeout)
+                return
+            except Exception as e:
+                log.warning("device %s batch failed (%s: %s); "
+                            "falling back to host", op,
+                            type(e).__name__, e)
+                self._record(perf_op, "device", 0, 60.0)
+                await asyncio.wait_for(
+                    asyncio.to_thread(self._exec_group, op, perf_op,
+                                      batch, idxs, "host", results),
+                    self.batch_timeout)
+                return
+            for i, o in zip(idxs, out):
+                results[i] = o
+            # calibration records the EXCLUSIVE stage execution time,
+            # not this coroutine's wall: wall includes queue wait
+            # behind sibling batches in the single-thread stage
+            # executors, which would understate device throughput by
+            # up to the in-flight depth and flip routing back to host
+            # precisely because pipelining engaged
+            self._record(perf_op, "device", total, busy)
+            self.stats["device_batches"] += 1
+            self.stats["device_items"] += len(idxs)
+            self.stats["device_bytes"] += total
+        finally:
+            self._window_close()
+
+    async def _staged_op(self, op: str, blobs: list) -> tuple[list, float]:
+        """h2d -> compute -> d2h through the current pipeline
+        generation's stage threads; -> (results, exclusive device
+        seconds). Each stage of THIS batch runs serially, but the
+        single-thread-per-stage executors let a different batch occupy
+        every other stage at the same time."""
+        pl = self._pipeline()
+        be = self._get_backend
+        busy: list[float] = []
+        staged = await self._stage_call(
+            pl, "h2d", lambda: be().stage(op, blobs), busy)
+        handle = await self._stage_call(
+            pl, "compute", lambda: be().compute(op, staged), busy)
+        out = await self._stage_call(
+            pl, "d2h", lambda: be().readback(op, handle), busy)
+        return out, sum(busy)
+
+    async def _stage_call(self, pl: DevicePipeline, stage: str, fn,
+                          busy: list):
+        if pl.dead:
+            raise _DeviceHang("pipeline aborted")
+        loop = asyncio.get_running_loop()
+        job = pl.submit(stage, loop, fn)
+        abort = asyncio.create_task(pl.aborted.wait())
+        try:
+            await asyncio.wait({job.fut, abort},
+                               return_when=asyncio.FIRST_COMPLETED)
+            if not job.fut.done() and job.claimed:
+                # the stage thread is ALREADY EXECUTING this job (the
+                # hung job never yields its thread, so ours is live):
+                # wait it out instead of racing a host re-run against
+                # its side effects — d2h advances the serial MD5 ETag
+                # chains, and abandoning it mid-flight would apply
+                # them twice. Still bounded by the caller's watchdog.
+                await asyncio.wait({job.fut})
+            if job.fut.done():
+                busy.append(job.busy)
+                return job.fut.result()
+            raise _DeviceHang("pipeline aborted by a sibling batch hang")
+        finally:
+            abort.cancel()
+            if not job.fut.done():
+                # abandon: a queued job is skipped outright by the
+                # stage thread (never executed), a claimed one
+                # completes silently with its delivery dropped
+                job.fut.cancel()
+
+    # ---- pipeline lifecycle + overlap accounting (loop thread) ---------
+
+    def _pipeline(self) -> DevicePipeline:
+        if self._pl is None or self._pl.dead:
+            self._pl = DevicePipeline(self._pl_busy)
+        return self._pl
+
+    def _on_device_hang(self) -> None:
+        """First watchdog to fire wins: mark the generation dead (the
+        stuck daemon threads are abandoned, never joined), wake every
+        sibling batch via the abort event, disable the device path and
+        poison the shared probe cache so co-located feeders don't each
+        pay the full watchdog timeout themselves."""
+        pl = self._pl
+        if pl is None or pl.dead:
+            return  # a sibling already handled this hang
+        pl.dead = True
+        pl.aborted.set()
+        log.error("feeder batch stuck >%ss; disabling device "
+                  "path and re-running host-side", self.batch_timeout)
+        self._device_ok = False
+        if self.mode != "require":
+            # thread: poison blocks on _probe_lock if a probe is
+            # mid-flight, and this is the loop
+            threading.Thread(
+                target=poison_probe_cache,
+                args=(f"device batch stuck >{self.batch_timeout}s",),
+                daemon=True).start()
+
+    def _window_open(self) -> None:
+        if self._win_open == 0:
+            self._win_t0 = time.monotonic()
+        self._win_open += 1
+
+    def _window_close(self) -> None:
+        self._win_open -= 1
+        if self._win_open == 0:
+            self._pl_wall += time.monotonic() - self._win_t0
+
+    def pipeline_stats(self) -> dict:
+        """Overlap observability (admin /metrics + bench): per-stage
+        busy seconds, the wall-clock union of in-flight windows, and
+        busy/wall — > 1.0 means stages of different batches really ran
+        concurrently (the double-buffering proof)."""
+        busy = {k: round(v, 6) for k, v in self._pl_busy.items()}
+        wall = self._pl_wall
+        if self._win_open > 0:
+            wall += time.monotonic() - self._win_t0
+        total = sum(self._pl_busy.values())
+        return {"busy_s": busy, "wall_s": round(wall, 6),
+                "overlap_efficiency": round(total / wall, 3) if wall > 0
+                else 0.0,
+                "inflight": len(self._inflight_tasks)}
 
     # ---- batch execution (worker thread) -------------------------------
 
@@ -684,7 +995,8 @@ class DeviceFeeder:
             return "host", False
         if self._force_device.pop(op, False):
             return "device", True  # inline fast-path escape: re-probe now
-        if total_bytes < _DEVICE_MIN_BYTES and n_items < _DEVICE_MIN_ITEMS:
+        if total_bytes < self.device_min_bytes \
+                and n_items < self.device_min_items:
             return "host", False  # tiny batches never amortize a round trip
         dev_rate, host_rate = self._rates(op)
         if dev_rate is None:
@@ -706,24 +1018,17 @@ class DeviceFeeder:
             ent[0] += nbytes
             ent[1] += max(dt, 1e-6)
 
-    def _run_batch(self, batch: list[_Item], force_host: bool = False
-                   ) -> list:
-        self.stats["batches"] += 1
-        self.stats["items"] += len(batch)
-        self.stats["max_batch"] = max(self.stats["max_batch"], len(batch))
-        results: list = [None] * len(batch)
+    def _plan_batch(self, batch: list[_Item], force_host: bool = False
+                    ) -> list[tuple]:
+        """-> [(op, perf_op, idxs, backend)] legs, trial splits applied
+        — the routing brain shared by the staged pipeline (async) and
+        the synchronous host paths (hang re-run, direct callers)."""
         by_op: dict[str, list[int]] = {}
         for i, item in enumerate(batch):
             by_op.setdefault(item.op, []).append(i)
+        legs: list[tuple] = []
         for op, idxs in by_op.items():
-            if op in ("verify", "encode_put", "hash_md5"):  # 2-tuples
-                total = sum(len(batch[i].data[1]) for i in idxs)
-            elif op == "parity_check":  # item = one stripe (shard list)
-                total = sum(len(b) for i in idxs for b in batch[i].data)
-            else:
-                total = sum(len(batch[i].data) for i in idxs
-                            if isinstance(batch[i].data,
-                                          (bytes, bytearray)))
+            total = group_bytes(op, [batch[i].data for i in idxs])
             perf_op = ("hash" if op in ("verify", "hash_md5") else
                        "encode" if op == "encode_put" else
                        "parity" if op == "parity_check" else op)
@@ -743,23 +1048,36 @@ class DeviceFeeder:
                 # exploration of the losing backend: one small timing
                 # sample there, the bulk stays on the winner
                 other = "host" if backend == "device" else "device"
-                self._exec_group(op, perf_op, batch, idxs[:cut], backend,
-                                 results)
-                self._exec_group(op, perf_op, batch, idxs[cut:], other,
-                                 results)
+                legs.append((op, perf_op, idxs[:cut], backend))
+                legs.append((op, perf_op, idxs[cut:], other))
             else:
-                self._exec_group(op, perf_op, batch, idxs, backend,
-                                 results)
+                legs.append((op, perf_op, idxs, backend))
+        return legs
+
+    def _exec_legs(self, batch: list, legs: list, results: list) -> None:
+        for op, perf_op, idxs, backend in legs:
+            self._exec_group(op, perf_op, batch, idxs, backend, results)
+
+    def _run_batch(self, batch: list[_Item], force_host: bool = False
+                   ) -> list:
+        """Synchronous (worker-thread) batch execution — the hang
+        fallback and direct test/bench entry point. The live dispatcher
+        routes through _run_batch_staged instead."""
+        self.stats["batches"] += 1
+        self.stats["items"] += len(batch)
+        self.stats["max_batch"] = max(self.stats["max_batch"], len(batch))
+        results: list = [None] * len(batch)
+        self._exec_legs(batch, self._plan_batch(batch, force_host), results)
         return results
 
-    @staticmethod
-    def _trial_cut(op: str, batch: list, idxs: list) -> int:
-        """Items in the exploration slice: at least _TRIAL_MAX_ITEMS,
-        growing to _TRIAL_ITEMS_CAP while under _TRIAL_MAX_BYTES."""
+    def _trial_cut(self, op: str, batch: list, idxs: list) -> int:
+        """Items in the exploration slice: at least trial_max_items,
+        growing to trial_items_cap while under trial_max_bytes."""
         cut, size = 0, 0
         for i in idxs:
-            if cut >= _TRIAL_MAX_ITEMS and (
-                    size >= _TRIAL_MAX_BYTES or cut >= _TRIAL_ITEMS_CAP):
+            if cut >= self.trial_max_items and (
+                    size >= self.trial_max_bytes
+                    or cut >= self.trial_items_cap):
                 break
             d = batch[i].data
             if op in ("verify", "encode_put", "hash_md5"):
@@ -775,13 +1093,7 @@ class DeviceFeeder:
     def _exec_group(self, op: str, perf_op: str, batch: list,
                     idxs: list, backend: str, results: list) -> None:
         blobs = [batch[i].data for i in idxs]
-        if op in ("verify", "encode_put", "hash_md5"):  # 2-tuples
-            total = sum(len(b) for _, b in blobs)
-        elif op == "parity_check":
-            total = sum(len(b) for s in blobs for b in s)
-        else:
-            total = sum(len(b) for b in blobs
-                        if isinstance(b, (bytes, bytearray)))
+        total = group_bytes(op, blobs)
         t0 = time.perf_counter()
         try:
             try:

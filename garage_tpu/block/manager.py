@@ -175,6 +175,7 @@ class BlockManager:
                  compression: bool = True, fsync: bool = False,
                  device_mode: str = "auto",
                  device_batch_blocks: int = 256,
+                 tpu_cfg=None,
                  ram_buffer_max: int = 256 * 1024 * 1024,
                  read_cache_max_bytes: Optional[int] = None,
                  resync_breaker_aware: bool = True):
@@ -200,6 +201,7 @@ class BlockManager:
             codec=codec if isinstance(codec, ErasureCodec) else None,
             mode=device_mode,
             max_batch=device_batch_blocks,
+            tpu_cfg=tpu_cfg,
         )
         # RAM held by in-flight outbound block writes, bounded like the
         # reference's buffer_stream semaphore (ref: manager.rs:156,

@@ -135,6 +135,7 @@ class Garage:
             fsync=config.data_fsync,
             device_mode="auto" if config.tpu.enable else "off",
             device_batch_blocks=config.tpu.batch_blocks,
+            tpu_cfg=config.tpu,
             ram_buffer_max=config.block_ram_buffer_max,
             read_cache_max_bytes=config.block_read_cache_max_bytes,
             resync_breaker_aware=config.block_resync_breaker_aware,

@@ -291,6 +291,15 @@ def _hash_fn(n_chunks: int):
     return jax.jit(functools.partial(hash_rows, n_chunks=n_chunks))
 
 
+def hash_fn(n_chunks: int):
+    """Public handle on the per-chunk-count jitted hasher: the staged
+    device backend (block/device_backend.py) launches it in its compute
+    stage and reads the result back in a separate d2h stage, so the two
+    can overlap across pipelined batches (hash_batch_jax fuses launch
+    and readback, which serializes the pipeline)."""
+    return _hash_fn(n_chunks)
+
+
 def n_chunks_for(length: int) -> int:
     return max(1, (length + CHUNK_LEN - 1) // CHUNK_LEN)
 

@@ -54,6 +54,14 @@ def _sh(mesh, *spec):
     return NamedSharding(mesh, PartitionSpec(*spec))
 
 
+def bytes_sharding(mesh):
+    """The byte-split (B, n, S) input sharding — dp over stripes, tp
+    over the byte axis. Public so the feeder's staged h2d can
+    device_put batches directly into the mesh layout (the transfer
+    itself fans out across chips instead of landing on chip 0)."""
+    return _sh(mesh, "dp", None, "tp")
+
+
 def _layouts(mesh, n: int, shard_len: int):
     """(bytes_sh, shards_sh, n_sharded) for a (B, n, S) stripe batch.
     Validates tp | S; shards the n axis in the whole-shard layout only
@@ -114,6 +122,48 @@ def make_put_step(mesh, k: int, m: int, shard_len: int):
         in_shardings=bytes_sh,
         out_shardings=(bytes_sh, shards_sh),
     )
+
+
+@functools.lru_cache(maxsize=None)
+def make_encode_step(mesh, k: int, m: int, shard_len: int):
+    """Jitted parity-only encode: (B, k, S) data -> (B, m, S) parity,
+    dp-sharded over stripes and tp-sharded over the byte axis (local
+    matmul per byte-column, no cross-chip collective needed). This is
+    the feeder's multi-chip batch-sharding step: unlike make_put_step
+    it skips device hashing, because the live PUT path advances its
+    ETag-MD5 chains host-side and the staged backend reads parity back
+    while the next batch computes."""
+    import jax
+
+    parity_bits = gf256.bitmat_t_for(rs.parity_matrix(k, m))
+    bytes_sh, _, _ = _layouts(mesh, k + m, shard_len)
+
+    def step(data):
+        data = jax.lax.with_sharding_constraint(data, bytes_sh)
+        return gf256.bit_matmul_apply(parity_bits, data)
+
+    return jax.jit(step, in_shardings=bytes_sh, out_shardings=bytes_sh)
+
+
+@functools.lru_cache(maxsize=None)
+def make_parity_check_step(mesh, k: int, m: int, shard_len: int):
+    """Jitted per-stripe parity consistency: (B, k+m, S) stored shards
+    -> (B,) bool, True when re-derived parity equals the stored parity
+    rows. The deep-scrub feeder op's multi-chip route; zero-padded
+    stripes come out True (zero data encodes to zero parity — linear
+    code) and are sliced away by the caller."""
+    import jax
+    import jax.numpy as jnp
+
+    parity_bits = gf256.bitmat_t_for(rs.parity_matrix(k, m))
+    bytes_sh, _, _ = _layouts(mesh, k + m, shard_len)
+
+    def step(stripes):
+        stripes = jax.lax.with_sharding_constraint(stripes, bytes_sh)
+        parity2 = gf256.bit_matmul_apply(parity_bits, stripes[:, :k, :])
+        return jnp.all(parity2 == stripes[:, k:, :], axis=(1, 2))
+
+    return jax.jit(step, in_shardings=bytes_sh, out_shardings=_sh(mesh, "dp"))
 
 
 @functools.lru_cache(maxsize=None)

@@ -29,7 +29,12 @@ class DataDir:
 
 @dataclass
 class TpuConfig:
-    """TPU data-plane knobs (no reference analogue)."""
+    """TPU data-plane knobs (no reference analogue; README "The TPU
+    data plane"). The feeder routing/trial knobs were hard-coded module
+    constants before the staged pipeline landed; a None leaves the
+    feeder's built-in default in force. inflight_batches /
+    device_min_bytes / device_min_items are also runtime-tunable via
+    admin GET/POST /v1/s3/tuning."""
 
     enable: bool = True
     # max blocks shipped to the device in one encode/hash call (the
@@ -38,6 +43,33 @@ class TpuConfig:
     batch_blocks: int = 256
     # platform override for tests ("cpu" forces the jnp fallback path)
     platform: Optional[str] = None
+    # staged-pipeline depth: device batches concurrently in flight
+    # through the h2d/compute/d2h stages (2 = double buffering)
+    inflight_batches: int = 2
+    # calibration routing floors: batches below BOTH never leave the
+    # host (a device round trip costs more than it saves there)
+    device_min_bytes: Optional[int] = None  # default 4 MiB
+    device_min_items: Optional[int] = None  # default 4
+    # exploration-trial caps: items/bytes sacrificed to re-time the
+    # currently-losing backend (block/feeder.py _trial_cut)
+    trial_max_items: Optional[int] = None   # default 2
+    trial_items_cap: Optional[int] = None   # default 8
+    trial_max_bytes: Optional[int] = None   # default 4 MiB
+    # fixed-shape launch buckets: item counts pad up to the next value
+    # here so XLA compiles a handful of programs instead of one per
+    # batch shape (feeder_pad_waste_bytes / feeder_recompiles track
+    # the trade); shard lengths round to the next power of two
+    pad_buckets: list = field(
+        default_factory=lambda: [1, 2, 4, 8, 16, 32, 64, 128, 256])
+    # batches of at least this many items shard across every visible
+    # chip through parallel/mesh.py's (dp, tp) data-plane mesh
+    mesh_min_items: int = 8
+    # "jax" = real accelerator; "stub" = deterministic latency
+    # emulator (CI / deviceless boxes; GARAGE_TPU_DEVICE_BACKEND
+    # env var overrides)
+    device_backend: str = "jax"
+    # per-batch watchdog budget, seconds (covers every pipeline stage)
+    batch_timeout_s: Optional[float] = None  # default 300
 
 
 @dataclass

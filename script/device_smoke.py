@@ -1,0 +1,54 @@
+#!/usr/bin/env python3
+"""Device-gated live-path smoke (nightly CI + TPU-box proof runs).
+
+Probes the accelerator first and SKIPS CLEANLY (exit 0) when no device
+answers — a deviceless runner must not fail the nightly. With a device
+(or with GARAGE_TPU_DEVICE_BACKEND=stub, the CI rehearsal of the same
+gate), it forks a real server under GARAGE_TPU_DEVICE=require, drives
+live S3 PUTs through it, and asserts the engagement gate:
+feeder_device_items > 0 on the live PUT path, with the pipeline's
+overlap efficiency and pad-waste reported alongside.
+
+Usage: python script/device_smoke.py [nobj] [obj_mib]
+"""
+
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    nobj = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    obj_mib = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+
+    stub = os.environ.get("GARAGE_TPU_DEVICE_BACKEND") == "stub"
+    if not stub:
+        from garage_tpu.block.feeder import probe_device
+
+        res = probe_device(timeout=120.0)
+        if not res["ok"]:
+            print("SKIP: no device answered the probe "
+                  f"({res['error'] or res['platform']})")
+            return 0
+        print(f"device probe ok: {res['platform']}")
+
+    import bench
+
+    out = bench.bench_s3_put(nobj, obj_mib, device=True)
+    print(json.dumps(out, indent=2))
+    if out.get("s3_feeder_device_items", 0) <= 0:
+        print("FAIL: feeder_device_items == 0 — live S3 PUTs never "
+              "reached the device path")
+        return 1
+    print("OK: live PUT path engaged the device "
+          f"({out['s3_feeder_device_items']} items, overlap "
+          f"{out.get('s3_feeder_overlap_efficiency', 0.0)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

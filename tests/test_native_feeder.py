@@ -472,19 +472,22 @@ def test_feeder_hash_md5_device_failure_fallback_etag_correct():
         pytest.skip("no native toolchain")
 
     async def go():
-        f = DeviceFeeder(mode="require")
-        f._device_ok = True  # skip real probe; fake device below
-        f.active_streams = 2
-        orig = f._do_hash
         calls = {"n": 0}
 
-        def flaky(blobs, backend):
-            if backend == "device":
+        class _BrokenBackend:
+            """Staged device backend whose transfer stage always
+            raises — the dead-tunnel shape, at the seam the pipelined
+            device route actually goes through."""
+
+            name = "jax"
+
+            def stage(self, op, blobs):
                 calls["n"] += 1
                 raise RuntimeError("tunnel died")
-            return orig(blobs, backend)
 
-        f._do_hash = flaky
+        f = DeviceFeeder(mode="require", backend=_BrokenBackend())
+        f._device_ok = True  # skip real probe; fake device above
+        f.active_streams = 2
         accs = [native.Md5(), native.Md5()]
         refs = [hashlib.md5(), hashlib.md5()]
         blobs = [os.urandom(2048), os.urandom(4096)]
