@@ -1003,6 +1003,192 @@ def bench_degraded(nhashes: int = 24, block_kib: int = 256) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_decode(nblocks: int = 24, block_kib: int = 1024,
+                 device_mode: str = "off") -> dict:
+    """Degraded-GET + scrub-rebuild lane (ISSUE 13) — the read-side
+    twin of the encode lane. An in-process 6-node erasure(4,2) cluster
+    stores `nblocks`; block i's systematic shard (i % k) is then
+    deleted cluster-wide, so every GET is a real degraded decode and
+    the run mixes k distinct erasure patterns (the pattern-as-data
+    production shape: recompiles must not scale with patterns).
+
+      decode_get_gbps             concurrent degraded GETs end to end
+                                  (gather + feeder decode + verify)
+      decode_blocks_per_s/_gbps   feeder-routed decode of the gathered
+                                  stripes (batched; host or device per
+                                  routing/mode)
+      decode_direct_blocks_per_s  pre-ISSUE-13 baseline: one serial
+                                  numpy decode per stripe on the caller
+      rebuild_blocks_per_s        feeder-batched shard rebuild wave
+                                  (the resync/scrub repair path) vs
+      rebuild_direct_blocks_per_s codec.repair_parts per stripe, serial
+      decode_feeder_device_items  read-path device engagement (the
+                                  degraded-GET twin of
+                                  feeder_device_items)
+      decode_recompiles           XLA programs built across the mixed-
+                                  pattern decode/rebuild lanes (flat =
+                                  the pattern-as-data proof)
+    """
+    import shutil
+    import tempfile
+
+    from garage_tpu.block.codec import shard_nodes_of
+    from garage_tpu.ops import rs
+    from garage_tpu.rpc import ReplicationMode
+    from garage_tpu.utils.data import blake3sum
+
+    k, m = 4, 2
+    block_len = block_kib << 10
+    tmp = tempfile.mkdtemp(
+        prefix="gt_decode_",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
+
+    async def scenario() -> dict:
+        rm = ReplicationMode.parse(3, erasure=f"{k},{m}")
+        systems, managers, tasks = await _build_cluster(tmp, 6, rm,
+                                                        device_mode)
+        try:
+            for mg in managers:
+                mg.cache.configure(max_bytes=0)  # measure the decode path
+            rng = np.random.default_rng(5)
+            blocks = [rng.integers(0, 256, block_len,
+                                   dtype=np.uint8).tobytes()
+                      for _ in range(nblocks)]
+            hashes = [blake3sum(b) for b in blocks]
+            for h, b in zip(hashes, blocks):
+                await managers[0].rpc_put_block(h, b, compress=False)
+            by_id = {s.id: mg for s, mg in zip(systems, managers)}
+            v = systems[0].layout_helper.current()
+            # delete block i's systematic shard i%k everywhere it
+            # landed: every GET degrades, patterns rotate across k
+            missing = []
+            for i, h in enumerate(hashes):
+                placement = shard_nodes_of(v, h, k + m)
+                want = i % k
+                mgr = by_id[placement[want]]
+                for _ in range(200):  # quorum acks at 5/6; wait for it
+                    p = mgr._find(h, [f".s{want}"])
+                    if p is not None:
+                        break
+                    await asyncio.sleep(0.01)
+                if p is not None:
+                    os.remove(p)
+                missing.append(want)
+            feeder = managers[0].feeder
+            got = await managers[0].rpc_get_block(hashes[0],
+                                                  cacheable=False)
+            assert got == blocks[0]  # warm/compile the degraded path
+            await _settle_feeder(feeder)
+
+            async def pump_gets() -> float:
+                counter = iter(range(nblocks))
+
+                async def w():
+                    for j in counter:
+                        out = await managers[0].rpc_get_block(
+                            hashes[j], cacheable=False)
+                        assert out == blocks[j]
+
+                t0 = time.perf_counter()
+                await asyncio.gather(*[w() for _ in range(8)])
+                return time.perf_counter() - t0
+
+            get_dt = await pump_gets()
+            get_dt = min(get_dt, await pump_gets())
+
+            # gather each stripe once so the math-only lanes time the
+            # decode/rebuild, not the shard fetches
+            sets = []
+            for h in hashes:
+                placement = shard_nodes_of(v, h, k + m)
+                g = await managers[0]._gather_parts(h, placement, k)
+                parts, cands, _lens = g
+                present = tuple(sorted(parts.keys())[:k])
+                sets.append((present, [parts[i] for i in present],
+                             cands[0]))
+            rc0 = feeder.stats["recompiles"]
+
+            async def feeder_decode_lane() -> float:
+                t0 = time.perf_counter()
+                outs = await asyncio.gather(*[
+                    feeder.decode(p, s, plen) for p, s, plen in sets])
+                for o, b in zip(outs, blocks):
+                    assert len(o) >= len(b)
+                return time.perf_counter() - t0
+
+            fdt = await feeder_decode_lane()
+            fdt = min(fdt, await feeder_decode_lane())
+
+            def direct_decode() -> float:
+                # the pre-batching shape: one numpy matmul per stripe,
+                # serial on the caller thread
+                t0 = time.perf_counter()
+                for present, shards, plen in sets:
+                    st = np.stack([np.frombuffer(s, dtype=np.uint8)
+                                   for s in shards])
+                    rs.join_stripe(rs.decode_np(k, m, present, st), plen)
+                return time.perf_counter() - t0
+
+            ddt = await asyncio.to_thread(direct_decode)
+            ddt = min(ddt, await asyncio.to_thread(direct_decode))
+
+            async def rebuild_lane() -> float:
+                t0 = time.perf_counter()
+                outs = await asyncio.gather(*[
+                    feeder.repair(p, (miss,), s)
+                    for (p, s, _plen), miss in zip(sets, missing)])
+                assert all(missing[j] in outs[j]
+                           for j in range(nblocks))
+                return time.perf_counter() - t0
+
+            rdt = await rebuild_lane()
+            rdt = min(rdt, await rebuild_lane())
+
+            codec = managers[0].codec
+
+            def direct_rebuild() -> float:
+                t0 = time.perf_counter()
+                for (present, shards, _plen), miss in zip(sets, missing):
+                    codec.repair_parts(dict(zip(present, shards)),
+                                       (miss,))
+                return time.perf_counter() - t0
+
+            rddt = await asyncio.to_thread(direct_rebuild)
+            rddt = min(rddt, await asyncio.to_thread(direct_rebuild))
+
+            fs = dict(feeder.stats)
+            waste = fs["pad_waste_bytes"]
+            out = {
+                "decode_get_gbps": round(
+                    nblocks * block_len / get_dt / 1e9, 3),
+                "decode_blocks_per_s": round(nblocks / fdt, 1),
+                "decode_gbps": round(nblocks * block_len / fdt / 1e9, 3),
+                "decode_direct_blocks_per_s": round(nblocks / ddt, 1),
+                "decode_vs_direct": round(ddt / fdt, 2),
+                "rebuild_blocks_per_s": round(nblocks / rdt, 1),
+                "rebuild_direct_blocks_per_s": round(nblocks / rddt, 1),
+                "rebuild_vs_direct": round(rddt / rdt, 2),
+                "decode_feeder_items": fs["decode_items"],
+                "decode_feeder_device_items": fs["decode_device_items"],
+                "decode_recompiles": fs["recompiles"] - rc0,
+                "decode_patterns_mixed": len(set(missing)),
+                "decode_pad_waste_pct": round(
+                    100.0 * waste
+                    / max(waste + fs["decode_device_bytes"], 1), 2),
+                "decode_feeder_mbps": {
+                    op: v for op, v in feeder.perf_summary().items()
+                    if op.startswith("decode")},
+            }
+            return out
+        finally:
+            await _teardown(systems, managers, tasks)
+
+    try:
+        return asyncio.run(asyncio.wait_for(scenario(), 300))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_resize(n_nodes: int = 16, nobj: int = 48, obj_kib: int = 256,
                  leg_s: float = 5.0) -> dict:
     """Zero-downtime cluster resize economics (ISSUE 6): foreground
@@ -1781,6 +1967,27 @@ def main() -> None:
         extra.update(bench_degraded())
     except Exception as e:
         extra["degraded_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # read-side device lane (ISSUE 13): degraded-GET decode +
+    # scrub-rebuild through the feeder's pattern-as-data route, vs the
+    # serial host baseline — the decode twin of the encode segments
+    try:
+        extra.update(bench_decode(
+            device_mode="auto" if platform != "cpu" else "off"))
+    except Exception as e:
+        extra["decode_error"] = f"{type(e).__name__}: {e}"[:300]
+    if platform != "cpu":
+        # forced-device edition: every decode batch on the accelerator
+        # (small, to stay inside the watchdog on a crawling tunnel)
+        try:
+            dev = bench_decode(nblocks=8, device_mode="require")
+            extra["device_decode_gbps"] = dev["decode_gbps"]
+            extra["decode_feeder_device_items"] = max(
+                extra.get("decode_feeder_device_items", 0),
+                dev["decode_feeder_device_items"])
+            extra["device_decode_recompiles"] = dev["decode_recompiles"]
+        except Exception as e:
+            extra["device_decode_error"] = f"{type(e).__name__}: {e}"[:300]
 
     # zero-downtime resize: rebalance throughput vs foreground p99
     # during an add-node + drain-node transition on a 16-node

@@ -50,7 +50,10 @@ def apply_s3_tuning(garage, spec: dict) -> dict:
               # server restart
               "feeder_inflight_batches": (1, 16),
               "feeder_device_min_bytes": (0, 1 << 40),
-              "feeder_device_min_items": (1, 4096)}
+              "feeder_device_min_items": (1, 4096),
+              # read-side routing floors (decode/repair — ISSUE 13)
+              "feeder_device_min_decode_bytes": (0, 1 << 40),
+              "feeder_device_min_decode_items": (1, 4096)}
     validated = {}
     for k, raw in spec.items():
         if k not in bounds:
@@ -89,6 +92,8 @@ def s3_tuning_state(garage) -> dict:
         "feeder_inflight_batches": feeder.inflight_batches,
         "feeder_device_min_bytes": feeder.device_min_bytes,
         "feeder_device_min_items": feeder.device_min_items,
+        "feeder_device_min_decode_bytes": feeder.device_min_decode_bytes,
+        "feeder_device_min_decode_items": feeder.device_min_decode_items,
         "feeder_pipeline": feeder.pipeline_stats(),
     }
 
@@ -942,6 +947,13 @@ class AdminHttpServer:
               "Distinct launch shapes seen (each one XLA compile)")
         gauge("feeder_mesh_batches", fs["mesh_batches"],
               "Device batches sharded across the multi-chip mesh")
+        gauge("feeder_decode_items", fs["decode_items"],
+              "Decode/repair items through the feeder (degraded GETs "
+              "+ scrub/resync rebuilds)")
+        gauge("feeder_decode_device_items", fs["decode_device_items"],
+              "Decode/repair items that ran on the device path (the "
+              "read-side engagement proof metric)")
+        gauge("feeder_decode_device_bytes", fs["decode_device_bytes"])
         ps = feeder.pipeline_stats()
         gauge("feeder_inflight", ps["inflight"],
               "Batches currently in flight through the staged pipeline")

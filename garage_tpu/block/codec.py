@@ -125,6 +125,13 @@ class ErasureCodec(BlockCodec):
         return out
 
     def decode(self, parts: dict[int, bytes], plain_len: int) -> bytes:
+        """HOST-ONLY single-stripe decode (numpy). The device route is
+        the feeder's batched `decode` op (BlockManager._decode_parts):
+        a synchronous per-block device round-trip here would block the
+        CALLER's thread on the tunnel — and the old `_jax_ok` branch
+        also jitted one XLA program per erasure pattern (the unbounded
+        `dec{k},{m},{present}` cache). Callers that can batch go
+        through the feeder; everyone else gets the numpy path."""
         if len(parts) < self.k:
             raise MissingBlock(b"")
         idx = tuple(sorted(parts.keys())[: self.k])
@@ -133,27 +140,20 @@ class ErasureCodec(BlockCodec):
         )
         if all(i < self.k for i in idx):
             data = shards  # all-systematic fast path: no math needed
-        elif self._jax_ok():
-            data = np.asarray(rs.decode(self.k, self.m, idx, shards[None])[0])
         else:
             data = rs.decode_np(self.k, self.m, idx, shards)
         return rs.join_stripe(data, plain_len)
 
     def repair_parts(self, parts: dict[int, bytes],
                      missing: tuple[int, ...]) -> dict[int, bytes]:
-        """Recompute lost shards from any k present ones."""
+        """Recompute lost shards from any k present ones. Host-only,
+        one precomposed repair-matrix matmul per stripe (same rule as
+        decode: the batched device route is feeder.repair)."""
         idx = tuple(sorted(parts.keys())[: self.k])
         shards = np.stack(
             [np.frombuffer(parts[i], dtype=np.uint8) for i in idx]
         )
-        if self._jax_ok():
-            out = np.asarray(
-                rs.repair(self.k, self.m, idx, tuple(missing), shards[None])[0]
-            )
-        else:
-            data = rs.decode_np(self.k, self.m, idx, shards)
-            full = np.concatenate([data, rs.encode_np(self.k, self.m, data)])
-            out = full[list(missing)]
+        out = rs.repair_np(self.k, self.m, idx, tuple(missing), shards)
         return {mi: bytes(out[j]) for j, mi in enumerate(missing)}
 
     def parity_ok(self, parts: dict[int, bytes], hash32: bytes) -> bool:

@@ -29,7 +29,12 @@ returned. This module is the staged replacement:
   recompile count are tracked in the feeder's stats
   (`feeder_pad_waste_bytes`, `feeder_recompiles`). When more than one
   device is visible, batches of at least `[tpu] mesh_min_items` items
-  route through parallel/mesh.py's (dp, tp) data-plane mesh.
+  route through parallel/mesh.py's (dp, tp) data-plane mesh. The READ
+  side (`decode` / `repair` ops, ISSUE 13) ships the erasure pattern as
+  DATA: each stripe's decode/repair bit-matrix rides alongside the
+  shard bytes into one batched matmul (rs.gf_apply_batched), so the
+  launch-shape key — and with it the compile count — never depends on
+  which shards survived.
 
 - `StubDeviceBackend`: a deterministic device emulator (selected via
   `[tpu] device_backend = "stub"` or GARAGE_TPU_DEVICE_BACKEND=stub)
@@ -81,6 +86,10 @@ def group_bytes(op: str, blobs: list) -> int:
         return sum(len(b) for _, b in blobs)
     if op == "parity_check":  # item = one stripe (shard list)
         return sum(len(b) for s in blobs for b in s)
+    if op == "decode":  # item = (present, shards, plain_len)
+        return sum(len(b) for it in blobs for b in it[1])
+    if op == "repair":  # item = (present, missing, shards)
+        return sum(len(b) for it in blobs for b in it[2])
     return sum(len(b) for b in blobs
                if isinstance(b, (bytes, bytearray, memoryview)))
 
@@ -243,6 +252,8 @@ class JaxDeviceBackend:
             return (op, blobs, self._stage_rs(blocks, "encode"))
         if op == "parity_check":
             return (op, blobs, self._stage_parity(blobs))
+        if op in ("decode", "repair"):
+            return (op, blobs, self._stage_gf(op, blobs))
         raise RuntimeError(f"unknown device op {op!r}")
 
     def _stage_hash(self, datas: list[bytes]):
@@ -329,6 +340,68 @@ class JaxDeviceBackend:
             dev = jax.device_put(arr)
         return (len(stripes), dev, mesh, smax)
 
+    def _stage_gf(self, op: str, items: list):
+        """Pad + h2d for the pattern-as-data decode/repair launches.
+
+        Items are grouped by OUTPUT ROW COUNT (decode always rebuilds
+        k rows; repair rebuilds len(missing) — 1 for a resync shard
+        rebuild, more for a multi-loss stripe), because one batched
+        launch needs a uniform (B, 8k, 8·rows) matrix stack. Within a
+        group the shard stacks pad up the usual bucket ladder and the
+        per-item bit-matrices ride as DATA: the shape key deliberately
+        EXCLUDES the erasure pattern, so feeder_recompiles stays flat
+        across mixed present-sets — the whole point of the kernel."""
+        import jax
+
+        from ..ops import rs
+
+        k, m = self.codec.k, self.codec.m
+        shards_of = ((lambda it: it[1]) if op == "decode"
+                     else (lambda it: it[2]))
+        groups: dict[int, list[int]] = {}
+        for i, it in enumerate(items):
+            rows = k if op == "decode" else len(it[1])
+            groups.setdefault(rows, []).append(i)
+        staged = []
+        for rows, idxs in groups.items():
+            slens = [len(shards_of(items[i])[0]) for i in idxs]
+            smax = bucket_len(max(slens))
+            bpad = bucket_items(len(idxs), self.pad_buckets)
+            mesh = (self._get_mesh()
+                    if len(idxs) >= self.mesh_min_items else None)
+            if mesh is not None:
+                dp, tp = mesh.shape["dp"], mesh.shape["tp"]
+                bpad = ((bpad + dp - 1) // dp) * dp
+                smax = ((smax + tp - 1) // tp) * tp
+            batch = np.zeros((bpad, k, smax), dtype=np.uint8)
+            # pad rows keep zero matrices: zero maps to zero output
+            # rows, sliced away at readback (the code is linear)
+            mats = np.zeros((bpad, 8 * k, 8 * rows), dtype=np.int8)
+            for row, i in enumerate(idxs):
+                it = items[i]
+                present = tuple(it[0])
+                for j, s in enumerate(shards_of(it)):
+                    batch[row, j, : len(s)] = np.frombuffer(s,
+                                                            dtype=np.uint8)
+                mats[row] = (rs.decode_bitmat_t(k, m, present)
+                             if op == "decode"
+                             else rs.repair_bitmat_t(k, m, present,
+                                                     tuple(it[1])))
+            waste = bpad * k * smax - sum(
+                len(b) for i in idxs for b in shards_of(items[i]))
+            self._note_shape((op, k, rows, bpad, smax, mesh is not None),
+                             waste)
+            if mesh is not None:
+                from ..parallel import mesh as pmesh
+
+                dev = jax.device_put(batch, pmesh.bytes_sharding(mesh))
+                mdev = jax.device_put(mats, pmesh.mats_sharding(mesh))
+            else:
+                dev = jax.device_put(batch)
+                mdev = jax.device_put(mats)
+            staged.append((rows, idxs, slens, mdev, dev, mesh, smax))
+        return staged
+
     # ---- compute: launch the kernels (async dispatch, no block) ---------
 
     def compute(self, op: str, staged):
@@ -366,6 +439,22 @@ class JaxDeviceBackend:
             else:
                 ok = rs.parity_check(k, m, dev)
             return (op, blobs, (n, ok))
+        if op in ("decode", "repair"):
+            from ..ops import rs
+
+            k = self.codec.k
+            launched = []
+            for rows, idxs, slens, mats, dev, mesh, smax in inner:
+                if mesh is not None:
+                    from ..parallel import mesh as pmesh
+
+                    out = pmesh.make_gf_apply_step(mesh, k, rows,
+                                                   smax)(mats, dev)
+                    self.stats["mesh_batches"] += 1
+                else:
+                    out = rs.gf_apply_batched(mats, dev)
+                launched.append((idxs, slens, out))
+            return (op, blobs, launched)
         raise RuntimeError(f"unknown device op {op!r}")
 
     # ---- readback: d2h + host-side finish -------------------------------
@@ -416,6 +505,24 @@ class JaxDeviceBackend:
             n, ok = inner
             arr = np.asarray(ok)
             return [bool(v) for v in arr[:n]]
+        if op in ("decode", "repair"):
+            from ..ops import rs
+
+            results: list = [None] * len(blobs)
+            for idxs, slens, out in inner:
+                arr = np.asarray(out)
+                for row, i in enumerate(idxs):
+                    sl = slens[row]
+                    if op == "decode":
+                        # (present, shards, plain_len) -> packed bytes
+                        results[i] = rs.join_stripe(arr[row, :, :sl],
+                                                    blobs[i][2])
+                    else:
+                        # (present, missing, shards) -> {idx: payload}
+                        results[i] = {
+                            mi: bytes(arr[row, j, :sl])
+                            for j, mi in enumerate(tuple(blobs[i][1]))}
+            return results
         raise RuntimeError(f"unknown device op {op!r}")
 
 
@@ -490,6 +597,10 @@ class StubDeviceBackend:
             res = f._do_encode_put(list(blobs), "host")
         elif op == "parity_check":
             res = f._do_parity_check(list(blobs), "host")
+        elif op == "decode":
+            res = f._do_decode(list(blobs), "host")
+        elif op == "repair":
+            res = f._do_repair(list(blobs), "host")
         else:
             raise RuntimeError(f"unknown device op {op!r}")
         return (op, blobs, res)
@@ -501,6 +612,10 @@ class StubDeviceBackend:
             out_bytes = 32 * len(res)
         elif op in ("encode", "encode_put"):
             out_bytes = sum(len(b) for parts in res for b in parts)
+        elif op == "decode":
+            out_bytes = sum(len(b) for b in res)
+        elif op == "repair":
+            out_bytes = sum(len(b) for d in res for b in d.values())
         else:
             out_bytes = len(res)
         self._sleep("d2h", out_bytes)

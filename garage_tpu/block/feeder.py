@@ -4,7 +4,8 @@ The reference does its per-block CPU work (hashing, compression) inline
 in each request task (src/api/s3/put.rs:413-477 spawn_blocking, one
 block at a time). A TPU earns its keep only on *batches* — so the data
 path here funnels every block-math request (content hash, RS encode,
-scrub verify) through one bounded queue. A single dispatcher drains
+RS decode/repair, scrub verify) through one bounded queue. A single
+dispatcher drains
 whatever has accumulated, groups it by operation and shape, and issues
 one batched JAX call per group (ops/treehash.hash_batch_jax,
 ops/rs.encode). Under load, concurrent PUTs coalesce into MXU-sized
@@ -65,6 +66,16 @@ log = logging.getLogger("garage_tpu.block.feeder")
 # a (possibly remote) device round trip only pays above these sizes
 _DEVICE_MIN_BYTES = 4 << 20
 _DEVICE_MIN_ITEMS = 4
+# separate floors for the READ-side ops (decode/repair): degraded GETs
+# are latency-sensitive, so a lone decode stays host-inline and only
+# coalesced bursts (concurrent degraded GETs, scrub/resync rebuild
+# waves) pay a device trip
+_DEVICE_MIN_DECODE_BYTES = 4 << 20
+_DEVICE_MIN_DECODE_ITEMS = 4
+# inline decode/repair fast path size ceiling: above this the GF
+# matmul runs in a worker thread via the queue (a multi-MiB stripe
+# matmul inline would park the event loop for milliseconds per GET)
+_INLINE_DECODE_MAX_BYTES = 1 << 20
 # re-try the losing backend at most this often (wall clock) so a
 # recovered tunnel (or a warmed-up XLA program) gets re-discovered.
 # Time-based, not count-based: on a slow tunnel one exploration batch
@@ -242,6 +253,10 @@ class DeviceFeeder:
                                          _DEVICE_MIN_BYTES))
         self.device_min_items = int(knob("device_min_items",
                                          _DEVICE_MIN_ITEMS))
+        self.device_min_decode_bytes = int(knob("device_min_decode_bytes",
+                                                _DEVICE_MIN_DECODE_BYTES))
+        self.device_min_decode_items = int(knob("device_min_decode_items",
+                                                _DEVICE_MIN_DECODE_ITEMS))
         self.trial_max_items = int(knob("trial_max_items",
                                         _TRIAL_MAX_ITEMS))
         self.trial_items_cap = int(knob("trial_items_cap",
@@ -289,7 +304,13 @@ class DeviceFeeder:
                       "device_items": 0, "device_bytes": 0,
                       "inline_items": 0, "max_batch": 0,
                       "pad_waste_bytes": 0, "recompiles": 0,
-                      "mesh_batches": 0}
+                      "mesh_batches": 0,
+                      # read-side (decode + repair) engagement counters:
+                      # total items through the feeder, items/bytes that
+                      # ran on the device path (the degraded-GET /
+                      # rebuild twin of device_items)
+                      "decode_items": 0, "decode_device_items": 0,
+                      "decode_device_bytes": 0}
         # staged pipeline state: the current executor generation, the
         # batches in flight, per-stage busy seconds and the wall-clock
         # union of windows with >= 1 device leg in flight (overlap
@@ -483,6 +504,17 @@ class DeviceFeeder:
         blob = bytes(np.random.default_rng(0).integers(
             0, 256, 1 << 20, dtype=np.uint8))
         batch = [blob] * 4
+        dec_items = None
+        if self.codec is not None and self.codec.m >= 1:
+            # read-side seed: a degraded stripe (shard 0 lost, first
+            # parity standing in) — without it the first production
+            # decode wave pays a cold device trial inline, exactly what
+            # calibration exists to avoid on the PUT ops
+            k = self.codec.k
+            present = tuple(range(1, k + 1))
+            stripes = self._do_encode(batch, "host")
+            dec_items = [(present, [st[i] for i in present], len(blob))
+                         for st in stripes]
         for backend in ("host", "device"):
             try:
                 # blake2 hashing never runs on device — recording a
@@ -498,6 +530,13 @@ class DeviceFeeder:
                     self._do_encode(batch, backend)
                     self._record("encode", backend, len(batch) << 20,
                                  time.perf_counter() - t0)
+                if dec_items is not None:
+                    t0 = time.perf_counter()
+                    self._do_decode(dec_items, backend)
+                    self._record("decode", backend,
+                                 sum(len(b) for it in dec_items
+                                     for b in it[1]),
+                                 time.perf_counter() - t0)
             except Exception as e:
                 # a host-leg failure must not kill the thread silently
                 # (the device leg would then never run and the first
@@ -508,6 +547,7 @@ class DeviceFeeder:
                 if backend == "device":
                     self._record("hash", "device", 0, 60.0)
                     self._record("encode", "device", 0, 60.0)
+                    self._record("decode", "device", 0, 60.0)
         log.info("feeder calibration: %s", self.perf_summary())
 
     # ---- public async ops ---------------------------------------------
@@ -712,6 +752,98 @@ class DeviceFeeder:
         futs = [self._submit("parity_check", s) for s in stripes]
         return list(await asyncio.gather(*futs))
 
+    def _check_stripe(self, present, shards, k: int, width: int) -> tuple:
+        """Shared validation for the read-side ops, BEFORE the queue:
+        a malformed item must fail its own caller, never poison the
+        group-mates it would have batched with (one _exec_group
+        exception fails the whole leg)."""
+        present = tuple(present)
+        if len(present) != k or len(shards) != k:
+            raise ValueError(
+                f"need exactly k={k} present shards, got "
+                f"{len(present)} indices / {len(shards)} payloads")
+        if len(set(present)) != k or any(
+                not 0 <= int(i) < width for i in present):
+            raise ValueError(
+                f"present indices must be {k} distinct values in "
+                f"[0, {width}); got {present}")
+        slen = len(shards[0])
+        if any(len(s) != slen for s in shards):
+            raise ValueError("unequal shard lengths in decode/repair "
+                             "stripe (corrupt or misplaced shard)")
+        return present
+
+    async def decode(self, present, shards: list, plain_len: int) -> bytes:
+        """Erasure decode of one stripe: `shards` are the surviving
+        payloads in ascending `present`-index order; -> the packed
+        block bytes (join_stripe at plain_len). Batched with every
+        concurrent caller, so degraded GETs and rebuild waves coalesce
+        into one pattern-as-data device launch. The all-systematic case
+        is the CALLER's fast path (pure concat, no math) — everything
+        submitted here pays a real matmul somewhere."""
+        if self.codec is None:
+            raise RuntimeError("feeder has no codec")
+        codec = self.codec
+        present = self._check_stripe(present, shards, codec.k,
+                                     codec.k + codec.m)
+        total = sum(len(s) for s in shards)
+        if total <= _INLINE_DECODE_MAX_BYTES \
+                and self._host_inline_ok("decode"):
+            from .. import native
+            from ..ops import rs
+
+            self.stats["inline_items"] += 1
+            self.stats["decode_items"] += 1
+            t0 = time.perf_counter()
+            st = np.stack([np.frombuffer(s, dtype=np.uint8)
+                           for s in shards])
+            # lint: ignore[GL10] host-inline fast path is gated to <= _INLINE_DECODE_MAX_BYTES stripes; the flagged open chain is the one-time native build, cached for the process lifetime
+            data = native.gf_matmul(
+                rs.decode_matrix(codec.k, codec.m, present), st)
+            out = rs.join_stripe(data, plain_len)
+            self._record("decode", "host", total,
+                         time.perf_counter() - t0)
+            return out
+        return await self._submit("decode", (present, list(shards),
+                                             plain_len))
+
+    async def repair(self, present, missing, shards: list) -> dict:
+        """Rebuild the `missing` shard payloads of one stripe from the
+        k `present` ones -> {missing_index: payload}. The resync /
+        scrub rebuild twin of decode — concurrent rebuilds across a
+        wave batch into one launch (grouped by len(missing), since one
+        launch needs a uniform output row count)."""
+        if self.codec is None:
+            raise RuntimeError("feeder has no codec")
+        codec = self.codec
+        width = codec.k + codec.m
+        present = self._check_stripe(present, shards, codec.k, width)
+        missing = tuple(missing)
+        if not missing:
+            return {}
+        if any(not 0 <= int(i) < width for i in missing):
+            raise ValueError(
+                f"missing indices must be in [0, {width}); got {missing}")
+        total = sum(len(s) for s in shards)
+        if total <= _INLINE_DECODE_MAX_BYTES \
+                and self._host_inline_ok("decode"):
+            from .. import native
+            from ..ops import rs
+
+            self.stats["inline_items"] += 1
+            self.stats["decode_items"] += 1
+            t0 = time.perf_counter()
+            st = np.stack([np.frombuffer(s, dtype=np.uint8)
+                           for s in shards])
+            # lint: ignore[GL10] host-inline fast path is gated to <= _INLINE_DECODE_MAX_BYTES stripes; the flagged open chain is the one-time native build, cached for the process lifetime
+            out = native.gf_matmul(
+                rs.repair_matrix(codec.k, codec.m, present, missing), st)
+            self._record("decode", "host", total,
+                         time.perf_counter() - t0)
+            return {mi: bytes(out[j]) for j, mi in enumerate(missing)}
+        return await self._submit("repair", (present, missing,
+                                             list(shards)))
+
     # ---- dispatcher ----------------------------------------------------
 
     async def _run(self) -> None:
@@ -799,6 +931,8 @@ class DeviceFeeder:
         and device legs through the staged pipeline, concurrently."""
         self.stats["batches"] += 1
         self.stats["items"] += len(batch)
+        self.stats["decode_items"] += sum(
+            1 for it in batch if it.op in ("decode", "repair"))
         self.stats["max_batch"] = max(self.stats["max_batch"], len(batch))
         results: list = [None] * len(batch)
         legs = self._plan_batch(batch)
@@ -878,6 +1012,9 @@ class DeviceFeeder:
             self.stats["device_batches"] += 1
             self.stats["device_items"] += len(idxs)
             self.stats["device_bytes"] += total
+            if op in ("decode", "repair"):
+                self.stats["decode_device_items"] += len(idxs)
+                self.stats["decode_device_bytes"] += total
         finally:
             self._window_close()
 
@@ -995,8 +1132,15 @@ class DeviceFeeder:
             return "host", False
         if self._force_device.pop(op, False):
             return "device", True  # inline fast-path escape: re-probe now
-        if total_bytes < self.device_min_bytes \
-                and n_items < self.device_min_items:
+        if op == "decode":
+            # the read-side floors ([tpu] device_min_decode_*): degraded
+            # GETs are latency-sensitive, so lone decodes stay host
+            min_bytes, min_items = (self.device_min_decode_bytes,
+                                    self.device_min_decode_items)
+        else:
+            min_bytes, min_items = (self.device_min_bytes,
+                                    self.device_min_items)
+        if total_bytes < min_bytes and n_items < min_items:
             return "host", False  # tiny batches never amortize a round trip
         dev_rate, host_rate = self._rates(op)
         if dev_rate is None:
@@ -1031,7 +1175,8 @@ class DeviceFeeder:
             total = group_bytes(op, [batch[i].data for i in idxs])
             perf_op = ("hash" if op in ("verify", "hash_md5") else
                        "encode" if op == "encode_put" else
-                       "parity" if op == "parity_check" else op)
+                       "parity" if op == "parity_check" else
+                       "decode" if op == "repair" else op)
             host_only = force_host
             if perf_op == "hash":
                 from ..utils import data as _data
@@ -1065,6 +1210,8 @@ class DeviceFeeder:
         routes through _run_batch_staged instead."""
         self.stats["batches"] += 1
         self.stats["items"] += len(batch)
+        self.stats["decode_items"] += sum(
+            1 for it in batch if it.op in ("decode", "repair"))
         self.stats["max_batch"] = max(self.stats["max_batch"], len(batch))
         results: list = [None] * len(batch)
         self._exec_legs(batch, self._plan_batch(batch, force_host), results)
@@ -1084,6 +1231,10 @@ class DeviceFeeder:
                 d = d[1]
             if op == "parity_check":
                 size += sum(len(b) for b in d)
+            elif op == "decode":
+                size += sum(len(b) for b in d[1])
+            elif op == "repair":
+                size += sum(len(b) for b in d[2])
             else:
                 size += len(d) if isinstance(d, (bytes, bytearray,
                                                  memoryview)) else 0
@@ -1118,6 +1269,9 @@ class DeviceFeeder:
             if backend == "device":
                 self.stats["device_batches"] += 1
                 self.stats["device_items"] += len(idxs)
+                if op in ("decode", "repair"):
+                    self.stats["decode_device_items"] += len(idxs)
+                    self.stats["decode_device_bytes"] += total
         except Exception as e:
             for i in idxs:
                 results[i] = e
@@ -1148,6 +1302,10 @@ class DeviceFeeder:
             return self._do_encode_put(blobs, backend)
         if op == "parity_check":
             return self._do_parity_check(blobs, backend)
+        if op == "decode":
+            return self._do_decode(blobs, backend)
+        if op == "repair":
+            return self._do_repair(blobs, backend)
         raise RuntimeError(f"unknown feeder op {op!r}")
 
     def _do_hash(self, blobs: list[bytes], backend: str) -> list[bytes]:
@@ -1271,4 +1429,116 @@ class DeviceFeeder:
                       else rs.encode_np(k, m, data))
             out.append(all(bytes(parity[j]) == bytes(s[k + j])
                            for j in range(m)))
+        return out
+
+    @staticmethod
+    def _native_or_none():
+        """The optional native kernel module, or None — one copy of the
+        guarded import the host legs share."""
+        try:
+            from .. import native
+
+            if native.available():
+                return native
+        except Exception:
+            # lint: ignore[GL05] native backend optional; numpy path handles it
+            pass
+        return None
+
+    def _do_decode(self, items: list[tuple], backend: str) -> list[bytes]:
+        """items = [(present, shards, plain_len)] -> packed block bytes
+        per item. Device: the batched pattern-as-data launch (one
+        compiled program per shape — the per-item decode matrices ride
+        as data). Host: native GF matmul per stripe, numpy as last
+        resort — same no-JAX-on-host rule as _do_encode."""
+        from ..ops import rs
+
+        codec = self.codec
+        k, m = codec.k, codec.m
+        if backend == "device":
+            return self._device_gf_batched("decode", items)
+        native_mod = self._native_or_none()
+        out = []
+        for present, shards, plain_len in items:
+            present = tuple(present)
+            st = np.stack([np.frombuffer(s, dtype=np.uint8)
+                           for s in shards])
+            if all(i < k for i in present):
+                data = st  # all-systematic: no math needed
+            elif native_mod is not None:
+                data = native_mod.gf_matmul(
+                    rs.decode_matrix(k, m, present), st)
+            else:
+                data = rs.decode_np(k, m, present, st)
+            out.append(rs.join_stripe(data, plain_len))
+        return out
+
+    def _device_gf_batched(self, op: str, items: list[tuple]) -> list:
+        """Synchronous-path device decode/repair: ONE padded
+        pattern-as-data launch per output-row group (the calibration /
+        sync-_run_batch twin of the backend's _stage_gf). Shapes pad up
+        the same bucket ladder, so the compiled programs are shared
+        with the staged route instead of jitting one B=1 program per
+        distinct shard length and paying N serial round-trips."""
+        from .device_backend import bucket_items, bucket_len
+        from ..ops import rs
+
+        codec = self.codec
+        k, m = codec.k, codec.m
+        shards_of = ((lambda it: it[1]) if op == "decode"
+                     else (lambda it: it[2]))
+        groups: dict[int, list[int]] = {}
+        for i, it in enumerate(items):
+            rows = k if op == "decode" else len(it[1])
+            groups.setdefault(rows, []).append(i)
+        results: list = [None] * len(items)
+        for rows, idxs in groups.items():
+            slens = [len(shards_of(items[i])[0]) for i in idxs]
+            smax = bucket_len(max(slens))
+            bpad = bucket_items(len(idxs), self.pad_buckets)
+            batch = np.zeros((bpad, k, smax), dtype=np.uint8)
+            mats = np.zeros((bpad, 8 * k, 8 * rows), dtype=np.int8)
+            for row, i in enumerate(idxs):
+                it = items[i]
+                present = tuple(it[0])
+                for j, s in enumerate(shards_of(it)):
+                    batch[row, j, : len(s)] = np.frombuffer(
+                        s, dtype=np.uint8)
+                mats[row] = (rs.decode_bitmat_t(k, m, present)
+                             if op == "decode"
+                             else rs.repair_bitmat_t(k, m, present,
+                                                     tuple(it[1])))
+            out = np.asarray(rs.gf_apply_batched(mats, batch))
+            for row, i in enumerate(idxs):
+                sl = slens[row]
+                if op == "decode":
+                    results[i] = rs.join_stripe(out[row, :, :sl],
+                                                items[i][2])
+                else:
+                    results[i] = {
+                        mi: bytes(out[row, j, :sl])
+                        for j, mi in enumerate(tuple(items[i][1]))}
+        return results
+
+    def _do_repair(self, items: list[tuple], backend: str) -> list[dict]:
+        """items = [(present, missing, shards)] -> {missing_index:
+        payload} per item (the resync/scrub rebuild op)."""
+        from ..ops import rs
+
+        codec = self.codec
+        k, m = codec.k, codec.m
+        if backend == "device":
+            return self._device_gf_batched("repair", items)
+        out = []
+        native_mod = self._native_or_none()
+        for present, missing, shards in items:
+            present, missing = tuple(present), tuple(missing)
+            st = np.stack([np.frombuffer(s, dtype=np.uint8)
+                           for s in shards])
+            rows = (native_mod.gf_matmul(
+                        rs.repair_matrix(k, m, present, missing), st)
+                    if native_mod is not None
+                    else rs.repair_np(k, m, present, missing, st))
+            out.append({mi: bytes(rows[j])
+                        for j, mi in enumerate(missing)})
         return out

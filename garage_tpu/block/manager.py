@@ -599,9 +599,18 @@ class BlockManager:
             parts, candidates, _lens = got
             for packed_len in candidates:
                 try:
-                    blk = DataBlock.unpack(
-                        self.codec.decode(parts, packed_len))
-                    blk.verify(hash32)
+                    packed = await self._decode_parts(parts, packed_len)
+
+                    def unpack_verify(packed=packed) -> bytes:
+                        blk = DataBlock.unpack(packed)
+                        blk.verify(hash32)
+                        return blk.plain_bytes()
+
+                    # MiB-scale decompress+verify off the event loop,
+                    # same rule as the replicate read path
+                    if len(packed) >= 64 * 1024:
+                        return await asyncio.to_thread(unpack_verify)
+                    return unpack_verify()
                 except (CorruptData, ValueError, IndexError):
                     # a forged/rotted length can make the decode itself
                     # blow up, not just the content check — either way
@@ -609,10 +618,26 @@ class BlockManager:
                     log.info("block %s: decode at packed_len=%d failed "
                              "verification", hash32[:4].hex(), packed_len)
                     continue
-                return blk.plain_bytes()
         if gathered_any:
             raise CorruptData(hash32)
         raise MissingBlock(hash32)
+
+    async def _decode_parts(self, parts: dict[int, bytes],
+                            packed_len: int) -> bytes:
+        """Stripe parts -> packed block bytes. The all-systematic case
+        is a pure concat (codec.decode, no math, no queue hop); a
+        DEGRADED set routes through the feeder's batched `decode` op,
+        so concurrent degraded GETs — and scrub/resync rebuild waves —
+        coalesce into one pattern-as-data device launch instead of one
+        blocking host matmul per block on the event loop."""
+        codec = self.codec
+        idx = tuple(sorted(parts.keys())[: codec.read_need])
+        if len(parts) < codec.read_need:
+            raise MissingBlock(b"")
+        if all(i < codec.k for i in idx):
+            return codec.decode(parts, packed_len)
+        return await self.feeder.decode(idx, [parts[i] for i in idx],
+                                        packed_len)
 
     async def _gather_parts(self, hash32: bytes, placement: list[bytes],
                             need: int):

@@ -30,6 +30,24 @@ log = logging.getLogger("garage_tpu.block.repair")
 SCRUB_INTERVAL = 25 * 86400.0  # ~25 days, ref: repair.rs:24-27
 
 
+async def gather_bounded(gather, items: list, window: int) -> list:
+    """Run `gather(*item)` for every item with at most `window` in
+    flight; results in item order. Deep scrub's leader sweep used an
+    UNBOUNDED asyncio.gather of k-shard stripe gathers — on a large
+    scrub batch that is batch×width concurrent MiB-scale fetches
+    spiking RAM and RPC concurrency at once. The feeder batch size is
+    the natural window: the parity-check launch downstream can't
+    consume more than one batch at a time anyway, so gathering wider
+    only buys memory pressure."""
+    sem = asyncio.Semaphore(max(1, int(window)))
+
+    async def one(item):
+        async with sem:
+            return await gather(*item)
+
+    return await asyncio.gather(*[one(it) for it in items])
+
+
 class ScrubState(migrate.Migratable):
     VERSION_MARKER = b"GTscrb01"
 
@@ -253,9 +271,12 @@ class ScrubWorker(Worker):
         if not leaders:
             return 0
         # stripe gathers are independent: run them concurrently so a
-        # slow holder costs the batch max(latency), not the sum
-        gathered = await asyncio.gather(
-            *[m._gather_parts(h, p, m.codec.width) for h, p in leaders])
+        # slow holder costs the batch max(latency), not the sum — but
+        # WINDOWED at the feeder batch size (gather_bounded) so a big
+        # scrub batch can't fan out batch×width shard fetches at once
+        gathered = await gather_bounded(
+            lambda h, p: m._gather_parts(h, p, m.codec.width),
+            leaders, getattr(m.feeder, "max_batch", self.BATCH))
         stripes, metas, flagged, clean = [], [], [], []
         for (h, placement), got in zip(leaders, gathered):
             if got is None:

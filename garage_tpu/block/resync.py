@@ -541,8 +541,11 @@ class BlockResyncManager:
         return None, skipped
 
     async def _rebuild_shard(self, hash32: bytes, idx: int) -> Optional[bytes]:
-        """RS repair: gather any k parts, recompute shard idx (the TPU
-        repair matmul, ops/rs.py repair)."""
+        """RS repair: gather any k parts, recompute shard idx through
+        the feeder's batched `repair` op — concurrent resync workers'
+        rebuilds (a repair/rebalance wave) coalesce into one
+        pattern-as-data device launch instead of one host matmul per
+        stripe on the event loop."""
         m = self.manager
         placement = shard_nodes_of(m.system.layout_helper.current(),
                                    hash32, m.codec.width)
@@ -554,7 +557,9 @@ class BlockResyncManager:
         if idx in parts:
             # lint: ignore[GL10] pack_shard's crc is native-C microseconds; the flagged open/cc chain is the one-time kernel build, cached for the process lifetime
             return pack_shard(parts[idx], packed_len)
-        rebuilt = m.codec.repair_parts(parts, (idx,))
+        present = tuple(sorted(parts.keys())[: m.codec.read_need])
+        rebuilt = await m.feeder.repair(present, (idx,),
+                                        [parts[i] for i in present])
         return pack_shard(rebuilt[idx], packed_len)
 
 

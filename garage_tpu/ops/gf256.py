@@ -196,6 +196,34 @@ def bit_matmul_apply(bitmat_t, x):
     return pack_bits(acc & 1, r8 // 8)
 
 
+def bit_matmul_apply_batched(bitmats_t, x):
+    """Per-item GF(2^8) linear maps in ONE batched MXU matmul — the
+    pattern-as-data form of bit_matmul_apply.
+
+    bitmats_t: (B, 8s, 8r) int8 — expand_bitmatrix(A_i).T per item.
+    x:         (B, s, n) uint8 — item i's s input symbols per byte-pos.
+    returns    (B, r, n) uint8 == A_i @ x_i over GF(2^8).
+
+    Because the matrices ride as a TENSOR OPERAND instead of a trace
+    constant, jit keys on shapes only: one compiled program serves
+    every erasure pattern (decode/repair matrices differ per present-
+    set), where the constant-matrix form compiles one XLA program per
+    pattern — the unbounded-cache / recompile-per-pattern trap the
+    read path's pad buckets exist to kill."""
+    import jax
+
+    jnp = _jnp()
+    r8 = bitmats_t.shape[-1]
+    bits = unpack_bits(x)  # (B, n, 8s)
+    acc = jax.lax.dot_general(
+        bits,
+        bitmats_t.astype(jnp.int8),
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.int32,
+    )  # (B, n, 8r)
+    return pack_bits(acc & 1, r8 // 8)
+
+
 def bitmat_t_for(a: np.ndarray):
     """Constant operand for bit_matmul_apply: expand_bitmatrix(a).T as
     int8. Returned as NUMPY on purpose: callers may be lru-cached

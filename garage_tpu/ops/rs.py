@@ -9,8 +9,10 @@ Shapes: a *stripe* is (k, shard_len) bytes of data producing (m,
 shard_len) parity; all ops take arbitrary leading batch dims so a whole
 batch of 1-16 MiB blocks is one MXU matmul (see gf256.bit_matmul_apply).
 Decode/repair matrices depend on *which* shards survive; they are built
-host-side per erasure pattern (k x k inversion, microseconds) and cached,
-so each pattern compiles exactly one XLA program.
+host-side per erasure pattern (k x k inversion, microseconds) and
+cached — but on device they travel as DATA (gf_apply_batched /
+gf256.bit_matmul_apply_batched), so one compiled XLA program serves
+every pattern; only the encode/parity constants are baked into traces.
 
 This is the math behind the `erasure(k, m)` replication mode — the north
 star's addition at the reference's plugin boundary
@@ -61,6 +63,25 @@ def repair_matrix(
     from the k `present` ones (data and parity alike)."""
     g = generator_matrix(k, m)
     return gf256.gf_matmul(g[list(missing)], decode_matrix(k, m, present))
+
+
+@functools.lru_cache(maxsize=None)
+def decode_bitmat_t(k: int, m: int, present: tuple[int, ...]) -> np.ndarray:
+    """(8k, 8k) int8 transposed bit-expansion of decode_matrix — the
+    per-item DATA operand of the pattern-as-data batched kernel
+    (gf_apply_batched). Host-side and lru-cached like the matrix
+    itself: the inversion plus expansion is microseconds, and caching
+    keys on the pattern tuple so a busy mixed-pattern read path builds
+    each expansion once."""
+    return gf256.bitmat_t_for(decode_matrix(k, m, present))
+
+
+@functools.lru_cache(maxsize=None)
+def repair_bitmat_t(k: int, m: int, present: tuple[int, ...],
+                    missing: tuple[int, ...]) -> np.ndarray:
+    """(8k, 8·len(missing)) int8 transposed bit-expansion of
+    repair_matrix, for the batched repair launch."""
+    return gf256.bitmat_t_for(repair_matrix(k, m, present, missing))
 
 
 # ---------------------------------------------------------------------------
@@ -135,6 +156,43 @@ def _apply(tag: str, mat: np.ndarray, x):
     return fn(x)
 
 
+@functools.lru_cache(maxsize=None)
+def _jit_gf_apply_batched():
+    """THE pattern-as-data kernel: one jitted batched GF apply for all
+    erasure patterns. The per-item bit-matrices are a tensor operand,
+    so jit keys on SHAPES only — (batch bucket, k, rows, shard-len
+    bucket) — never on which shards survived. One compiled program per
+    shape serves every present-set; the feeder's pad-bucket ladder
+    keeps the shape set finite."""
+    import jax
+
+    @jax.jit
+    def apply(bitmats_t, x):
+        return gf256.bit_matmul_apply_batched(bitmats_t, x)
+
+    return apply
+
+
+def gf_apply_batched(bitmats_t, shards):
+    """Per-stripe GF maps, batched: bitmats_t (B, 8s, 8r) int8 +
+    shards (B, s, n) uint8 -> (B, r, n) uint8 on device."""
+    return _jit_gf_apply_batched()(bitmats_t, shards)
+
+
+def _apply_pattern(bitmat_t: np.ndarray, x):
+    """Apply ONE pattern's bit-matrix to a (..., s, n) batch through
+    the pattern-as-data kernel (matrix broadcast over the batch). The
+    predecessor jitted per pattern (`f"dec{k},{m},{present}"` keys):
+    every distinct erasure pattern grew the jit cache and paid a fresh
+    XLA compile — unbounded across C(k+m, k) patterns."""
+    shape = tuple(x.shape)
+    x3 = x.reshape((-1,) + shape[-2:])
+    mats = np.ascontiguousarray(
+        np.broadcast_to(bitmat_t, (x3.shape[0],) + bitmat_t.shape))
+    out = gf_apply_batched(mats, x3)
+    return out.reshape(shape[:-2] + tuple(out.shape[-2:]))
+
+
 def encode(k: int, m: int, data):
     """data (..., k, n) uint8 -> parity (..., m, n) uint8 on device."""
     return _apply(f"enc{k},{m}", parity_matrix(k, m), data)
@@ -142,14 +200,17 @@ def encode(k: int, m: int, data):
 
 def decode(k: int, m: int, present: tuple[int, ...], shards):
     """shards (..., k, n) = surviving shard rows in ascending-index order
-    -> data (..., k, n)."""
-    return _apply(f"dec{k},{m},{present}", decode_matrix(k, m, present), shards)
+    -> data (..., k, n). Pattern-as-data: every present-set shares one
+    compiled program per shape (the constant-matrix form leaked a jit
+    cache entry + compile per pattern)."""
+    return _apply_pattern(decode_bitmat_t(k, m, tuple(present)), shards)
 
 
 def repair(k: int, m: int, present: tuple[int, ...], missing: tuple[int, ...], shards):
-    """shards (..., k, n) -> rebuilt missing shards (..., len(missing), n)."""
-    mat = repair_matrix(k, m, present, missing)
-    return _apply(f"rep{k},{m},{present},{missing}", mat, shards)
+    """shards (..., k, n) -> rebuilt missing shards (..., len(missing), n).
+    Pattern-as-data like decode."""
+    return _apply_pattern(
+        repair_bitmat_t(k, m, tuple(present), tuple(missing)), shards)
 
 
 @functools.lru_cache(maxsize=None)
@@ -190,6 +251,14 @@ def encode_np(k: int, m: int, data: np.ndarray) -> np.ndarray:
 
 def decode_np(k: int, m: int, present: tuple[int, ...], shards: np.ndarray) -> np.ndarray:
     return gf256.gf_matmul(decode_matrix(k, m, present), np.asarray(shards, dtype=np.uint8))
+
+
+def repair_np(k: int, m: int, present: tuple[int, ...],
+              missing: tuple[int, ...], shards: np.ndarray) -> np.ndarray:
+    """Host reference: rebuild the `missing` rows directly from the k
+    `present` ones (one matmul by the precomposed repair matrix)."""
+    return gf256.gf_matmul(repair_matrix(k, m, present, missing),
+                           np.asarray(shards, dtype=np.uint8))
 
 
 # ---------------------------------------------------------------------------

@@ -62,6 +62,14 @@ def bytes_sharding(mesh):
     return _sh(mesh, "dp", None, "tp")
 
 
+def mats_sharding(mesh):
+    """Sharding of the per-stripe bit-matrix stack (B, 8k, 8r) for the
+    pattern-as-data steps: dp over stripes, the (tiny) matrix axes
+    replicated. Public so the feeder's staged h2d can device_put the
+    matrices straight into the mesh layout alongside the shard bytes."""
+    return _sh(mesh, "dp", None, None)
+
+
 def _layouts(mesh, n: int, shard_len: int):
     """(bytes_sh, shards_sh, n_sharded) for a (B, n, S) stripe batch.
     Validates tp | S; shards the n axis in the whole-shard layout only
@@ -164,6 +172,31 @@ def make_parity_check_step(mesh, k: int, m: int, shard_len: int):
         return jnp.all(parity2 == stripes[:, k:, :], axis=(1, 2))
 
     return jax.jit(step, in_shardings=bytes_sh, out_shardings=_sh(mesh, "dp"))
+
+
+@functools.lru_cache(maxsize=None)
+def make_gf_apply_step(mesh, k: int, rows: int, shard_len: int):
+    """Jitted pattern-as-data GF apply: per-stripe (8k, 8·rows)
+    bit-matrices (dp-sharded, tiny, replicated across tp) applied to a
+    (B, k, S) shard stack (dp over stripes, tp over the byte axis —
+    the contraction is per byte-position, so no cross-chip collective).
+    This is the feeder's multi-chip decode/repair route: one compiled
+    program per SHAPE serves every erasure pattern, because which
+    shards survived lives in the matrix DATA, not the trace."""
+    import jax
+
+    tp = mesh.shape["tp"]
+    if shard_len % tp:
+        raise ValueError(
+            f"tp={tp} must divide shard_len={shard_len} (byte-split layout)")
+    bytes_sh = _sh(mesh, "dp", None, "tp")
+
+    def step(mats_t, shards):
+        shards = jax.lax.with_sharding_constraint(shards, bytes_sh)
+        return gf256.bit_matmul_apply_batched(mats_t, shards)
+
+    return jax.jit(step, in_shardings=(mats_sharding(mesh), bytes_sh),
+                   out_shardings=bytes_sh)
 
 
 @functools.lru_cache(maxsize=None)

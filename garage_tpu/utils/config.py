@@ -50,6 +50,12 @@ class TpuConfig:
     # host (a device round trip costs more than it saves there)
     device_min_bytes: Optional[int] = None  # default 4 MiB
     device_min_items: Optional[int] = None  # default 4
+    # read-side floors (decode/repair, ISSUE 13): a lone degraded GET
+    # decodes host-inline for latency; only coalesced bursts
+    # (concurrent degraded GETs, scrub/resync rebuild waves) pay a
+    # device trip. Runtime-tunable via admin /v1/s3/tuning.
+    device_min_decode_bytes: Optional[int] = None  # default 4 MiB
+    device_min_decode_items: Optional[int] = None  # default 4
     # exploration-trial caps: items/bytes sacrificed to re-time the
     # currently-losing backend (block/feeder.py _trial_cut)
     trial_max_items: Optional[int] = None   # default 2

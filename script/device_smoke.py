@@ -47,6 +47,31 @@ def main() -> int:
     print("OK: live PUT path engaged the device "
           f"({out['s3_feeder_device_items']} items, overlap "
           f"{out.get('s3_feeder_overlap_efficiency', 0.0)})")
+
+    # read-side gate (ISSUE 13): degraded GETs + rebuild waves must
+    # engage the device decode route — stub and real device alike
+    dec = bench.bench_decode(nblocks=4, block_kib=256,
+                             device_mode="require")
+    print(json.dumps(dec, indent=2))
+    if dec.get("decode_feeder_device_items", 0) <= 0:
+        print("FAIL: decode_feeder_device_items == 0 — degraded GETs "
+              "never reached the device decode path")
+        return 1
+    # pattern-as-data flatness gate: under the stub nothing compiles
+    # (0); on a real device only the first decode + rebuild SHAPES may
+    # compile — recompiles scaling with the mixed pattern count means
+    # the present-set leaked back into a jit key
+    rc_ceiling = 0 if stub else 3
+    if dec.get("decode_recompiles", 0) > rc_ceiling:
+        print(f"FAIL: decode_recompiles = {dec['decode_recompiles']} "
+              f"(> {rc_ceiling}) across "
+              f"{dec['decode_patterns_mixed']} erasure patterns — "
+              "decode is recompiling per pattern")
+        return 1
+    print("OK: degraded-GET/rebuild path engaged the device "
+          f"({dec['decode_feeder_device_items']} decode items, "
+          f"{dec['decode_recompiles']} recompiles across "
+          f"{dec['decode_patterns_mixed']} erasure patterns)")
     return 0
 
 

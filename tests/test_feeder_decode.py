@@ -1,0 +1,388 @@
+"""Device-routed read path (ISSUE 13): batched RS decode & repair
+through the staged pipeline with pattern-as-data GF kernels.
+
+Same deviceless discipline as test_feeder_pipeline.py: the jax
+backend's "device" is the cpu platform (conftest pins JAX_PLATFORMS=cpu)
+— the staging/padding/grouping and the pattern-as-data compile behavior
+are under test, not the silicon — and the stub backend covers the
+watchdog and live-gate semantics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+from garage_tpu.block.codec import ErasureCodec
+from garage_tpu.block.device_backend import StubDeviceBackend
+from garage_tpu.block.feeder import DeviceFeeder, _Item
+from garage_tpu.ops import rs
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _stripe(codec, block: bytes):
+    return codec.encode(block)
+
+
+# ---------------------------------------------------------------------------
+# byte-parity: device decode/repair == decode_np across ALL erasure
+# patterns and across shard-length buckets
+# ---------------------------------------------------------------------------
+
+
+def test_decode_byte_parity_all_patterns_and_buckets():
+    """Every C(k+m, k) present-set, at two block sizes landing in
+    different shard-length pad buckets, decoded through the staged jax
+    route in ONE batch — results byte-identical to decode_np +
+    join_stripe (pad rows and length padding sliced away)."""
+    k, m = 4, 2
+    codec = ErasureCodec(k, m, use_jax=False)
+    f = DeviceFeeder(codec=codec, mode="require", max_batch=256)
+    f._device_ok = True
+    rng = np.random.default_rng(13)
+    patterns = list(itertools.combinations(range(k + m), k))
+    assert len(patterns) == 15
+
+    async def go():
+        items, want = [], []
+        for blen in (3_000, 300_000):  # distinct bucket_len buckets
+            block = rng.integers(0, 256, blen, dtype=np.uint8).tobytes()
+            stripe = _stripe(codec, block)
+            for present in patterns:
+                shards = [stripe[i] for i in present]
+                items.append((present, shards, blen))
+                st = np.stack([np.frombuffer(s, dtype=np.uint8)
+                               for s in shards])
+                want.append(rs.join_stripe(
+                    rs.decode_np(k, m, present, st), blen))
+        batch = [_Item("decode", it, asyncio.get_running_loop()
+                       .create_future()) for it in items]
+        res = await f._run_batch_staged(batch)
+        for got, exp, it in zip(res, want, items):
+            assert not isinstance(got, BaseException), (it[0], got)
+            assert got == exp, f"pattern {it[0]} len {it[2]}"
+        assert f.stats["decode_device_items"] == len(items)
+        assert f.stats["pad_waste_bytes"] > 0
+        await f.stop()
+
+    run(go())
+
+
+def test_repair_byte_parity_mixed_missing_sizes():
+    """Repair through the staged route rebuilds the exact missing
+    shard bytes for 1- and 2-missing patterns in one batch (grouped by
+    output row count internally) — vs the repair_np reference."""
+    k, m = 4, 2
+    codec = ErasureCodec(k, m, use_jax=False)
+    f = DeviceFeeder(codec=codec, mode="require", max_batch=256)
+    f._device_ok = True
+    rng = np.random.default_rng(17)
+    block = rng.integers(0, 256, 65_000, dtype=np.uint8).tobytes()
+    stripe = _stripe(codec, block)
+    full = np.stack([np.frombuffer(s, dtype=np.uint8) for s in stripe])
+
+    items = []
+    for missing in [(0,), (3,), (5,), (0, 1), (2, 5), (4, 5)]:
+        present = tuple(i for i in range(k + m) if i not in missing)[:k]
+        items.append((present, missing, [stripe[i] for i in present]))
+
+    async def go():
+        batch = [_Item("repair", it, asyncio.get_running_loop()
+                       .create_future()) for it in items]
+        res = await f._run_batch_staged(batch)
+        for (present, missing, _s), got in zip(items, res):
+            assert not isinstance(got, BaseException), (missing, got)
+            assert sorted(got) == sorted(missing)
+            for mi in missing:
+                assert got[mi] == bytes(full[mi]), (present, missing, mi)
+        await f.stop()
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# recompile stability: the pattern is DATA, not a trace constant
+# ---------------------------------------------------------------------------
+
+
+def test_recompiles_flat_across_mixed_erasure_patterns():
+    """>= 8 distinct erasure patterns through the staged decode route,
+    one batch per pattern at identical shapes: feeder_recompiles moves
+    once for the first shape and NEVER again — and the per-pattern
+    constant-matrix jit cache (rs._jit_apply, the pre-ISSUE-13 leak)
+    gains no entries at all."""
+    k, m = 4, 2
+    codec = ErasureCodec(k, m, use_jax=False)
+    f = DeviceFeeder(codec=codec, mode="require", max_batch=16)
+    f._device_ok = True
+    rng = np.random.default_rng(23)
+    block = rng.integers(0, 256, 40_000, dtype=np.uint8).tobytes()
+    stripe = _stripe(codec, block)
+    patterns = list(itertools.combinations(range(k + m), k))[:9]
+    assert len(patterns) >= 8
+    leak_cache_before = rs._jit_apply.cache_info().currsize
+
+    async def go():
+        rc_after_first = None
+        for present in patterns:
+            shards = [stripe[i] for i in present]
+            batch = [_Item("decode", (present, shards, len(block)),
+                           asyncio.get_running_loop().create_future())
+                     for _ in range(4)]
+            res = await f._run_batch_staged(batch)
+            st = np.stack([np.frombuffer(s, dtype=np.uint8)
+                           for s in shards])
+            want = rs.join_stripe(rs.decode_np(k, m, present, st),
+                                  len(block))
+            assert all(r == want for r in res), present
+            if rc_after_first is None:
+                rc_after_first = f.stats["recompiles"]
+        assert f.stats["recompiles"] == rc_after_first, \
+            "a new erasure pattern caused a recompile"
+        assert f.stats["decode_device_items"] == 4 * len(patterns)
+        await f.stop()
+
+    run(go())
+    assert rs._jit_apply.cache_info().currsize == leak_cache_before, \
+        "per-pattern constant-matrix jit entries leaked"
+
+
+def test_rs_decode_repair_share_one_jit_across_patterns():
+    """The ops-level decode/repair entry points themselves no longer
+    grow a jit cache entry per pattern (the f"dec{k},{m},{present}"
+    keys): every pattern rides the single pattern-as-data kernel."""
+    k, m = 4, 2
+    rng = np.random.default_rng(29)
+    data = rng.integers(0, 256, (k, 64), dtype=np.uint8)
+    stripe = np.concatenate([data, np.asarray(rs.encode(k, m, data))])
+    before = rs._jit_apply.cache_info().currsize
+    for present in itertools.combinations(range(k + m), k):
+        got = np.asarray(rs.decode(k, m, present, stripe[list(present)]))
+        assert np.array_equal(got, data)
+    missing = (0, 5)
+    present = (1, 2, 3, 4)
+    got = np.asarray(rs.repair(k, m, present, missing,
+                               stripe[list(present)]))
+    assert np.array_equal(got, stripe[list(missing)])
+    assert rs._jit_apply.cache_info().currsize == before
+
+
+# ---------------------------------------------------------------------------
+# watchdog: depth-2 decode hang -> host re-run, every future resolves
+# ---------------------------------------------------------------------------
+
+
+def test_decode_hang_reruns_host_every_future_resolves(monkeypatch):
+    """Injected device hang with decode batches in flight at depth 2:
+    every caller gets the CORRECT packed bytes via the host re-run, no
+    future is lost, and the device path is disabled — the read-side
+    edition of the pipeline hang test."""
+    monkeypatch.delenv("GARAGE_TPU_DEVICE", raising=False)
+    k, m = 4, 2
+    codec = ErasureCodec(k, m, use_jax=False)
+    stub = StubDeviceBackend(None, fixed_s=0.01)
+    stub.hang_stage = "compute"
+    f = DeviceFeeder(codec=codec, mode="require", max_batch=2,
+                     backend=stub)
+    f._device_ok = True
+    f.batch_timeout = 1.0
+    rng = np.random.default_rng(31)
+    blocks = [rng.integers(0, 256, 20_000 + i, dtype=np.uint8).tobytes()
+              for i in range(4)]
+    present = (1, 2, 3, 4)  # degraded: shard 0 lost
+
+    async def go():
+        jobs = []
+        for b in blocks:
+            stripe = codec.encode(b)
+            jobs.append(f.decode(present, [stripe[i] for i in present],
+                                 len(b)))
+        outs = await asyncio.gather(*jobs)
+        dev_ok = f._device_ok
+        await f.stop()
+        return outs, dev_ok
+
+    outs, dev_ok = run(go())
+    for b, got in zip(blocks, outs):
+        st = np.stack([np.frombuffer(s, dtype=np.uint8)
+                       for s in codec.encode(b)])
+        want = rs.join_stripe(
+            rs.decode_np(k, m, present, st[list(present)]), len(b))
+        assert got == want
+    assert dev_ok is False
+    assert f.stats["decode_device_items"] == 0
+
+
+# ---------------------------------------------------------------------------
+# stub live gate: degraded GETs through a real cluster engage the
+# device decode route
+# ---------------------------------------------------------------------------
+
+
+def test_degraded_get_stub_live_gate(tmp_path, monkeypatch):
+    """GARAGE_TPU_DEVICE=require + stub backend on a real 6-node
+    erasure cluster: a degraded GET (one systematic shard destroyed)
+    must route its decode through the device path —
+    feeder_decode_device_items > 0, the CI shape of the read-side
+    engagement gate."""
+    from test_block import make_block_cluster, stop_all
+    from garage_tpu.utils.data import blake2sum
+
+    monkeypatch.setenv("GARAGE_TPU_DEVICE", "require")
+    monkeypatch.setenv("GARAGE_TPU_DEVICE_BACKEND", "stub")
+
+    async def main():
+        net, systems, managers, tasks = await make_block_cluster(
+            tmp_path, n=6, rf=3, erasure=(4, 2))
+        try:
+            data = os.urandom(200_000)
+            h = blake2sum(data)
+            await managers[0].rpc_put_block(h, data)
+            for _ in range(100):
+                held = sorted(i for mg in managers
+                              for i in mg.local_parts(h))
+                if held == [0, 1, 2, 3, 4, 5]:
+                    break
+                await asyncio.sleep(0.02)
+            # destroy a systematic shard so the GET really decodes
+            victim = next(mg for mg in managers
+                          if 0 in mg.local_parts(h))
+            victim.delete_local(h)
+            reader = managers[1]
+            reader.cache.clear()
+            got = await reader.rpc_get_block(h, cacheable=False)
+            assert got == data
+            fs = reader.feeder.stats
+            assert fs["decode_items"] >= 1
+            assert fs["decode_device_items"] >= 1, fs
+        finally:
+            await stop_all(systems, tasks)
+
+    run(asyncio.wait_for(main(), 120))
+
+
+# ---------------------------------------------------------------------------
+# deep-scrub gather fan-out is windowed
+# ---------------------------------------------------------------------------
+
+
+def test_deep_scrub_gather_window_bounded():
+    """gather_bounded keeps at most `window` stripe gathers in flight
+    (repair.py:258 used to fan out the whole leader set at once) and
+    returns results in item order."""
+    from garage_tpu.block.repair import gather_bounded
+
+    live = 0
+    peak = 0
+
+    async def fake_gather(h, placement):
+        nonlocal live, peak
+        live += 1
+        peak = max(peak, live)
+        await asyncio.sleep(0.01)
+        live -= 1
+        return (h, placement)
+
+    items = [(i, f"p{i}") for i in range(23)]
+
+    async def go():
+        return await gather_bounded(fake_gather, items, 4)
+
+    out = run(go())
+    assert out == items  # order preserved
+    assert peak <= 4, f"window exceeded: {peak}"
+    assert peak >= 2  # it did actually run concurrently
+
+
+# ---------------------------------------------------------------------------
+# knobs: [tpu] decode floors flow into the feeder + admin tuning
+# ---------------------------------------------------------------------------
+
+
+def test_decode_knobs_flow_into_feeder_and_tuning():
+    from types import SimpleNamespace
+
+    from garage_tpu.admin.http import apply_s3_tuning, s3_tuning_state
+    from garage_tpu.block.cache import BlockCache
+    from garage_tpu.block import feeder as fmod
+    from garage_tpu.utils.config import Config, config_from_dict
+
+    cfg = config_from_dict({
+        "metadata_dir": "/tmp/x",
+        "tpu": {"device_min_decode_bytes": 2048,
+                "device_min_decode_items": 3},
+    })
+    f = DeviceFeeder(mode="off", tpu_cfg=cfg.tpu)
+    assert f.device_min_decode_bytes == 2048
+    assert f.device_min_decode_items == 3
+    # None leaves the module defaults in force
+    f2 = DeviceFeeder(mode="off")
+    assert f2.device_min_decode_bytes == fmod._DEVICE_MIN_DECODE_BYTES
+    assert f2.device_min_decode_items == fmod._DEVICE_MIN_DECODE_ITEMS
+
+    feeder = DeviceFeeder(mode="off")
+    garage = SimpleNamespace(
+        config=Config(metadata_dir="/tmp/x"),
+        block_manager=SimpleNamespace(cache=BlockCache(1 << 20),
+                                      feeder=feeder))
+    state = apply_s3_tuning(garage, {
+        "feeder_device_min_decode_bytes": 1 << 21,
+        "feeder_device_min_decode_items": 7})
+    assert feeder.device_min_decode_bytes == 1 << 21
+    assert feeder.device_min_decode_items == 7
+    assert state["feeder_device_min_decode_items"] == 7
+    assert s3_tuning_state(garage)["feeder_device_min_decode_bytes"] \
+        == 1 << 21
+
+
+def test_decode_routing_floor_keeps_lone_small_decode_on_host():
+    """A single small decode below both [tpu] device_min_decode_*
+    floors must not pay a device trip even when the device is healthy
+    (auto mode, device winning on calibration data)."""
+    k, m = 4, 2
+    codec = ErasureCodec(k, m, use_jax=False)
+    stub = StubDeviceBackend(None, fixed_s=0.0)
+    f = DeviceFeeder(codec=codec, mode="auto", max_batch=8, backend=stub)
+    f._device_ok = True
+    f._record("decode", "device", 1 << 30, 1.0)  # device hugely winning
+    f._record("decode", "host", 1 << 20, 1.0)
+    backend, trial = f._pick_backend("decode", 4096, 1)
+    assert backend == "host" and trial is False
+    # a coalesced wave above the item floor goes device
+    backend, _ = f._pick_backend(
+        "decode", 4096 * f.device_min_decode_items,
+        f.device_min_decode_items)
+    assert backend == "device"
+
+
+def test_malformed_decode_item_fails_its_caller_only():
+    """Unequal shard lengths are rejected BEFORE the queue: the bad
+    caller gets ValueError, batch-mates are unaffected (an in-batch
+    failure would poison the whole op group)."""
+    k, m = 4, 2
+    codec = ErasureCodec(k, m, use_jax=False)
+    f = DeviceFeeder(codec=codec, mode="off", max_batch=8)
+
+    async def go():
+        block = os.urandom(10_000)
+        stripe = codec.encode(block)
+        present = (1, 2, 3, 4)
+        bad_shards = [stripe[1], stripe[2][:100], stripe[3], stripe[4]]
+        with pytest.raises(ValueError):
+            await f.decode(present, bad_shards, len(block))
+        good = await f.decode(present,
+                              [stripe[i] for i in present], len(block))
+        st = np.stack([np.frombuffer(stripe[i], dtype=np.uint8)
+                       for i in present])
+        assert good == rs.join_stripe(
+            rs.decode_np(k, m, present, st), len(block))
+        await f.stop()
+
+    run(go())
