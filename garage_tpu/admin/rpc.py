@@ -323,9 +323,11 @@ class AdminRpcHandler:
 
     async def op_block_list_errors(self, p):
         res = self.garage.block_manager.resync
+        # iter_errors scans the resync error tree (GL10)
+        errors = await asyncio.to_thread(lambda: list(res.iter_errors()))
         return {"errors": [
             {"hash": h.hex(), "failures": count, "next_try_ms": next_ms}
-            for h, count, next_ms in res.iter_errors()
+            for h, count, next_ms in errors
         ]}
 
     async def op_block_info(self, p):

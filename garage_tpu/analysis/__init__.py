@@ -29,16 +29,32 @@ Rules (each encodes an invariant an earlier PR established by hand):
   GL10 blocking-reachable-from-async
                                 a sync helper that blocks, called
                                 transitively from an async def with no
-                                to_thread hop (reports the full chain)
+                                to_thread hop (reports the full chain);
+                                since ISSUE 14 the db seam is the
+                                @blocking_api annotation where the
+                                call resolves, and iterating an
+                                in-project generator counts
   GL11 leaked-budget-on-exception
                                 qos token/lease/semaphore acquire whose
-                                refund/release is not on every exit path
+                                refund/release is not on every exit
+                                path — cross-function since ISSUE 14
+                                (acquire here / release in a callee
+                                settles through the call graph)
+  GL12 await-interleaving-atomicity
+                                read -> await -> write on the same
+                                shared lvalue with no lock across the
+                                await (check-then-act race; ISSUE 14)
+  GL13 lock-order-inversion     lock-acquisition cycles across the
+                                global graph — the ABBA deadlock, both
+                                chains reported (ISSUE 14)
   GL00 (framework)              stale waivers, stale baseline entries,
                                 unparseable files — cannot be waived
 
-GL02/GL03/GL10/GL11 run on the two-pass interprocedural engine
+GL02/GL03/GL10-GL13 run on the two-pass interprocedural engine
 (dataflow.py summaries + callgraph.py resolution — see README "How
-dataflow resolution works").
+dataflow resolution works"). The runtime half is
+utils/sanitizer.py (GARAGE_SANITIZE=1): loop-stall detection +
+teardown leak/conservation checks wired into tests/conftest.py.
 
 Waive a deliberate site inline, with a reason (checked for staleness):
 
@@ -55,6 +71,8 @@ from .dataflow import (DataflowState, summarize_tree, summary_fingerprint,
                        summary_json)
 from .rules_async import (AwaitHoldingLock, BlockingCallInAsync,
                           OrphanTask, SwallowedException)
+from .rules_concurrency import (AwaitInterleavingAtomicity,
+                                LockOrderInversion)
 from .rules_dataflow import (BlockingReachableFromAsync,
                              LeakedBudgetOnException)
 from .rules_project import (ConfigKnobDrift, CrossWorkerState,
@@ -74,6 +92,8 @@ RULE_CLASSES = [
     CrossWorkerState,           # GL09
     BlockingReachableFromAsync,  # GL10
     LeakedBudgetOnException,    # GL11
+    AwaitInterleavingAtomicity,  # GL12
+    LockOrderInversion,         # GL13
 ]
 
 

@@ -43,10 +43,21 @@ class CallGraph:
         # function id -> list of (callee id, call record)
         self._edges: dict[str, list[tuple[str, dict]]] = {}
 
+        # functions that are blocking-by-annotation: @blocking_api on
+        # the def, or `blocking_api = True` on the enclosing class —
+        # the exact replacement for GL10's db-receiver-name heuristic
+        # wherever the call resolves in-project (ISSUE 14 satellite)
+        self._annotated: set[str] = set()
+
         for fs in file_summaries.values():
             self.modules[fs["module"]] = fs
             for qn, fn in fs["functions"].items():
-                self.functions[f"{fs['module']}:{qn}"] = fn
+                fid = f"{fs['module']}:{qn}"
+                self.functions[fid] = fn
+                cls = fs["classes"].get(fn.get("class") or "")
+                if fn.get("blocking_api") \
+                        or (cls is not None and cls.get("blocking_api")):
+                    self._annotated.add(fid)
         for fs in file_summaries.values():
             for cname, cls in fs["classes"].items():
                 for m, mq in cls["methods"].items():
@@ -181,6 +192,49 @@ class CallGraph:
     def edges_from(self, fid: str) -> list[tuple[str, dict]]:
         return self._edges.get(fid, [])
 
+    def resolve_ref(self, caller_id: str, ref: list) -> Optional[str]:
+        """Resolve a bare call ref (no full record) to a function id
+        known to the graph, or None."""
+        callee = self.resolve(caller_id, {"ref": ref})
+        return callee if callee in self.functions else None
+
+    def is_blocking_api(self, fid: Optional[str]) -> bool:
+        return fid in self._annotated
+
+    def atoms_of(self, fid: str):
+        """The function's EFFECTIVE blocking atoms (ISSUE 14):
+
+          * hard-I/O atoms unchanged;
+          * heuristic db atoms (db-named receiver + db-verb method)
+            kept only when the call does NOT resolve to an in-project
+            function, or resolves to a @blocking_api one — the
+            annotation is authoritative wherever it can speak, the
+            name heuristic covers out-of-tree callables;
+          * calls (non-awaited, non-thread-hop) resolving to a
+            @blocking_api function become atoms even where the
+            receiver name never matched the heuristic.
+        """
+        fn = self.functions.get(fid)
+        if fn is None:
+            return
+        seen_lines = set()
+        for atom in fn["blocking"]:
+            if atom["kind"] != "db":
+                yield atom
+                continue
+            ref = atom.get("ref")
+            callee = self.resolve_ref(fid, ref) if ref else None
+            if callee is None or callee in self._annotated:
+                seen_lines.add(atom["line"])
+                yield atom
+        for callee, rec in self.edges_from(fid):
+            if callee in self._annotated and not rec["awaited"] \
+                    and not rec["via_thread"] \
+                    and rec["line"] not in seen_lines:
+                target = self.functions[callee]
+                yield {"target": target["qualname"],
+                       "line": rec["line"], "kind": "api"}
+
     def bound_call(self, caller_id: str, rec: dict) -> bool:
         """True when the call binds its receiver as `self` — positional
         arguments then land one parameter later. self/attr refs are
@@ -202,8 +256,13 @@ class CallGraph:
         """Chains [ (callee id, call record)..., blocking atom ] from
         `fid` through SYNC project frames to a blocking atom, skipping
         thread-hop edges, async callees (their own rule's business) and
-        generators (calling one runs nothing). Cycle-tolerant: a
-        function is expanded at most once per query."""
+        generators — EXCEPT a generator reached by ITERATION (`for x
+        in gen(...)` / `async for`): iterating runs the body on this
+        frame, so its atoms count, reported at the iteration site
+        (ISSUE 14 satellite; plain calls stay exempt). @blocking_api
+        callees are atoms themselves (atoms_of) and are not expanded.
+        Cycle-tolerant: a function is expanded at most once per
+        query."""
         visited = {fid}
         stack: list[tuple[str, list]] = [(fid, [])]
         while stack:
@@ -215,11 +274,16 @@ class CallGraph:
                 if callee in visited:
                     continue
                 target = self.functions[callee]
-                if target["is_async"] or target["is_generator"]:
+                iterated_gen = target["is_generator"] \
+                    and rec.get("iterated")
+                if (target["is_async"] or target["is_generator"]) \
+                        and not iterated_gen:
                     continue
+                if callee in self._annotated:
+                    continue  # the CALL is the atom (atoms_of)
                 visited.add(callee)
                 new_path = path + [(callee, rec)]
-                for atom in target["blocking"]:
+                for atom in self.atoms_of(callee):
                     yield new_path + [atom]
                 if len(new_path) < max_depth:
                     stack.append((callee, new_path))

@@ -67,6 +67,24 @@ DECRYPT_RE = r"(^|_)(decrypt|unseal)"
 ACQUIRE_METHODS = {"acquire", "try_acquire"}
 RELEASE_METHODS = {"release", "refund", "give_back", "revoke"}
 
+# mutating container/method calls on a tracked shared lvalue count as
+# WRITES for the GL12 interleaving analysis (the receiver read they
+# imply is part of the atomic mutation, not a separate stale check, so
+# no read event is emitted for it)
+MUT_METHODS = {"add", "append", "appendleft", "clear", "discard",
+               "extend", "insert", "pop", "popleft", "popitem",
+               "remove", "setdefault", "update"}
+# accretive subset: these operate on the LIVE state at mutation time
+# (append/add/insert can't clobber a concurrent task's entry,
+# setdefault is itself an atomic re-check), so they count as a
+# re-validating read immediately before their write — a stale
+# pre-await check cannot make them lose another task's update
+ACCRETIVE_METHODS = {"add", "append", "appendleft", "extend", "insert",
+                     "setdefault"}
+
+# identifier segment that marks a context-manager expression as a lock
+LOCK_SEG = "lock"
+
 import re as _re
 
 _DB_RECEIVER = _re.compile(DB_RECEIVER_RE)
@@ -76,7 +94,10 @@ _DECRYPT = _re.compile(DECRYPT_RE, _re.IGNORECASE)
 
 # bump on ANY change to the summary schema or extraction semantics —
 # cached entries from other versions are recomputed, not trusted
-SUMMARY_VERSION = 2
+# (v3: ISSUE 14 — exit-path contexts on call/acquire/release records,
+# shared-state access events, lock-acquisition facts, generator-
+# iteration flags, blocking_api annotations)
+SUMMARY_VERSION = 3
 
 
 def module_name_of(rel_path: str) -> str:
@@ -119,12 +140,14 @@ class _FunctionCollector:
     their own collector; we do not descend into them here)."""
 
     def __init__(self, node: ast.AST, qualname: str, cls: Optional[str],
-                 parent: Optional[str], strategies: dict):
+                 parent: Optional[str], strategies: dict,
+                 module_state: Optional[set] = None):
         self.node = node
         self.qualname = qualname
         self.cls = cls
         self.parent = parent
         self.local_strategies = strategies  # name -> hedge pin (or None)
+        self.module_state = module_state or set()
         self.params: list[str] = []
         self.calls: list[dict] = []
         self.blocking: list[dict] = []
@@ -138,6 +161,17 @@ class _FunctionCollector:
         self.sse_locals: set[str] = set()
         self._lock_stack: list[str] = []
         self._with_items: set[int] = set()  # id() of calls in with-items
+        self._try_ctx: list[str] = []       # "except"/"finally" frames
+        self._iter_calls: set[int] = set()  # id() of for/async-for iters
+        self.blocking_api = False           # @blocking_api-decorated
+        # concurrency facts (own ordered walk, _collect_concurrency):
+        # accesses = source-order events over shared lvalues in THIS
+        # frame ("r" read / "w" write / "a" await / "c" call);
+        # lock_acqs = every lock acquisition with the locks already held
+        self.accesses: list[dict] = []
+        self.lock_acqs: list[dict] = []
+        self._cw_locks: list[str] = []
+        self._cw_terminal = 0  # inside a return/raise expression
 
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             a = node.args
@@ -189,8 +223,12 @@ class _FunctionCollector:
             self.awaits_under_lock.clear()
             self._lock_stack.clear()
             self._with_items.clear()
+            self._try_ctx.clear()
+            self._iter_calls.clear()
             for child in body:
                 self._visit(child, awaited=False)
+        self._mark_return_calls()
+        self._collect_concurrency()
 
     def _visit(self, node: ast.AST, awaited: bool) -> None:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
@@ -203,6 +241,27 @@ class _FunctionCollector:
             for sub in ast.walk(node.value):
                 if isinstance(sub, ast.Name):
                     self.escaped.add(sub.id)
+        if isinstance(node, ast.Try):
+            # exit-path contexts: releases/calls inside an except
+            # handler or a finally: block carry a "ctx" marker so the
+            # (now cross-function) GL11 logic can classify them from
+            # the summary alone
+            for st in node.body:
+                self._visit(st, awaited=False)
+            for h in node.handlers:
+                if h.type is not None:
+                    self._visit(h.type, awaited=False)
+                self._try_ctx.append("except")
+                for st in h.body:
+                    self._visit(st, awaited=False)
+                self._try_ctx.pop()
+            for st in node.orelse:
+                self._visit(st, awaited=False)
+            self._try_ctx.append("finally")
+            for st in node.finalbody:
+                self._visit(st, awaited=False)
+            self._try_ctx.pop()
+            return
         if isinstance(node, ast.Assign):
             labels = self._expr_taint(node.value)
             sse_expr = any(lb in self.sse_locals or lb == "<decrypt>"
@@ -219,6 +278,10 @@ class _FunctionCollector:
             self._bind(node.target, self._expr_taint(node.value), False)
         elif isinstance(node, (ast.For, ast.AsyncFor)):
             self._bind(node.target, self._expr_taint(node.iter), False)
+            if isinstance(node.iter, ast.Call):
+                # `for x in gen(...)` — iterating a generator RUNS its
+                # body on this frame (GL10's generator blindness)
+                self._iter_calls.add(id(node.iter))
         elif isinstance(node, (ast.With, ast.AsyncWith)):
             lockish = None
             for item in node.items:
@@ -285,6 +348,8 @@ class _FunctionCollector:
                         "awaited": False, "name": inner[-1],
                         "recv": [], "kwargs": [], "args": [], "kw": {},
                         "ops": [],
+                        "ctx": self._try_ctx[-1] if self._try_ctx
+                               else "",
                     })
 
         if ref is None:
@@ -306,8 +371,11 @@ class _FunctionCollector:
                    if k.arg is not None
                    and self._arg_desc(k.value) is not None},
             "ops": _payload_ops(node),
+            "ctx": self._try_ctx[-1] if self._try_ctx else "",
         }
         rec["kw"] = {k: v for k, v in rec["kw"].items() if v}
+        if id(node) in self._iter_calls:
+            rec["iterated"] = True
         self.calls.append(rec)
 
         # blocking atoms (non-awaited only: an awaited call is a
@@ -319,9 +387,12 @@ class _FunctionCollector:
                     {"target": dn, "line": node.lineno, "kind": "io"})
             elif name in DB_METHODS and recv \
                     and _DB_RECEIVER.search(recv[-1]):
+                # "ref" lets pass 2 override the receiver-name
+                # heuristic with the @blocking_api annotation when the
+                # call resolves to an in-project function
                 self.blocking.append(
                     {"target": ".".join(segs), "line": node.lineno,
-                     "kind": "db"})
+                     "kind": "db", "ref": ref})
 
         # resource discipline facts
         if name in ACQUIRE_METHODS and recv:
@@ -329,10 +400,14 @@ class _FunctionCollector:
                 "line": node.lineno, "recv": recv[-1],
                 "method": name, "awaited": awaited,
                 "in_with": id(node) in self._with_items,
+                "names": sorted(self._acq_names(
+                    {"line": node.lineno, "method": name})),
+                "ctx": self._try_ctx[-1] if self._try_ctx else "",
             })
         elif name in RELEASE_METHODS and recv:
             self.releases.append({
-                "line": node.lineno, "recv": recv[-1], "method": name})
+                "line": node.lineno, "recv": recv[-1], "method": name,
+                "ctx": self._try_ctx[-1] if self._try_ctx else ""})
 
     def _arg_desc(self, expr: ast.AST) -> Optional[dict]:
         out: dict = {}
@@ -357,6 +432,9 @@ class _FunctionCollector:
                         hedge = bool(k.value.value)
                 out["s"] = {"k": "inline", "hedge": hedge}
         elif isinstance(expr, ast.Name):
+            # the bare name itself (GL11v2 matches it against callee
+            # release facts to see a resource released one frame down)
+            out["n"] = expr.id
             if expr.id in self.local_strategies:
                 out["s"] = {"k": "local",
                             "hedge": self.local_strategies[expr.id]}
@@ -364,47 +442,256 @@ class _FunctionCollector:
                 out["s"] = {"k": "param", "name": expr.id}
         return out or None
 
-    # -- GL11: refund-on-every-exit-path ---------------------------------
+    def _mark_return_calls(self) -> None:
+        """Post-pass annotations that need whole-body context: which
+        call records sit inside a `return` expression ("in_ret") and
+        which names each call's result was bound to ("bound")."""
+        ret_calls: set[tuple] = set()
+        for r in self.returns_exprs:
+            for sub in ast.walk(r):
+                if isinstance(sub, ast.Call):
+                    cs = chain_segments(sub.func)
+                    if cs:
+                        ret_calls.add((sub.lineno, cs[-1]))
+        bound: dict[tuple, list] = {}
+        for sub in ast.walk(self.node):
+            if not isinstance(sub, ast.Assign):
+                continue
+            v = sub.value
+            if isinstance(v, ast.Await):
+                v = v.value
+            if not isinstance(v, ast.Call):
+                continue
+            cs = chain_segments(v.func)
+            if not cs:
+                continue
+            names = sorted(t.id for t in sub.targets
+                           if isinstance(t, ast.Name))
+            if names:
+                bound[(v.lineno, cs[-1])] = names
+        for rec in self.calls:
+            key = (rec["line"], rec["name"])
+            if key in ret_calls:
+                rec["in_ret"] = True
+            if key in bound:
+                rec["bound"] = bound[key]
 
-    def leak_findings(self) -> list[dict]:
-        """Acquire/release pairs where the release is NOT structurally
-        exception-safe: a matching release exists on the fall-through
-        path, there is raise-capable work between acquire and release,
-        and no enclosing try protects the span with a finally- or
-        handler-release. Acquires with no release at all are NOT
-        flagged (plain token-bucket admission consumes tokens by
-        design), nor are acquires whose result/receiver escapes
-        (ownership transferred to a caller or object)."""
-        if not self.acquires or not self.releases:
-            return []
-        finally_rel, handler_rel = self._guarded_release_lines()
-        out = []
-        for acq in self.acquires:
-            if acq["in_with"]:
-                continue
-            match_names = {acq["recv"]} | self._acq_names(acq)
-            rels = [r for r in self.releases if r["recv"] in match_names]
-            if not rels:
-                continue
-            if any(r["line"] in finally_rel for r in rels):
-                continue  # try/finally: exception-safe by construction
-            plain = [r for r in rels if r["line"] not in handler_rel]
-            if not plain:
-                continue  # refund-on-failure idiom (except: refund; raise)
-            after = [r for r in plain if r["line"] > acq["line"]]
-            if not after:
-                continue
-            rel = min(after, key=lambda r: r["line"])
-            risky = self._risky_between(acq["line"], rel["line"])
-            if risky is None:
-                continue
-            out.append({
-                "line": acq["line"],
-                "recv": acq["recv"],
-                "release_line": rel["line"],
-                "risky_line": risky,
-            })
-        return out
+    # -- concurrency facts (GL12 / GL13) ---------------------------------
+
+    def _lvalue_of(self, expr: ast.AST) -> Optional[list]:
+        """Shared-state lvalue behind an expression: `self.X` (and any
+        subscript of it) -> ["self", X]; a module-state name ->
+        ["mod", name]. Local names and params are not shared state."""
+        e = expr
+        while isinstance(e, ast.Subscript):
+            e = e.value
+        if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name) \
+                and e.value.id in ("self", "cls"):
+            return ["self", e.attr]
+        if isinstance(e, ast.Name) and e.id in self.module_state:
+            return ["mod", e.id]
+        return None
+
+    def _collect_concurrency(self) -> None:
+        """One extra source-order walk collecting the facts the GL12
+        (await-interleaving) and GL13 (lock-order) rules consume:
+
+          * `accesses`: ordered events over shared lvalues — "r" read,
+            "w" write (assignment, augmented assignment, del, or a
+            mutating container method), "a" await (with the locks held
+            and the awaited call's ref), "c" project call (so a write
+            performed by a self-call lands at the call line);
+          * `lock_acqs`: every lock acquisition (`with`/`async with` on
+            a lock-named expression, or a bare `.acquire()` on one)
+            with the locks already held at that point.
+
+        The walk linearizes control flow by source order — good enough
+        for lint — with one refinement: a `while` loop's test is
+        re-emitted after its body, so the guard-loop idiom (await
+        inside the loop, condition re-checked before falling through)
+        does not read as a stale check."""
+        for child in ast.iter_child_nodes(self.node):
+            if not isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                self._cw_visit(child)
+
+    def _cw_emit(self, kind: str, line: int, lv=None, **extra) -> None:
+        ev = {"k": kind, "line": line}
+        if lv is not None:
+            ev["lv"] = lv
+        ev.update(extra)
+        self.accesses.append(ev)
+
+    def _cw_visit(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            # EVERY lock-ish item counts, in order: `with a, b:` is an
+            # a -> b acquisition edge (items after the first are taken
+            # while the earlier ones are held)
+            pushed = 0
+            for item in node.items:
+                self._cw_visit(item.context_expr)
+                segs = chain_segments(item.context_expr)
+                if any(LOCK_SEG in s.lower() for s in segs):
+                    lock = ".".join(s for s in segs
+                                    if s not in ("acquire",))
+                    self.lock_acqs.append({
+                        "lock": lock, "line": node.lineno,
+                        "held": list(self._cw_locks),
+                        "sync": isinstance(node, ast.With)})
+                    self._cw_locks.append(lock)
+                    pushed += 1
+            for st in node.body:
+                self._cw_visit(st)
+            for _ in range(pushed):
+                self._cw_locks.pop()
+            return
+        if isinstance(node, ast.While):
+            self._cw_visit(node.test)
+            for st in node.body:
+                self._cw_visit(st)
+            self._cw_visit(node.test)  # re-evaluated before exit
+            for st in node.orelse:
+                self._cw_visit(st)
+            return
+        if isinstance(node, ast.Assign):
+            self._cw_visit(node.value)
+            # a bare True/False store is idempotent-convergent (every
+            # racing task writes the same terminal flag value) — GL12
+            # records but does not fire on it
+            const_flag = isinstance(node.value, ast.Constant) \
+                and node.value.value in (True, False)
+            for t in node.targets:
+                lv = self._lvalue_of(t)
+                if lv is not None:
+                    if const_flag and not isinstance(t, ast.Subscript):
+                        self._cw_emit("w", t.lineno, lv, flag=True)
+                    else:
+                        self._cw_emit("w", t.lineno, lv)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    for el in t.elts:
+                        elv = self._lvalue_of(el)
+                        if elv is not None:
+                            self._cw_emit("w", el.lineno, elv)
+                if isinstance(t, ast.Subscript):
+                    self._cw_visit(t.slice)
+            return
+        if isinstance(node, ast.AugAssign):
+            # read-modify-write: the read precedes the value (CPython
+            # loads the target before evaluating the RHS), so an await
+            # INSIDE the value still races; but as a callee's write it
+            # is accretive (it re-reads at mutation time)
+            lv = self._lvalue_of(node.target)
+            if lv is not None:
+                self._cw_emit("r", node.lineno, lv)
+            self._cw_visit(node.value)
+            if lv is not None:
+                self._cw_emit("w", node.lineno, lv, acc=True)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                lv = self._lvalue_of(t)
+                if lv is not None:
+                    self._cw_emit("w", t.lineno, lv)
+                if isinstance(t, ast.Subscript):
+                    self._cw_visit(t.slice)
+            return
+        if isinstance(node, (ast.Return, ast.Raise)):
+            # control leaves this frame: an await here cannot precede
+            # a later write in THIS frame, and the awaited callee's
+            # writes land after the caller is done deciding
+            self._cw_terminal += 1
+            for child in ast.iter_child_nodes(node):
+                self._cw_visit(child)
+            self._cw_terminal -= 1
+            if isinstance(node, ast.Return):
+                # barrier: any flow that crossed an earlier await ends
+                # here, so a textually-later write belongs to a branch
+                # that never awaited (`if batch: await ...; return` /
+                # `else: write`) — raise is NOT a barrier (exceptional
+                # guards between await and write still race)
+                self._cw_emit("x", node.lineno, None)
+            return
+        if isinstance(node, ast.Await):
+            ref = None
+            if isinstance(node.value, ast.Call):
+                # the "a" event carries the ref itself — no separate
+                # "c" event, which would wrongly count the awaited
+                # callee's writes as landing BEFORE the preemption
+                ref = _call_ref(node.value.func)
+                self._cw_call(node.value, emit_call=False)
+            else:
+                self._cw_visit(node.value)
+            self._cw_emit("a", node.lineno, None,
+                          locks=list(self._cw_locks), call=ref,
+                          **({"ret": True} if self._cw_terminal else {}))
+            return
+        if isinstance(node, ast.Call):
+            self._cw_call(node)
+            return
+        if isinstance(node, ast.Attribute):
+            lv = self._lvalue_of(node)
+            if lv is not None:
+                self._cw_emit("r", node.lineno, lv)
+                return
+        if isinstance(node, ast.Name):
+            lv = self._lvalue_of(node)
+            if lv is not None:
+                self._cw_emit("r", node.lineno, lv)
+            return
+        if isinstance(node, ast.Subscript):
+            lv = self._lvalue_of(node)
+            if lv is not None:
+                self._cw_emit("r", node.lineno, lv)
+                self._cw_visit(node.slice)
+                return
+        for child in ast.iter_child_nodes(node):
+            self._cw_visit(child)
+
+    def _cw_call(self, node: ast.Call, emit_call: bool = True) -> None:
+        segs = chain_segments(node.func)
+        name = segs[-1] if segs else ""
+        recv_lv = None
+        if isinstance(node.func, ast.Attribute):
+            recv_lv = self._lvalue_of(node.func.value)
+            # receiver chain below the method name still carries reads
+            # (`self.peers[p].ring.push(x)` reads self.peers) — but a
+            # mutating method ON a tracked lvalue is one atomic write,
+            # not a stale read followed by a write
+            if recv_lv is None or name not in MUT_METHODS:
+                self._cw_visit(node.func.value)
+        for a in node.args:
+            self._cw_visit(a)
+        for k in node.keywords:
+            self._cw_visit(k.value)
+        db_recv = bool(segs[:-1]) and bool(
+            _DB_RECEIVER.search(segs[-2]))
+        if recv_lv is not None and name in MUT_METHODS and not db_recv:
+            if name in ACCRETIVE_METHODS:
+                # re-validating read at mutation time (see above)
+                self._cw_emit("r", node.lineno, recv_lv)
+                self._cw_emit("w", node.lineno, recv_lv, acc=True)
+            else:
+                self._cw_emit("w", node.lineno, recv_lv)
+        if name == "acquire" and segs[:-1] \
+                and any(LOCK_SEG in s.lower() for s in segs[:-1]):
+            self.lock_acqs.append({
+                "lock": ".".join(segs[:-1]), "line": node.lineno,
+                "held": list(self._cw_locks), "sync": False})
+        if not emit_call:
+            return
+        ref = _call_ref(node.func)
+        if ref is not None and name not in MUT_METHODS \
+                and (ref[0] in ("self", "name") or self._cw_locks):
+            self._cw_emit("c", node.lineno, None, call=ref,
+                          held=list(self._cw_locks))
+
+    # -- GL11 support facts ----------------------------------------------
+    # (the leak DECISION moved to pass 2 in ISSUE 14 so acquire/release
+    # facts can settle across call-graph edges; the collector only
+    # records the structural facts the rule consumes)
 
     def _acq_names(self, acq: dict) -> set:
         """Names the acquired value was bound to (release via the
@@ -422,35 +709,6 @@ class _FunctionCollector:
                                 names.add(t.id)
         return names
 
-    def _guarded_release_lines(self) -> tuple[set, set]:
-        """(linenos of release calls inside `finally:` blocks, linenos
-        of release calls inside except handlers)."""
-        finally_rel: set = set()
-        handler_rel: set = set()
-        for sub in ast.walk(self.node):
-            if not isinstance(sub, ast.Try):
-                continue
-            for st in sub.finalbody:
-                for c in ast.walk(st):
-                    if isinstance(c, ast.Call):
-                        cs = chain_segments(c.func)
-                        if cs and cs[-1] in RELEASE_METHODS:
-                            finally_rel.add(c.lineno)
-            for h in sub.handlers:
-                for st in h.body:
-                    for c in ast.walk(st):
-                        if isinstance(c, ast.Call):
-                            cs = chain_segments(c.func)
-                            if cs and cs[-1] in RELEASE_METHODS:
-                                handler_rel.add(c.lineno)
-        return finally_rel, handler_rel
-
-    def _risky_between(self, lo: int, hi: int) -> Optional[int]:
-        for rec in self.calls:
-            if lo < rec["line"] < hi and rec["name"] not in RELEASE_METHODS:
-                return rec["line"]
-        return None
-
     # -- output -----------------------------------------------------------
 
     def summary(self, path: str, module: str, nested: dict) -> dict:
@@ -459,6 +717,9 @@ class _FunctionCollector:
         param_return = sorted(
             set().union(*[self._expr_taint(r) for r in self.returns_exprs])
             & set(self.params)) if self.returns_exprs else []
+        ret_names = sorted({sub.id for r in self.returns_exprs
+                            for sub in ast.walk(r)
+                            if isinstance(sub, ast.Name)})
         return {
             "name": name,
             "qualname": self.qualname,
@@ -475,14 +736,17 @@ class _FunctionCollector:
             "mutation_name": bool(MUTATION_NAME_RE.search(name)),
             "sse_sources": sorted(self.sse_locals),
             "param_return": param_return,
+            "ret_names": ret_names,
             "escaped": sorted(self.escaped),
             "blocking": sorted(self.blocking,
                                key=lambda b: (b["line"], b["target"])),
+            "blocking_api": self.blocking_api,
             "calls": self.calls,
             "acquires": self.acquires,
             "releases": self.releases,
             "awaits_under_lock": self.awaits_under_lock,
-            "leaks": self.leak_findings(),
+            "accesses": self.accesses,
+            "lock_acqs": self.lock_acqs,
             "nested": {k: nested[k] for k in sorted(nested)},
         }
 
@@ -506,10 +770,65 @@ def _local_strategy_pins(fn: ast.AST) -> dict:
     return out
 
 
+_MUTABLE_INITS = (ast.Dict, ast.List, ast.Set, ast.Call,
+                  ast.DictComp, ast.ListComp, ast.SetComp)
+
+
+def _top_level_state(tree: ast.Module) -> set:
+    """Module-level names bound to mutable-looking values (dict/list/
+    set/call/comprehension) — the shared-state census GL09 pioneered,
+    reused here so GL12 can track module-global lvalues. Restricted to
+    true module scope (no descending into defs/classes)."""
+    out: set = set()
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(child, ast.Assign) \
+                    and isinstance(child.value, _MUTABLE_INITS):
+                for t in child.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+            elif isinstance(child, ast.AnnAssign) \
+                    and child.value is not None \
+                    and isinstance(child.value, _MUTABLE_INITS) \
+                    and isinstance(child.target, ast.Name):
+                out.add(child.target.id)
+            else:
+                walk(child)
+
+    walk(tree)
+    return out
+
+
+def _has_blocking_api_marker(node) -> bool:
+    """`@blocking_api` decorator on a def, or a truthy `blocking_api =
+    True` class attribute (checked by the caller for ClassDef)."""
+    for dec in getattr(node, "decorator_list", []):
+        segs = chain_segments(dec)
+        if segs and segs[-1] == "blocking_api":
+            return True
+    return False
+
+
+def _class_blocking_api(node: ast.ClassDef) -> bool:
+    for child in node.body:
+        if isinstance(child, ast.Assign):
+            for t in child.targets:
+                if isinstance(t, ast.Name) and t.id == "blocking_api" \
+                        and isinstance(child.value, ast.Constant) \
+                        and bool(child.value.value):
+                    return True
+    return False
+
+
 def summarize_tree(tree: ast.Module, rel_path: str) -> dict:
     """The whole pass-1 product for one file: module facts (imports,
     classes) + per-function summaries. Pure function of the AST."""
     module = module_name_of(rel_path)
+    module_state = _top_level_state(tree)
     # a package __init__ IS its package: `from .core import x` there
     # resolves against the package itself, one level shallower than the
     # same import in a sibling module
@@ -557,6 +876,7 @@ def summarize_tree(tree: ast.Module, rel_path: str) -> dict:
                         for s in [".".join(chain_segments(b))] if s),
                     "methods": {},
                     "line": child.lineno,
+                    "blocking_api": _class_blocking_api(child),
                 }
                 methods = walk_scope(child, class_stack + [child.name],
                                      None)
@@ -570,7 +890,9 @@ def summarize_tree(tree: ast.Module, rel_path: str) -> dict:
                     child, qn,
                     cls=".".join(class_stack) if class_stack else None,
                     parent=parent_fn,
-                    strategies=_local_strategy_pins(child))
+                    strategies=_local_strategy_pins(child),
+                    module_state=module_state)
+                coll.blocking_api = _has_blocking_api_marker(child)
                 coll.run()
                 nested = walk_scope(child, [], qn)
                 functions[qn] = coll.summary(rel_path, module, {

@@ -28,7 +28,8 @@ escapes (ownership transferred to the caller)."""
 from __future__ import annotations
 
 from .core import ProjectState, Rule, Violation
-from .dataflow import IO_BLOCKING_CALLS
+from .dataflow import (ACQUIRE_METHODS, IO_BLOCKING_CALLS,
+                       RELEASE_METHODS)
 from .rules_async import BLOCKING_CALLS as _GL01_BLOCKING
 
 # atoms GL10 adds beyond GL01's list: typed DIRECTLY in an async frame
@@ -54,21 +55,32 @@ class BlockingReachableFromAsync(Rule):
     id = "GL10"
     name = "blocking-reachable-from-async"
     needs_dataflow = True
-    summary = ("a sync helper that blocks (I/O, sqlite/LSM db seam) is "
-               "reachable from an `async def` with no asyncio.to_thread "
-               "frame on the path — the event loop stalls for the whole "
-               "operation; the report names the full call chain")
+    summary = ("a sync helper that blocks (I/O, @blocking_api db seam) "
+               "is reachable from an `async def` with no "
+               "asyncio.to_thread frame on the path — the event loop "
+               "stalls for the whole operation; the report names the "
+               "full call chain (since ISSUE 14 iterating an "
+               "in-project generator counts, and the db seam is the "
+               "@blocking_api annotation where the call resolves, "
+               "receiver-name heuristic only for out-of-tree "
+               "callables)")
     rationale = (
         "GL01 sees `time.sleep` typed directly in an async def; the "
         "PR 2 regression class more often hides one helper down "
         "(async handler -> def scan -> sqlite). Pass 2 walks the "
         "call graph from every async function through sync project "
         "frames to a blocking atom — GL01's hard-I/O list plus the "
-        "project's sync db seams (store/db/tree/todo/queue receivers "
-        "with a db-verb method) — skipping to_thread hops, async "
-        "callees and generators, and reports the FULL chain. The "
-        "ISSUE 9 sweep fixed ~30 real on-loop db calls this found "
-        "(table sync/gc/queue, resync, k2v poll, RPC handlers).")
+        "project's sync db seams — skipping to_thread hops and async "
+        "callees, and reports the FULL chain. ISSUE 14 closed two "
+        "holes: `for x in gen(...)` over an in-project generator now "
+        "RUNS the body here (reported at the iteration site; a plain "
+        "call stays exempt), and db-seam atoms come from the "
+        "@blocking_api annotation on db.Db/Tree/Transaction wherever "
+        "the call resolves in-project (the store/db/tree receiver-"
+        "name heuristic remains as the fallback for calls the graph "
+        "cannot resolve). The ISSUE 9 sweep fixed ~30 real on-loop "
+        "db calls this found (table sync/gc/queue, resync, k2v poll, "
+        "RPC handlers).")
     example_fire = ("def scan(path):\n"
                     "    return sqlite3.connect(path)\n"
                     "async def handler(path):\n"
@@ -92,13 +104,18 @@ class BlockingReachableFromAsync(Rule):
             if not file_ok[path]:
                 continue
             # direct atoms in the async frame itself that GL01 does
-            # not own: the db seams, and the fsync/rename syscalls
-            # only GL10's list carries
-            for atom in fn["blocking"]:
+            # not own: the db seams (annotation-filtered since
+            # ISSUE 14), @blocking_api calls, and the fsync/rename
+            # syscalls only GL10's list carries
+            for atom in df.graph.atoms_of(fid):
                 if atom["kind"] == "db":
                     msg = (f"sync db call `{atom['target']}(...)` "
                            "directly on the event loop; wrap in "
                            "asyncio.to_thread")
+                elif atom["kind"] == "api":
+                    msg = (f"blocking-annotated `{atom['target']}(...)`"
+                           " called directly on the event loop; wrap "
+                           "in asyncio.to_thread")
                 elif atom["target"] in _EXTRA_IO:
                     msg = (f"blocking `{atom['target']}(...)` directly "
                            "on the event loop; wrap in "
@@ -144,8 +161,10 @@ class LeakedBudgetOnException(Rule):
     summary = ("qos token / lease / semaphore acquire whose refund or "
                "release is not on every exit path — a raise between "
                "acquire and the happy-path release leaks the budget "
-               "(PR 8's lease-conservation bug class); move the release "
-               "into a finally: or the except-reraise refund idiom")
+               "(PR 8's lease-conservation bug class); cross-function "
+               "since ISSUE 14: an acquire here released in a callee "
+               "(or handed out by an acquiring helper) settles through "
+               "the call graph instead of mis-reporting")
     rationale = (
         "The exact shape of PR 8's lease-conservation bugs (and "
         "Aspirator's error-path blindness): acquire, do raise-capable "
@@ -154,36 +173,190 @@ class LeakedBudgetOnException(Rule):
         "shapes: `with` acquires, release in a finally:, the "
         "failure-refund idiom (except: refund; raise), acquires with "
         "no release at all (plain admission consumes by design), and "
-        "acquires whose value escapes (ownership transferred).")
-    example_fire = ("tok = await bucket.acquire(n)\n"
-                    "resp = await upstream()     # raise leaks tok\n"
-                    "bucket.refund(n)")
+        "acquires whose value escapes (ownership transferred). Since "
+        "ISSUE 14 acquire/release facts settle ACROSS call-graph "
+        "edges to a fixpoint: a release inside a helper invoked from "
+        "a finally: is exception-safe (no false positive), a helper "
+        "that acquires and returns makes its CALLER the owner (the "
+        "happy-path-only release there is a real leak — no false "
+        "negative). This is the shape of BudgetLeaseBroker "
+        "revoke/renew and the feeder's abort paths.")
+    example_fire = ("lease = self._rent(n)   # helper acquires+returns\n"
+                    "resp = await upstream()  # raise leaks the lease\n"
+                    "lease.release()")
     example_ok = ("tok = await bucket.acquire(n)\n"
                   "try:\n    resp = await upstream()\n"
-                  "finally:\n    bucket.refund(n)")
+                  "finally:\n    self._give_back(tok)  "
+                  "# releases in the callee")
+
+    # fixpoint iteration cap (call chains deeper than this are noise)
+    _MAX_ROUNDS = 8
 
     def finish_project(self, project: ProjectState) -> list[Violation]:
         df = _dataflow(project)
         if df is None:
             return []
+        g = df.graph
+        fns = g.functions
+        rel_params, rel_attrs = self._release_facts(g)
+        acq_ret = self._acquire_returning(g)
+
         out: list[Violation] = []
         file_ok: dict[str, bool] = {}
-        for fid in sorted(df.graph.functions):
-            fn = df.graph.functions[fid]
+        for fid in sorted(fns):
+            fn = fns[fid]
             path = fn["path"]
             if path not in file_ok:
                 file_ok[path] = _is_checked_file(project, path)
             if not file_ok[path]:
                 continue
-            for leak in fn["leaks"]:
-                v = Violation(
-                    rule=self.id, path=path, line=leak["line"], col=0,
+            ret_names = set(fn["ret_names"])
+            acquires = []
+            for a in fn["acquires"]:
+                if a["in_with"]:
+                    continue
+                acquires.append((a["line"], a["recv"],
+                                 {a["recv"]} | set(a["names"]),
+                                 set(a["names"])))
+            for callee, rec in g.edges_from(fid):
+                # synthetic acquire: the callee acquires and hands the
+                # resource back — this frame is now the owner
+                if callee in acq_ret and rec.get("bound") \
+                        and not rec["via_thread"]:
+                    bound = set(rec["bound"])
+                    acquires.append(
+                        (rec["line"], rec["name"], set(bound), bound))
+            for line, recv, match_names, bound in acquires:
+                if bound & ret_names:
+                    continue  # ownership passed to OUR caller
+                evs = self._release_events(g, fid, fn, match_names,
+                                           rel_params, rel_attrs)
+                if not evs:
+                    continue  # plain admission consumes by design
+                if any(ctx == "finally" for _, ctx in evs):
+                    continue
+                plain = [(ln, ctx) for ln, ctx in evs
+                         if ctx != "except"]
+                if not plain:
+                    continue  # except-refund-reraise idiom
+                after = [ln for ln, _ in plain if ln > line]
+                if not after:
+                    continue
+                rel_line = min(after)
+                risky = self._risky_between(fn, line, rel_line)
+                if risky is None:
+                    continue
+                out.append(Violation(
+                    rule=self.id, path=path, line=line, col=0,
                     message=(
-                        f"`{leak['recv']}` acquire here is released at "
-                        f"line {leak['release_line']} only on the happy "
-                        f"path — the call at line {leak['risky_line']} "
-                        "can raise and leak the budget; release in a "
-                        "finally: (or refund in an except: ... raise)"),
-                    context=fn["qualname"])
-                out.append(v)
+                        f"`{recv}` acquire here is released at line "
+                        f"{rel_line} only on the happy path — the call "
+                        f"at line {risky} can raise and leak the "
+                        "budget; release in a finally: (or refund in "
+                        "an except: ... raise)"),
+                    context=fn["qualname"]))
         return out
+
+    # ---- cross-function facts (fixpoints over summaries) ---------------
+
+    def _release_facts(self, g) -> tuple[dict, dict]:
+        """rel_params[fid] = own params the function releases (itself
+        or by passing them into a releasing callee); rel_attrs[fid] =
+        receiver names it releases, transitively through self-calls
+        (the `finally: self._cleanup()` shape)."""
+        fns = g.functions
+        rel_params = {}
+        rel_attrs = {}
+        for fid, fn in fns.items():
+            params = set(fn["params"])
+            rel_params[fid] = {r["recv"] for r in fn["releases"]
+                               if r["recv"] in params}
+            rel_attrs[fid] = {r["recv"] for r in fn["releases"]}
+        for _ in range(self._MAX_ROUNDS):
+            changed = False
+            for fid, fn in fns.items():
+                params = set(fn["params"])
+                for callee, rec in g.edges_from(fid):
+                    if rec["via_thread"]:
+                        continue
+                    for n, _ln in self._released_args(
+                            g, fid, callee, rec, rel_params):
+                        if n in params and n not in rel_params[fid]:
+                            rel_params[fid].add(n)
+                            changed = True
+                    if rec["ref"][0] == "self":
+                        new = rel_attrs[callee] - rel_attrs[fid]
+                        if new:
+                            rel_attrs[fid] |= new
+                            changed = True
+            if not changed:
+                break
+        return rel_params, rel_attrs
+
+    def _released_args(self, g, fid, callee, rec, rel_params):
+        """Names this call passes into parameters the callee releases."""
+        shift = g.bound_call(fid, rec)
+        for pos, ad in enumerate(rec["args"]):
+            if not ad or "n" not in ad:
+                continue
+            pname = g.param_index(callee, pos, shift)
+            if pname and pname in rel_params.get(callee, ()):
+                yield ad["n"], rec["line"]
+        for k, ad in rec.get("kw", {}).items():
+            if ad and "n" in ad and k in rel_params.get(callee, ()):
+                yield ad["n"], rec["line"]
+
+    def _acquire_returning(self, g) -> set:
+        """Functions that hand an acquired resource to their caller:
+        return a name bound from an acquire, return the acquire call
+        itself, or return the result of another acquire-returning
+        function (fixpoint)."""
+        fns = g.functions
+        acq_ret = set()
+        for fid, fn in fns.items():
+            ret_names = set(fn["ret_names"])
+            if any(set(a["names"]) & ret_names for a in fn["acquires"]):
+                acq_ret.add(fid)
+            if any(rec["name"] in ACQUIRE_METHODS and rec.get("in_ret")
+                   for rec in fn["calls"]):
+                acq_ret.add(fid)
+        for _ in range(self._MAX_ROUNDS):
+            changed = False
+            for fid, fn in fns.items():
+                if fid in acq_ret:
+                    continue
+                ret_names = set(fn["ret_names"])
+                for callee, rec in g.edges_from(fid):
+                    if callee not in acq_ret or rec["via_thread"]:
+                        continue
+                    if rec.get("in_ret") \
+                            or set(rec.get("bound", ())) & ret_names:
+                        acq_ret.add(fid)
+                        changed = True
+                        break
+            if not changed:
+                break
+        return acq_ret
+
+    def _release_events(self, g, fid, fn, match_names,
+                        rel_params, rel_attrs) -> list[tuple[int, str]]:
+        evs = [(r["line"], r["ctx"]) for r in fn["releases"]
+               if r["recv"] in match_names]
+        for callee, rec in g.edges_from(fid):
+            if rec["via_thread"]:
+                continue
+            for n, ln in self._released_args(g, fid, callee, rec,
+                                             rel_params):
+                if n in match_names:
+                    evs.append((ln, rec["ctx"]))
+            if rec["ref"][0] == "self" \
+                    and rel_attrs.get(callee, set()) & match_names:
+                evs.append((rec["line"], rec["ctx"]))
+        return sorted(set(evs))
+
+    def _risky_between(self, fn, lo: int, hi: int):
+        for rec in fn["calls"]:
+            if lo < rec["line"] < hi \
+                    and rec["name"] not in RELEASE_METHODS:
+                return rec["line"]
+        return None

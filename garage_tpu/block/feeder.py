@@ -353,6 +353,8 @@ class DeviceFeeder:
         if self._task is None or self._task.done():
             self._q = asyncio.Queue()
             self._task = asyncio.create_task(self._run(), name="device-feeder")
+            # supervised by stop(): not a leak at loop teardown
+            self._task._garage_background = True
         if self.mode == "off":
             self._device_ok = False
         elif self._device_ok is None and self._backend_is_stub():
@@ -426,26 +428,42 @@ class DeviceFeeder:
             self._device_ok = True
 
     async def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except (asyncio.CancelledError, Exception):
-                pass
-            self._task = None
-        # cancel every in-flight pipelined batch: each _finish_batch
-        # fails its items' futures on the way out, so no caller hangs
-        # on a batch that was mid-stage when the feeder stopped
-        for t in list(self._inflight_tasks):
+        # snapshot-and-clear EVERYTHING this stop owns BEFORE awaiting
+        # (GL12): stop() yields while the cancelled dispatcher
+        # unwinds, and a concurrent _submit()'s _ensure_started() can
+        # legitimately respawn a new dispatcher (with a NEW queue)
+        # into self._task during that window. The old code nulled
+        # self._task after the await — orphaning the live respawn —
+        # and drained self._q, which by then was the RESPAWN's queue:
+        # a fresh submission got a spurious "feeder stopped" while the
+        # feeder was running. Only the snapshots are touched below.
+        t, self._task = self._task, None
+        q = self._q  # snapshot only: the unwinding dispatcher still
+        # reads self._q between suspension points; a respawn swaps in
+        # a fresh queue object, so draining the snapshot can never
+        # touch the respawn's submissions
+        inflight = list(self._inflight_tasks)
+        self._inflight_tasks.clear()
+        if t is not None:
             t.cancel()
             try:
                 await t
             except (asyncio.CancelledError, Exception):
                 pass
-        # fail anything still queued so no caller awaits forever
-        if self._q is not None:
-            while not self._q.empty():
-                item = self._q.get_nowait()
+        # cancel every in-flight pipelined batch THIS stop snapshotted:
+        # each _finish_batch fails its items' futures on the way out,
+        # so no caller hangs on a batch that was mid-stage
+        for bt in inflight:
+            bt.cancel()
+            try:
+                await bt
+            except (asyncio.CancelledError, Exception):
+                pass
+        # fail anything still queued on the OLD queue so no caller
+        # awaits forever
+        if q is not None:
+            while not q.empty():
+                item = q.get_nowait()
                 if not item.future.done():
                     item.future.set_exception(RuntimeError("feeder stopped"))
 

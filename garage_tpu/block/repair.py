@@ -331,8 +331,13 @@ class ScrubWorker(Worker):
                             "(%s); headers left untouched",
                             h.hex()[:16], sorted(set(lens.values())))
                 continue
-            self.header_repaired += await self._repair_headers(
+            # bind first, then add (GL12): `x += await ...` reads the
+            # counter BEFORE the (multi-RPC) await and stores after it,
+            # so a concurrent repair wave's increments in that window
+            # would be lost
+            repaired = await self._repair_headers(
                 h, parts, packed_len, placement, bad_idx)
+            self.header_repaired += repaired
         return bad
 
     async def _repair_headers(self, hash32: bytes, parts: dict[int, bytes],

@@ -105,42 +105,49 @@ async def _offline(args) -> int:
                 lockfile.release(fd)
             print(str(e), file=sys.stderr)
             return 1
-        src = open_db(args.src, engine=args.src_engine)
-        dst = open_db(args.dst, engine=args.dst_engine)
+        def _convert() -> int:
+            # runs in a worker thread (GL10): the whole-db copy is
+            # minutes of sqlite/LSM I/O and must not pin the loop
+            src = open_db(args.src, engine=args.src_engine)
+            dst = open_db(args.dst, engine=args.dst_engine)
+            try:
+                if dst.list_trees():
+                    print("destination database is not empty; refusing "
+                          "to interleave rows", file=sys.stderr)
+                    return 1
+                total = 0
+                for name in src.list_trees():
+                    st = src.open_tree(name)
+                    dt = dst.open_tree(name)
+                    rows, cursor = 0, None
+                    while True:  # batched: never materialize a tree
+                        batch = list(st.iter(start=cursor, limit=10000))
+                        if not batch:
+                            break
+
+                        def copy(tx, batch=batch, dt=dt):
+                            for k, v in batch:
+                                tx.insert(dt, k, v)
+
+                        dst.transaction(copy)
+                        rows += len(batch)
+                        if len(batch) < 10000:
+                            break
+                        cursor = batch[-1][0] + b"\x00"
+                    total += rows
+                    print(f"  {name}: {rows} rows")
+                print(f"converted {total} rows "
+                      f"({args.src_engine} -> {args.dst_engine})")
+            finally:
+                src.close()
+                dst.close()
+            return 0
+
         try:
-            if dst.list_trees():
-                print("destination database is not empty; refusing to "
-                      "interleave rows", file=sys.stderr)
-                return 1
-            total = 0
-            for name in src.list_trees():
-                st = src.open_tree(name)
-                dt = dst.open_tree(name)
-                rows, cursor = 0, None
-                while True:  # batched: never materialize a whole tree
-                    batch = list(st.iter(start=cursor, limit=10000))
-                    if not batch:
-                        break
-
-                    def copy(tx, batch=batch, dt=dt):
-                        for k, v in batch:
-                            tx.insert(dt, k, v)
-
-                    dst.transaction(copy)
-                    rows += len(batch)
-                    if len(batch) < 10000:
-                        break
-                    cursor = batch[-1][0] + b"\x00"
-                total += rows
-                print(f"  {name}: {rows} rows")
-            print(f"converted {total} rows "
-                  f"({args.src_engine} -> {args.dst_engine})")
+            return await asyncio.to_thread(_convert)
         finally:
-            src.close()
-            dst.close()
             for fd in lock_fds:
                 lockfile.release(fd)
-        return 0
     if args.cmd == "repair-offline":
         cfg = await asyncio.to_thread(read_config, args.config)
         from ..model.garage import Garage

@@ -8,6 +8,7 @@ at millions of keys — see lsm.py and README "Metadata at scale"). The
 same test-suite runs against all engines, mirroring src/db/test.rs.
 """
 
-from .db import Db, Tree, Transaction, TxAbort, open_db
+from .db import Db, Tree, Transaction, TxAbort, blocking_api, open_db
 
-__all__ = ["Db", "Tree", "Transaction", "TxAbort", "open_db"]
+__all__ = ["Db", "Tree", "Transaction", "TxAbort", "blocking_api",
+           "open_db"]

@@ -33,7 +33,23 @@ class TxAbort(Exception):
         self.value = value
 
 
+def blocking_api(fn):
+    """Marker for synchronous, potentially-blocking API functions.
+
+    garage-lint's GL10 reads this (the decorator, or a `blocking_api =
+    True` class attribute) instead of guessing from receiver names: a
+    non-awaited call that resolves to a marked function is a blocking
+    atom when reached from an async frame without a to_thread hop.
+    Runtime no-op beyond the attribute (ISSUE 14 satellite)."""
+    fn.__blocking_api__ = True
+    return fn
+
+
 class Db:
+    # every public method runs engine code under the Db lock — sqlite
+    # or LSM I/O that must never run directly on the event loop
+    blocking_api = True
+
     def __init__(self, engine: "_Engine"):
         self._engine = engine
         self._lock = threading.RLock()
@@ -92,6 +108,9 @@ class Db:
 
 class Tree:
     """A named keyspace with ordered byte keys. ref: db/lib.rs:98-270."""
+
+    # sqlite/LSM I/O under the Db lock: blocking by contract (GL10)
+    blocking_api = True
 
     def __init__(self, db: Db, name: str):
         self._db = db
@@ -162,6 +181,9 @@ class Tree:
 class Transaction:
     """Operations inside Db.transaction(); sees its own writes.
     ref: db/lib.rs:272-384 (ITx)."""
+
+    # runs inside Db.transaction's engine critical section (GL10)
+    blocking_api = True
 
     def __init__(self, engine: "_Engine"):
         self._e = engine
@@ -459,6 +481,7 @@ class SqliteEngine(_Engine):
         self._conn.close()
 
 
+@blocking_api
 def open_db(path: str, engine: str = "sqlite", fsync: bool = False) -> Db:
     """ref: src/db/open.rs:65-125 (engine selection; `[metadata]
     db_engine = sqlite|memory|lsm`)."""

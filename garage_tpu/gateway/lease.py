@@ -39,6 +39,8 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from ..utils import sanitizer
+
 # EWMA weight for one demand sample (per renew interval)
 DEMAND_ALPHA = 0.3
 
@@ -134,6 +136,9 @@ class BudgetLeaseBroker:
         self.renews = 0
         self.revokes = 0
         self.expiries = 0
+        # Σ leases ≤ budget re-checked at every loop teardown under
+        # GARAGE_SANITIZE=1 (no-op when disarmed)
+        sanitizer.track_conservation(self)
 
     # ---- configuration -------------------------------------------------
 

@@ -200,6 +200,7 @@ class LifecycleWorker(Worker):
                 and self._bucket_cache[0] == bucket_id:
             return self._bucket_cache[1]
         b = await self.garage.bucket_table.get(bucket_id, b"")
+        # lint: ignore[GL12] single lifecycle worker task owns this 1-entry cache; a racing fill would only re-cache the other bucket and the id check above re-fetches on mismatch
         self._bucket_cache = (bucket_id, b)
         return b
 

@@ -82,6 +82,7 @@ class AutoSnapshotWorker(Worker):
         if time.monotonic() < self._next:
             return WState.IDLE
         await asyncio.to_thread(snapshot_metadata, self.garage)
+        # lint: ignore[GL12] single snapshot worker task owns _next; BackgroundRunner never runs two work() frames of one worker concurrently
         self._next = time.monotonic() + self.interval * (
             1.0 + random.random() / 5.0)
         return WState.IDLE

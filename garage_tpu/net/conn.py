@@ -469,6 +469,10 @@ class Conn:
             asyncio.create_task(self._send_loop()),
             asyncio.create_task(self._recv_loop()),
         ]
+        for t in self._tasks:
+            # supervised by close(): not leaks for the sanitizer's
+            # loop-teardown check
+            t._garage_background = True
 
     # ---- outgoing ------------------------------------------------------
 
@@ -805,6 +809,8 @@ class Conn:
             self._run_handler(req_id, path, prio, order, payload, st.stream,
                               trace_id)
         )
+        # supervised: tracked in _handler_tasks, cancelled by close()
+        task._garage_background = True
         self._handler_tasks[req_id] = task
         task.add_done_callback(lambda t: self._handler_tasks.pop(req_id, None))
 

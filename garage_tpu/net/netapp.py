@@ -171,9 +171,11 @@ class NetApp:
             return  # already listening (idempotent for composition roots)
         assert self.bind_addr is not None, "no bind_addr configured"
         host, port = self.bind_addr
+        # lint: ignore[GL12] listen() is called once from the composition root before any request task exists; the None check above is an idempotence guard, not concurrency control
         self._server = await asyncio.start_server(self._accept, host, port)
         if port == 0:  # test convenience: recover the kernel-chosen port
             port = self._server.sockets[0].getsockname()[1]
+            # lint: ignore[GL12] same single-task startup window as _server above
             self.bind_addr = (host, port)
             if self.public_addr is None or self.public_addr[1] == 0:
                 self.public_addr = (host, port)
@@ -306,9 +308,15 @@ class NetApp:
     async def shutdown(self) -> None:
         if self._server is not None:
             self._server.close()
-        for conn in list(self.conns.values()):
+        # pop-then-close (GL12): iterating a snapshot and then
+        # clear()ing raced _register() — a connection accepted while an
+        # earlier close() awaited survived the snapshot and was then
+        # dropped from the map WITHOUT being closed (leaked socket, the
+        # peer kept a half-open channel). Popping drains whatever is
+        # present at each step, including late registrations.
+        while self.conns:
+            _, conn = self.conns.popitem()
             await conn.close()
-        self.conns.clear()
 
 
 def old_is_initiated(conn: Conn) -> bool:

@@ -425,6 +425,9 @@ class PeeringManager:
     async def run(self) -> None:
         ping_task = asyncio.create_task(self._ping_loop())
         conn_task = asyncio.create_task(self._connect_loop())
+        # supervised (cancelled below): not leaks for the sanitizer
+        ping_task._garage_background = True
+        conn_task._garage_background = True
         await self._stop.wait()
         ping_task.cancel()
         conn_task.cancel()

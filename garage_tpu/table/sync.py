@@ -77,6 +77,7 @@ class TableSyncer(Worker):
         all_ok = await self.sync_all_partitions()
         self.rounds_done += 1
         if all_ok:
+            # lint: ignore[GL12] one syncer worker per table owns _last_sync; BackgroundRunner serializes a worker's work() frames
             self._last_sync = time.monotonic()
             self._fail_streak = 0
         else:

@@ -34,6 +34,9 @@ def spawn(coro, name: str = "") -> asyncio.Task:
             t.set_name(name)
         except AttributeError:
             pass
+    # deliberate background work: the runtime sanitizer's task-leak
+    # check at loop teardown skips marked tasks (utils/sanitizer.py)
+    t._garage_background = True
     _detached.add(t)
     t.add_done_callback(_spawn_done)
     return t
@@ -120,9 +123,10 @@ class BackgroundRunner:
         wid = f"{self._seq}:{worker.name}"
         self._workers[wid] = worker
         self._infos[wid] = worker.info()
-        self._tasks[wid] = asyncio.create_task(
-            self._run_worker(wid, worker), name=wid
-        )
+        t = asyncio.create_task(self._run_worker(wid, worker), name=wid)
+        # supervised by shutdown(); not a leak at loop teardown
+        t._garage_background = True
+        self._tasks[wid] = t
 
     def worker_info(self) -> Dict[str, WorkerInfo]:
         for wid, w in self._workers.items():

@@ -28,6 +28,31 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+from garage_tpu.utils import sanitizer  # noqa: E402
+
+if sanitizer.armed():
+    # runtime asyncio sanitizer (ISSUE 14): loop-stall detector +
+    # teardown leak/conservation checks. CI exports GARAGE_SANITIZE=1
+    # for tier-1 and the nightly soak.
+    sanitizer.install()
+
+
+@pytest.fixture(autouse=True)
+def _sanitizer_reports():
+    """Fail the test that stalled the loop / leaked a task or lock /
+    broke budget conservation — the report names the culprit frame."""
+    if sanitizer.armed():
+        sanitizer.drain_reports()  # a prior test's tail must not bleed
+    yield
+    if not sanitizer.armed():
+        return
+    reports = sanitizer.drain_reports()
+    if reports:
+        detail = "\n".join(f"[{r['kind']}] {r['detail']}"
+                           for r in reports)
+        pytest.fail(f"sanitizer reports (GARAGE_SANITIZE=1):\n{detail}",
+                    pytrace=False)
+
 
 @pytest.fixture(params=["memory", "sqlite", "lsm"])
 def db_engine(request) -> str:

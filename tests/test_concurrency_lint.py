@@ -1,0 +1,639 @@
+"""ISSUE 14: concurrency-soundness lint — GL12 await-interleaving
+races, GL13 lock-order cycles, GL11v2 cross-function budget leaks,
+engine-level @blocking_api annotations, GL10 generator-iteration
+blindness — fire+suppress fixtures, the real-CLI exit-1 pins, summary
+determinism over the new fields, and the SUMMARY_VERSION bump."""
+
+import ast
+import json
+import os
+import textwrap
+
+from garage_tpu.analysis import (analyze_source, default_rules,
+                                 summarize_tree, summary_json)
+from garage_tpu.analysis.dataflow import SUMMARY_VERSION
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(src: str, rel_path: str = "garage_tpu/fake/mod.py"):
+    ctx = analyze_source(textwrap.dedent(src), default_rules(),
+                         rel_path=rel_path)
+    return [v for v in ctx.violations if v.active]
+
+
+def rules_of(violations):
+    return sorted({v.rule for v in violations})
+
+
+# ---- GL12 await-interleaving-atomicity ----------------------------------
+
+def test_gl12_check_then_act_fires_with_both_lines():
+    vs = run("""
+        class F:
+            async def start(self, h):
+                if h not in self._inflight:
+                    fut = await self._spawn(h)
+                    self._inflight[h] = fut
+                return self._inflight[h]
+    """)
+    assert rules_of(vs) == ["GL12"]
+    assert "self._inflight" in vs[0].message
+    assert "read at line 4" in vs[0].message
+    assert "awaited at line 5" in vs[0].message
+
+
+def test_gl12_write_in_awaited_callee_fires():
+    vs = run("""
+        class F:
+            async def start(self, h):
+                if h not in self._inflight:
+                    await self._insert(h)
+            async def _insert(self, h):
+                self._inflight[h] = 1
+    """)
+    assert rules_of(vs) == ["GL12"]
+    assert "F._insert" in vs[0].message
+
+
+def test_gl12_write_in_sync_self_callee_after_await_fires():
+    vs = run("""
+        class F:
+            async def start(self, h):
+                if h not in self._inflight:
+                    fut = await self.spawn(h)
+                    self._store(h, fut)
+            def _store(self, h, fut):
+                self._inflight[h] = fut
+    """)
+    assert rules_of(vs) == ["GL12"]
+    assert "F._store" in vs[0].message
+
+
+def test_gl12_module_state_fires():
+    vs = run("""
+        _pending = {}
+        async def start(h):
+            if h not in _pending:
+                fut = await spawn(h)
+                _pending[h] = fut
+    """)
+    assert rules_of(vs) == ["GL12"]
+    assert "_pending" in vs[0].message
+
+
+def test_gl12_recheck_after_await_is_the_fix_idiom():
+    vs = run("""
+        class F:
+            async def start(self, h):
+                if h not in self._inflight:
+                    fut = await self._spawn(h)
+                    if h not in self._inflight:
+                        self._inflight[h] = fut
+    """)
+    assert vs == []
+
+
+def test_gl12_lock_across_await_suppresses():
+    vs = run("""
+        class F:
+            async def start(self, h):
+                async with self._lock:
+                    if h not in self._inflight:
+                        fut = await self._spawn(h)
+                        self._inflight[h] = fut
+    """)
+    assert vs == []
+
+
+def test_gl12_guard_loop_while_recheck_suppresses():
+    # `while cond: await` re-evaluates its test before falling
+    # through — the post-loop write acts on a re-validated read
+    vs = run("""
+        class F:
+            async def admit(self, t):
+                while len(self._tasks) >= self.cap:
+                    await wait_any(self._tasks)
+                self._tasks.add(t)
+    """)
+    assert vs == []
+
+
+def test_gl12_accretive_mutation_suppresses():
+    # extend/append act on LIVE state; a stale length check cannot
+    # make them clobber another task's bytes
+    vs = run("""
+        class R:
+            async def fill(self, n):
+                while len(self._buf) < n:
+                    c = await self.inner.read()
+                    self._buf.extend(c)
+    """)
+    assert vs == []
+
+
+def test_gl12_constant_flag_store_suppresses():
+    vs = run("""
+        class R:
+            async def read(self):
+                if self._eof:
+                    return b""
+                data = await self.inner.read()
+                if not data:
+                    self._eof = True
+                return data
+    """)
+    assert vs == []
+
+
+def test_gl12_return_barrier_suppresses_branch_write():
+    # the await sits on a branch that RETURNS; the write path never
+    # crossed it
+    vs = run("""
+        class W:
+            async def work(self):
+                if self._phase == 0:
+                    await self.push_batch()
+                    return "busy"
+                self._phase = 1
+    """)
+    assert vs == []
+
+
+def test_gl12_augassign_with_await_inside_value_fires():
+    vs = run("""
+        class C:
+            async def bump(self):
+                self.count += await self.compute()
+    """)
+    assert rules_of(vs) == ["GL12"]
+
+
+def test_gl12_waivable_with_reason():
+    vs = run("""
+        class F:
+            async def start(self, h):
+                if h not in self._inflight:
+                    fut = await self._spawn(h)
+                    # lint: ignore[GL12] single dispatcher task owns this map
+                    self._inflight[h] = fut
+    """)
+    assert vs == []
+
+
+def test_gl12_skips_test_files():
+    ctx = analyze_source(textwrap.dedent("""
+        class F:
+            async def start(self, h):
+                if h not in self._inflight:
+                    fut = await self._spawn(h)
+                    self._inflight[h] = fut
+    """), default_rules(), rel_path="tests/test_fake.py")
+    assert [v for v in ctx.violations if v.active] == []
+
+
+# ---- GL13 lock-order-inversion ------------------------------------------
+
+GL13_ABBA = """
+    class F:
+        async def a(self):
+            async with self._lock_a:
+                async with self._lock_b:
+                    pass
+        async def b(self):
+            async with self._lock_b:
+                async with self._lock_a:
+                    pass
+"""
+
+
+def test_gl13_abba_fires_with_both_chains():
+    vs = run(GL13_ABBA)
+    assert rules_of(vs) == ["GL13"]
+    msg = vs[0].message
+    assert "_lock_a -> " in msg and "_lock_b -> " in msg
+    assert "F.a" in msg and "F.b" in msg
+
+
+def test_gl13_consistent_order_is_quiet():
+    vs = run("""
+        class F:
+            async def a(self):
+                async with self._lock_a:
+                    async with self._lock_b:
+                        pass
+            async def b(self):
+                async with self._lock_a:
+                    async with self._lock_b:
+                        pass
+    """)
+    assert vs == []
+
+
+def test_gl13_cycle_through_resolved_call():
+    vs = run("""
+        class F:
+            async def a(self):
+                async with self._lock_a:
+                    await self._takeb()
+            async def _takeb(self):
+                async with self._lock_b:
+                    pass
+            async def b(self):
+                async with self._lock_b:
+                    async with self._lock_a:
+                        pass
+    """)
+    assert rules_of(vs) == ["GL13"]
+    assert "via F._takeb" in vs[0].message
+
+
+def test_gl13_sync_with_and_acquire_count():
+    vs = run("""
+        class F:
+            def a(self):
+                with self._lock_a:
+                    self._lock_b.acquire()
+            def b(self):
+                with self._lock_b:
+                    with self._lock_a:
+                        pass
+    """)
+    assert rules_of(vs) == ["GL13"]
+
+
+def test_gl13_same_attr_in_different_classes_not_an_edge():
+    # lock identity is CLASS-qualified: A._lock and B._lock are
+    # different locks even with the same attribute name
+    vs = run("""
+        class A:
+            async def f(self):
+                async with self._lock:
+                    async with self._other:
+                        pass
+        class B:
+            async def g(self):
+                async with self._other:
+                    async with self._lock:
+                        pass
+    """)
+    assert vs == []
+
+
+# ---- GL11v2 cross-function leaks ----------------------------------------
+
+def test_gl11v2_release_in_callee_from_finally_is_safe():
+    vs = run("""
+        class F:
+            async def ok(self, n):
+                tok = await self.bucket.acquire(n)
+                try:
+                    return await self.upstream(n)
+                finally:
+                    self._give_back(n)
+            def _give_back(self, n):
+                self.bucket.refund(n)
+    """)
+    assert vs == []
+
+
+def test_gl11v2_release_in_callee_on_happy_path_fires():
+    vs = run("""
+        class F:
+            async def bad(self, n):
+                tok = await self.bucket.acquire(n)
+                resp = await self.upstream(n)
+                self._give_back(n)
+                return resp
+            def _give_back(self, n):
+                self.bucket.refund(n)
+    """)
+    assert rules_of(vs) == ["GL11"]
+
+
+def test_gl11v2_acquiring_helper_makes_caller_the_owner():
+    vs = run("""
+        class F:
+            def _rent(self, n):
+                lease = self.broker.acquire(n)
+                return lease
+            async def use(self, n):
+                lease = self._rent(n)
+                resp = await self.upstream(n)
+                lease.release()
+                return resp
+    """)
+    assert rules_of(vs) == ["GL11"]
+    assert "_rent" in vs[0].message
+
+
+def test_gl11v2_acquiring_helper_caller_with_finally_is_safe():
+    vs = run("""
+        class F:
+            def _rent(self, n):
+                lease = self.broker.acquire(n)
+                return lease
+            async def use(self, n):
+                lease = self._rent(n)
+                try:
+                    return await self.upstream(n)
+                finally:
+                    lease.release()
+    """)
+    assert vs == []
+
+
+def test_gl11v2_passing_resource_on_is_ownership_transfer():
+    # the caller returns the lease itself: its own caller owns it
+    vs = run("""
+        class F:
+            def _rent(self, n):
+                lease = self.broker.acquire(n)
+                return lease
+            async def rent_for_caller(self, n):
+                lease = self._rent(n)
+                await self.audit(n)
+                return lease
+    """)
+    assert vs == []
+
+
+def test_gl11v2_release_via_param_passing_fires_and_finally_safe():
+    vs = run("""
+        def put_back(lease, n):
+            lease.release()
+        async def bad(self, n):
+            lease = await self.broker.acquire(n)
+            resp = await self.upstream(n)
+            put_back(lease, n)
+            return resp
+    """)
+    assert rules_of(vs) == ["GL11"]
+
+
+# ---- engine-level blocking annotations (GL10) ---------------------------
+
+def test_blocking_api_class_attribute_fires_direct_and_transitive():
+    vs = run("""
+        class Store:
+            blocking_api = True
+            def fetch_rows(self):
+                return 1
+        def helper(s):
+            return s.fetch_rows()
+        class Svc:
+            async def handler(self, s):
+                return helper(s)
+    """)
+    assert rules_of(vs) == ["GL10"]
+    assert "fetch_rows" in vs[0].message
+
+
+def test_blocking_api_decorator_fires():
+    vs = run("""
+        def blocking_api(fn):
+            return fn
+        @blocking_api
+        def scan_all(path):
+            return 1
+        async def handler(path):
+            return scan_all(path)
+    """)
+    assert rules_of(vs) == ["GL10"]
+
+
+def test_annotation_beats_receiver_heuristic_when_resolved():
+    # receiver named `store` + db-verb method, but the call RESOLVES
+    # to an in-project, NON-annotated function: the annotation layer
+    # is authoritative — quiet (the old name heuristic alone fired)
+    vs = run("""
+        class Store:
+            def iter(self):
+                return []
+        class Svc:
+            async def handler(self):
+                return self.store.iter()
+    """)
+    assert vs == []
+
+
+def test_heuristic_kept_for_unresolved_out_of_tree_receivers():
+    vs = run("""
+        async def handler(self, pk):
+            return self.store.get(pk)
+    """)
+    assert rules_of(vs) == ["GL10"]
+
+
+def test_blocking_api_to_thread_hop_is_quiet():
+    vs = run("""
+        import asyncio
+        class Store:
+            blocking_api = True
+            def fetch_rows(self):
+                return 1
+        class Svc:
+            async def handler(self, s):
+                return await asyncio.to_thread(s.fetch_rows)
+    """)
+    assert vs == []
+
+
+def test_db_facade_is_annotated_in_tree():
+    src = open(os.path.join(REPO, "garage_tpu/db/db.py"),
+               encoding="utf-8").read()
+    s = summarize_tree(ast.parse(src), "garage_tpu/db/db.py")
+    assert s["classes"]["Tree"]["blocking_api"]
+    assert s["classes"]["Transaction"]["blocking_api"]
+    assert s["classes"]["Db"]["blocking_api"]
+    assert s["functions"]["open_db"]["blocking_api"]
+
+
+# ---- GL10 generator-iteration blindness ---------------------------------
+
+def test_generator_iteration_fires_at_iteration_site():
+    vs = run("""
+        import sqlite3
+        def gen(path):
+            yield sqlite3.connect(path)
+        async def uses(path):
+            for row in gen(path):
+                pass
+    """)
+    assert rules_of(vs) == ["GL10"]
+    assert "uses -> gen" in vs[0].message
+
+
+def test_async_generator_iteration_fires():
+    # the blocking atom sits in a sync helper INSIDE the async
+    # generator's body — only iterating runs it on the caller's frame
+    vs = run("""
+        import sqlite3
+        def scan(path):
+            return sqlite3.connect(path)
+        async def agen(path):
+            yield scan(path)
+        async def uses(path):
+            async for row in agen(path):
+                pass
+    """)
+    assert "GL10" in rules_of(vs)
+    assert any("uses -> agen" in v.message for v in vs)
+
+
+def test_plain_generator_call_stays_exempt():
+    vs = run("""
+        import sqlite3
+        def gen(path):
+            yield sqlite3.connect(path)
+        async def plain(path):
+            g = gen(path)
+            return g
+    """)
+    assert vs == []
+
+
+# ---- CLI pins (each bug shape exits 1 via the real CLI) -----------------
+
+def _cli_rc_on(tmp_path, source: str, rel: str) -> int:
+    from garage_tpu.analysis.__main__ import main
+
+    target = tmp_path / rel
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(textwrap.dedent(source))
+    return main(["--baseline", "none", str(target)])
+
+
+def test_cli_gl12_seeded_fixture_exits_1(tmp_path, capsys):
+    rc = _cli_rc_on(tmp_path, """
+        class F:
+            async def start(self, h):
+                if h not in self._inflight:
+                    fut = await self._spawn(h)
+                    self._inflight[h] = fut
+    """, "garage_tpu/block/fake_inflight.py")
+    assert rc == 1
+    assert "GL12" in capsys.readouterr().out
+
+
+def test_cli_gl13_seeded_fixture_exits_1(tmp_path, capsys):
+    rc = _cli_rc_on(tmp_path, GL13_ABBA,
+                    "garage_tpu/gateway/fake_locks.py")
+    assert rc == 1
+    assert "GL13" in capsys.readouterr().out
+
+
+def test_cli_gl11v2_seeded_fixture_exits_1(tmp_path, capsys):
+    rc = _cli_rc_on(tmp_path, """
+        class F:
+            def _rent(self, n):
+                lease = self.broker.acquire(n)
+                return lease
+            async def use(self, n):
+                lease = self._rent(n)
+                resp = await self.upstream(n)
+                lease.release()
+                return resp
+    """, "garage_tpu/qos/fake_rent.py")
+    assert rc == 1
+    assert "GL11" in capsys.readouterr().out
+
+
+def test_explain_covers_the_new_rules(capsys):
+    from garage_tpu.analysis.__main__ import main
+
+    for rule in ("GL12", "GL13", "GL11"):
+        assert main(["--explain", rule]) == 0
+        out = capsys.readouterr().out
+        assert "fires on:" in out and "quiet on:" in out
+
+
+# ---- summary schema: determinism + version bump -------------------------
+
+CONCURRENCY_RICH = """
+    _registry = {}
+
+    class F:
+        blocking_api = True
+
+        async def start(self, h):
+            if h not in self._inflight:
+                async with self._lock:
+                    with self._aux_lock:
+                        fut = await self._spawn(h)
+                self._inflight[h] = fut
+            for x in self.gen():
+                self.counts.update(x)
+
+        def gen(self):
+            yield 1
+
+        async def leaky(self, n):
+            tok = await self.bucket.acquire(n)
+            try:
+                return await self.up(n)
+            finally:
+                self.bucket.refund(n)
+"""
+
+
+def test_new_summary_fields_are_byte_deterministic():
+    src = textwrap.dedent(CONCURRENCY_RICH)
+    a = summary_json(summarize_tree(ast.parse(src), "garage_tpu/m.py"))
+    b = summary_json(summarize_tree(ast.parse(src), "garage_tpu/m.py"))
+    assert a == b
+    payload = json.loads(a)
+    fn = payload["functions"]["F.start"]
+    # the ISSUE 14 fields exist and carry structure
+    assert fn["accesses"] and fn["lock_acqs"]
+    assert payload["classes"]["F"]["blocking_api"] is True
+    assert any(ev["k"] == "a" and ev["locks"]
+               for ev in fn["accesses"])
+
+
+def test_summary_version_bumped_for_concurrency_fields():
+    # stale-cache schema drift was a PR 9 review find: any cached
+    # v<3 summary lacks accesses/lock_acqs/ctx and MUST be recomputed
+    assert SUMMARY_VERSION >= 3
+    src = "def f():\n    return 1\n"
+    s = summarize_tree(ast.parse(src), "garage_tpu/m.py")
+    fn = s["functions"]["f"]
+    for field in ("accesses", "lock_acqs", "ret_names", "blocking_api"):
+        assert field in fn
+
+
+def test_gl11v2_partial_record_in_scope_does_not_crash():
+    """Review regression: thread-hop/partial unwrapping synthesizes an
+    extra call record — GL11's release-event scan must see its
+    exit-path ctx like any other record (it used to KeyError and kill
+    the whole lint run)."""
+    vs = run("""
+        from functools import partial
+        class F:
+            async def bad(self, n):
+                cb = partial(self._cleanup)
+                tok = await self.bucket.acquire(n)
+                resp = await self.upstream(n)
+                self.bucket.refund(n)
+                return resp
+            def _cleanup(self):
+                self.bucket.release()
+    """)
+    assert "GL11" in rules_of(vs)
+
+
+def test_gl13_multi_item_with_records_each_lock():
+    """Review regression: `async with a, b:` acquires b while a is
+    held — the most idiomatic multi-lock form must contribute the
+    a -> b edge (only the last item used to be recorded)."""
+    vs = run("""
+        class F:
+            async def a(self):
+                async with self._lock_a, self._lock_b:
+                    pass
+            async def b(self):
+                async with self._lock_b:
+                    async with self._lock_a:
+                        pass
+    """)
+    assert rules_of(vs) == ["GL13"]

@@ -469,3 +469,99 @@ def test_s3_tuning_feeder_knobs():
         apply_s3_tuning(garage, {"feeder_bogus": 1})
     # a rejected spec must not have half-applied
     assert feeder.inflight_batches == 4
+
+
+def test_stop_concurrent_restart_keeps_new_dispatcher():
+    """GL12 regression (ISSUE 14): stop() yields while the cancelled
+    dispatcher unwinds; a concurrent _submit's _ensure_started() can
+    respawn a NEW dispatcher in that window. The old `self._task =
+    None` after the await nulled the live dispatcher's handle — the
+    feeder then thought it was stopped while an orphan kept consuming
+    a queue nothing referenced, and the next restart spawned a second
+    one. stop() now snapshots-and-clears BEFORE awaiting."""
+    f = DeviceFeeder(mode="off")
+
+    async def go():
+        unwound = asyncio.Event()
+
+        async def slow_dispatcher():
+            try:
+                await asyncio.sleep(3600)
+            finally:
+                unwound.set()
+                # cancellation takes a few loop ticks — the window a
+                # real dispatcher's cleanup occupies
+                try:
+                    await asyncio.shield(asyncio.sleep(0.05))
+                except asyncio.CancelledError:
+                    pass
+
+        f._task = asyncio.create_task(slow_dispatcher())
+        old = f._task
+        await asyncio.sleep(0)  # let the dispatcher enter its try block
+
+        async def restart_mid_stop():
+            await unwound.wait()       # inside stop()'s await window
+            f._ensure_started()        # a concurrent submitter respawns
+            return f._task
+
+        rt = asyncio.create_task(restart_mid_stop())
+        await f.stop()
+        new = await rt
+        assert new is not old
+        # the respawned dispatcher's handle must survive stop()
+        assert f._task is new
+        assert not new.done()
+        await f.stop()  # cleanup (also exercises the fixed path again)
+        assert f._task is None
+
+    run(go())
+
+
+def test_stop_drains_only_its_own_queue_not_the_respawns():
+    """Review regression: stop() snapshots the queue BEFORE awaiting —
+    an item submitted to a dispatcher respawned mid-stop must not get
+    a spurious "feeder stopped" from stop()'s drain."""
+    f = DeviceFeeder(mode="off")
+
+    async def go():
+        unwound = asyncio.Event()
+
+        async def slow_dispatcher():
+            try:
+                await asyncio.sleep(3600)
+            finally:
+                unwound.set()
+                try:
+                    await asyncio.shield(asyncio.sleep(0.05))
+                except asyncio.CancelledError:
+                    pass
+
+        f._ensure_started()          # real queue to snapshot
+        f._task.cancel()             # replace with the slow stand-in
+        f._task = asyncio.create_task(slow_dispatcher())
+        await asyncio.sleep(0)
+
+        async def submit_mid_stop():
+            await unwound.wait()
+            f._ensure_started()      # respawn: NEW queue
+            fut = asyncio.get_event_loop().create_future()
+            f._q.put_nowait(_Item("hash", b"x", fut, None))
+            return fut
+
+        st = asyncio.create_task(submit_mid_stop())
+        await f.stop()
+        fut = await st
+        # the respawned dispatcher owns that item now: it must be
+        # served normally (host-path digest), NEVER failed with
+        # stop()'s "feeder stopped" drain
+        for _ in range(100):
+            if fut.done():
+                break
+            await asyncio.sleep(0.01)
+        assert fut.done() and fut.exception() is None, \
+            "stop() drained the respawned queue"
+        assert fut.result() == blake3sum(b"x")
+        await f.stop()               # clean shutdown of the respawn
+
+    run(go())

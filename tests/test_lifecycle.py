@@ -169,12 +169,12 @@ def test_snapshot_keep_two(tmp_path):
                                                         rf=1)
         g = garages[0]
         try:
-            import time
-
             paths = []
             for _ in range(3):
                 paths.append(await asyncio.to_thread(snapshot_metadata, g))
-                time.sleep(1.1)  # distinct second-resolution stamps
+                # distinct second-resolution stamps; asyncio.sleep, not
+                # time.sleep — the sanitizer flags on-loop sleeps
+                await asyncio.sleep(1.1)
             base = snapshots_dir(g.config)
             left = sorted(os.listdir(base))
             assert len(left) == 2

@@ -342,3 +342,40 @@ def test_ordered_cancel_does_not_stall_stream():
         assert 0 in ran and 2 in ran
 
     run(main(), timeout=60)
+
+
+def test_shutdown_closes_connections_registered_mid_shutdown():
+    """GL12 regression (ISSUE 14): shutdown() used to close a SNAPSHOT
+    of conns and then clear() the map — a connection _register()ed
+    while an earlier close() awaited survived the snapshot and was
+    dropped from the map WITHOUT being closed (leaked socket, the peer
+    kept a half-open channel). The pop-then-close loop drains late
+    registrations too."""
+    async def main():
+        net = LocalNetwork()
+        a = make_local_node(net)
+
+        class FakeConn:
+            def __init__(self):
+                self.closed_flag = False
+
+            async def close(self):
+                self.closed_flag = True
+
+        late = FakeConn()
+
+        class SlowConn(FakeConn):
+            async def close(self):
+                # while this close() awaits, a peer's connect lands
+                await asyncio.sleep(0)
+                a.conns[b"late-peer"] = late
+                self.closed_flag = True
+
+        slow = SlowConn()
+        a.conns[b"slow-peer"] = slow
+        await a.shutdown()
+        assert slow.closed_flag
+        assert late.closed_flag, "late-registered conn leaked by shutdown"
+        assert not a.conns
+
+    run(main())

@@ -1,0 +1,233 @@
+"""ISSUE 14: runtime asyncio sanitizer self-tests.
+
+The seeded-stall test is the acceptance proof: a `time.sleep` typed
+onto the loop produces a report naming the offending frame WHILE it
+blocks. The other tests pin teardown leak detection (tasks, locks),
+budget-conservation tracking, and that a clean run stays silent.
+
+These tests install the sanitizer's patches themselves (they are
+idempotent and observation-only), then drain every report they
+generate so the conftest's autouse check never sees test-induced
+noise.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from garage_tpu.utils import sanitizer
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _scoped_sanitizer():
+    """These tests install() (and thereby activate) the sanitizer even
+    in unarmed pytest sessions; on module exit, reporting reverts to
+    the armed() state so later tests don't accumulate reports nobody
+    drains."""
+    yield
+    sanitizer.set_active(sanitizer.armed())
+    sanitizer.drain_reports()
+
+
+@pytest.fixture
+def fast_stall():
+    """Temporarily lower the stall threshold; always restore."""
+    sanitizer.install()
+    prev = sanitizer.stall_threshold()
+    sanitizer.configure(0.25)
+    yield 0.25
+    sanitizer.configure(prev)
+    sanitizer.drain_reports()
+
+
+def test_seeded_stall_reports_the_blocking_frame(fast_stall):
+    async def _seeded_stall():
+        time.sleep(0.7)  # deliberately pins the loop
+
+    asyncio.run(_seeded_stall())
+    time.sleep(0.1)  # let the monitor thread flush its sample
+    reports = sanitizer.drain_reports()
+    stalls = [r for r in reports if r["kind"] == "loop_stall"]
+    assert stalls, f"no stall report in {reports}"
+    # the report names the live frame, not a post-hoc summary
+    assert "_seeded_stall" in stalls[0]["detail"]
+    assert "time.sleep" in stalls[0]["detail"] \
+        or "test_sanitizer" in stalls[0]["detail"]
+
+
+def test_one_report_per_stall_episode(fast_stall):
+    async def _stall_once():
+        time.sleep(0.7)
+        await asyncio.sleep(0.3)  # beats resume: episode over
+
+    asyncio.run(_stall_once())
+    time.sleep(0.1)
+    stalls = [r for r in sanitizer.drain_reports()
+              if r["kind"] == "loop_stall"]
+    assert len(stalls) == 1
+
+
+def test_no_stall_report_below_threshold(fast_stall):
+    async def _quick():
+        time.sleep(0.05)
+        await asyncio.sleep(0.05)
+
+    asyncio.run(_quick())
+    time.sleep(0.1)
+    assert [r for r in sanitizer.drain_reports()
+            if r["kind"] == "loop_stall"] == []
+
+
+def test_leaked_task_reported_background_task_not():
+    sanitizer.install()
+    sanitizer.drain_reports()
+
+    async def main():
+        async def forever():
+            await asyncio.sleep(3600)
+
+        leaked = asyncio.ensure_future(forever())
+        leaked.set_name("leaked-task")
+        marked = asyncio.ensure_future(forever())
+        marked._garage_background = True
+        await asyncio.sleep(0.01)
+
+    asyncio.run(main())
+    leaks = [r for r in sanitizer.drain_reports()
+             if r["kind"] == "task_leak"]
+    assert len(leaks) == 1
+    assert "leaked-task" in leaks[0]["detail"]
+
+
+def test_utils_background_spawn_is_marked():
+    from garage_tpu.utils.background import spawn
+
+    sanitizer.install()
+    sanitizer.drain_reports()
+
+    async def main():
+        async def forever():
+            await asyncio.sleep(3600)
+
+        spawn(forever(), "deliberate-background")
+        await asyncio.sleep(0.01)
+
+    asyncio.run(main())
+    assert [r for r in sanitizer.drain_reports()
+            if r["kind"] == "task_leak"] == []
+
+
+def test_lock_held_at_teardown_reported():
+    sanitizer.install()
+    sanitizer.drain_reports()
+
+    async def main():
+        lock = asyncio.Lock()
+        await lock.acquire()  # never released; survives cancel-all
+
+    asyncio.run(main())
+    locks = [r for r in sanitizer.drain_reports()
+             if r["kind"] == "lock_leak"]
+    assert locks, "held lock not reported at loop close"
+
+
+def test_conservation_violation_reported():
+    sanitizer.install()
+    sanitizer.drain_reports()
+
+    class Broken:
+        conservation_ok = False
+
+    obj = Broken()
+    # track() is env-gated; reach past it the way lease.py would when
+    # armed — the teardown check walks the registry either way
+    sanitizer._conserved.append(__import__("weakref").ref(obj))
+
+    async def main():
+        await asyncio.sleep(0.01)
+
+    asyncio.run(main())
+    cons = [r for r in sanitizer.drain_reports()
+            if r["kind"] == "budget_conservation"]
+    assert cons and "Broken" in cons[0]["detail"]
+
+
+def test_clean_run_produces_no_reports():
+    sanitizer.install()
+    sanitizer.drain_reports()
+
+    async def main():
+        lock = asyncio.Lock()
+        async with lock:
+            await asyncio.sleep(0.01)
+
+    asyncio.run(main())
+    assert sanitizer.drain_reports() == []
+
+
+def test_broker_and_bucket_register_only_when_armed(monkeypatch):
+    # disarmed: constructing runtime objects must not grow the registry
+    monkeypatch.delenv(sanitizer.ENV_FLAG, raising=False)
+    from garage_tpu.gateway.lease import BudgetLeaseBroker
+    from garage_tpu.qos.limiter import TokenBucket
+
+    before = len(sanitizer._conserved)
+    BudgetLeaseBroker(100.0, 1000.0)
+    TokenBucket(10.0)
+    assert len(sanitizer._conserved) == before
+
+    monkeypatch.setenv(sanitizer.ENV_FLAG, "1")
+    b = BudgetLeaseBroker(100.0, 1000.0)
+    t = TokenBucket(10.0)
+    assert len(sanitizer._conserved) == before + 2
+    assert b.conservation_ok and t.conservation_ok
+    # drop our registrations so later teardown checks skip them
+    sanitizer._conserved[:] = sanitizer._conserved[:before]
+
+
+def test_background_mark_inherited_by_child_tasks():
+    """gather fan-outs inside supervised service loops are themselves
+    supervised — the mark propagates to tasks a background task
+    creates."""
+    sanitizer.install()
+    sanitizer.drain_reports()
+
+    async def main():
+        async def child():
+            await asyncio.sleep(3600)
+
+        async def service_loop():
+            asyncio.ensure_future(child())  # would leak if unmarked
+            await asyncio.sleep(3600)
+
+        svc = asyncio.ensure_future(service_loop())
+        svc._garage_background = True
+        await asyncio.sleep(0.02)
+
+    asyncio.run(main())
+    assert [r for r in sanitizer.drain_reports()
+            if r["kind"] == "task_leak"] == []
+
+
+def test_lock_leak_entry_purged_after_report():
+    """Review regression: a reported leaked lock must not stay in the
+    registry — id() reuse by a later loop would re-attribute it and
+    fail an innocent test."""
+    sanitizer.install()
+    sanitizer.drain_reports()
+
+    async def leaky():
+        await asyncio.Lock().acquire()
+
+    asyncio.run(leaky())
+    assert [r for r in sanitizer.drain_reports()
+            if r["kind"] == "lock_leak"]
+    with sanitizer._lock:
+        assert not sanitizer._held_locks  # purged with the report
+
+    async def clean():
+        await asyncio.sleep(0.01)
+
+    asyncio.run(clean())
+    assert sanitizer.drain_reports() == []
