@@ -221,7 +221,8 @@ def bench_scrub_kernel(jax, platform: str) -> float:
 
 
 async def _build_cluster(tmp: str, n: int, rm, device_mode: str,
-                         compression: bool = False):
+                         compression: bool = False,
+                         ping_interval: float = 10.0):
     """In-process loopback cluster: n Systems + BlockManagers."""
     from garage_tpu.block import BlockManager, DataLayout
     from garage_tpu.db import open_db
@@ -236,7 +237,8 @@ async def _build_cluster(tmp: str, n: int, rm, device_mode: str,
         net.register(app)
         meta = os.path.join(tmp, f"node{i}")
         os.makedirs(meta, exist_ok=True)
-        s = System(app, rm, meta, status_interval=0.5, ping_interval=10.0)
+        s = System(app, rm, meta, status_interval=0.5,
+                   ping_interval=ping_interval)
         systems.append(s)
     tasks = [asyncio.create_task(s.run()) for s in systems]
     for s in systems[1:]:
@@ -1189,6 +1191,238 @@ def bench_decode(nblocks: int = 24, block_kib: int = 1024,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_cache_tier(nblocks: int = 12, block_kib: int = 512,
+                     rounds: int = 4, nodes: int = 6) -> dict:
+    """Cluster cache tier economics (ISSUE 15). A 6-node erasure(4,2)
+    cluster serves a hot working set from EVERY node, tier off vs on:
+
+      cache_tier_hot_get_gbps        cluster hot-GET throughput, tier on
+      cache_tier_hot_get_base_gbps   node-local baseline (tier off;
+                                     each node keeps its own copy)
+      cache_tier_decodes             cluster-wide store decodes for the
+                                     hot set with the tier on — the
+                                     "~1 per block, not N" proof — vs
+      cache_tier_decodes_base        N per block without it
+      cache_tier_remote_hit_ms       mean GET served by a remote probe
+                                     hit vs
+      cache_tier_cold_decode_ms      the cold gather+decode it replaces
+      cache_tier_hint_convergence_s  hot-hash hint gossip: heat node0,
+                                     time until every peer knows
+      shm_forward_*_us               shm publish+map vs loopback-socket
+                                     copy per forward, by payload size
+    """
+    import shutil
+    import socket as socketmod
+    import tempfile
+
+    from garage_tpu.rpc import ReplicationMode
+    from garage_tpu.utils.data import blake3sum
+
+    block_len = block_kib << 10
+    tmp = tempfile.mkdtemp(
+        prefix="gt_tier_",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
+
+    async def scenario() -> dict:
+        rm = ReplicationMode.parse(3, erasure="4,2")
+        systems, managers, tasks = await _build_cluster(
+            tmp, nodes, rm, "off", ping_interval=0.3)
+        try:
+            rng = np.random.default_rng(15)
+            blocks = [rng.integers(0, 256, block_len,
+                                   dtype=np.uint8).tobytes()
+                      for _ in range(nblocks)]
+            hashes = [blake3sum(b) for b in blocks]
+            for h, b in zip(hashes, blocks):
+                await managers[0].rpc_put_block(h, b, compress=False,
+                                                cacheable=False)
+
+            def decodes() -> int:
+                return sum(m.metrics["store_reads"] for m in managers)
+
+            async def hot_sweep() -> float:
+                t0 = time.perf_counter()
+                for _ in range(rounds):
+                    await asyncio.gather(*[
+                        _read_all(m) for m in managers])
+                return time.perf_counter() - t0
+
+            async def _read_all(m) -> None:
+                for h, b in zip(hashes, blocks):
+                    got = await m.rpc_get_block(h)
+                    assert len(got) == len(b)
+
+            def reset_caches() -> None:
+                for m in managers:
+                    m.cache.clear()
+
+            async def warm_once() -> None:
+                # ONE node touches the set first (the production shape:
+                # some reader is always first; the herd arrives after).
+                # With the tier on this seeds the owners via the
+                # write-through pushes; wait for them to land.
+                await _read_all(managers[0])
+                if managers[0].cache_tier.enabled:
+                    deadline = time.perf_counter() + 10.0
+                    by_node = {s.id: m for s, m in zip(systems,
+                                                       managers)}
+                    for h in hashes:
+                        o = managers[0].cache_tier.owner_of(h)
+                        om = by_node[o] if o is not None \
+                            else managers[0]
+                        while om.cache.get(h) is None \
+                                and time.perf_counter() < deadline:
+                            await asyncio.sleep(0.01)
+
+            # ---- node-local baseline: tier off ------------------------
+            for m in managers:
+                m.cache_tier.enabled = False
+            d0 = decodes()
+            await warm_once()
+            base_dt = await hot_sweep()
+            base_decodes = decodes() - d0
+
+            # ---- tier on ----------------------------------------------
+            reset_caches()
+            for m in managers:
+                m.cache_tier.enabled = True
+            d0 = decodes()
+            await warm_once()
+            tier_dt = await hot_sweep()
+            tier_decodes = decodes() - d0
+
+            # ---- latency lanes ----------------------------------------
+            # remote probe-hit GETs: non-owner reads of owner-warm keys
+            lat_hit, lat_cold = [], []
+            for h, b in zip(hashes, blocks):
+                reader = next((m for m in managers
+                               if m.cache_tier.owner_of(h) is not None),
+                              None)
+                if reader is None:
+                    continue
+                t0 = time.perf_counter()
+                got = await reader.rpc_get_block(h)
+                dt = time.perf_counter() - t0
+                if reader.cache.get(h) is None:  # really remote-served
+                    lat_hit.append(dt)
+                t0 = time.perf_counter()
+                await reader.rpc_get_block(h, cacheable=False)
+                lat_cold.append(time.perf_counter() - t0)
+
+            # ---- hint gossip convergence ------------------------------
+            # a FRESH hash (never read in the sweeps, so no earlier
+            # ping can have carried it): heat it, clock the spread
+            fresh = os.urandom(1 << 10)
+            hot_h = blake3sum(fresh)
+            m0 = managers[0]
+            m0.cache.insert(hot_h, fresh)
+            m0.cache.get(hot_h)  # a hit makes it gossip-worthy
+            t0 = time.perf_counter()
+            conv = None
+            while time.perf_counter() - t0 < 20.0:
+                if all(m.cache_tier.is_hot(hot_h)
+                       for m in managers[1:]):
+                    conv = time.perf_counter() - t0
+                    break
+                await asyncio.sleep(0.02)
+
+            total = nodes * rounds * nblocks * block_len
+            out = {
+                "cache_tier_hot_get_gbps": round(total / tier_dt / 1e9,
+                                                 3),
+                "cache_tier_hot_get_base_gbps": round(
+                    total / base_dt / 1e9, 3),
+                "cache_tier_decodes": tier_decodes,
+                "cache_tier_decodes_base": base_decodes,
+                "cache_tier_decodes_per_block": round(
+                    tier_decodes / nblocks, 2),
+                "cache_tier_remote_hit_ms": round(
+                    1e3 * sum(lat_hit) / max(len(lat_hit), 1), 3),
+                "cache_tier_cold_decode_ms": round(
+                    1e3 * sum(lat_cold) / max(len(lat_cold), 1), 3),
+                "cache_tier_hint_convergence_s": (
+                    round(conv, 3) if conv is not None else None),
+                "cache_tier_probe_hits": sum(
+                    m.cache_tier.probe_hits for m in managers),
+            }
+            return out
+        finally:
+            await _teardown(systems, managers, tasks)
+
+    def shm_vs_socket() -> dict:
+        """Micro lane: one FORWARD's payload transfer. The shm shape is
+        the production one — the owner publishes a hot block once per
+        lease and every subsequent forward is a reference + mmap view
+        (zero payload copies); the socket shape pays the full payload
+        copy through the kernel per forward. shm_publish_*_us prices
+        the cold first-publish separately."""
+        from garage_tpu.gateway.shm import ShmReader, ShmRing, ring_path
+
+        out = {}
+        ring = ShmRing(ring_path(tmp, 99), 64 << 20, lease_s=30.0)
+        reader = ShmReader()
+        for kib in (64, 256, 1024, 4096):
+            payload = os.urandom(kib << 10)
+            n_iter = max(8, (16 << 20) // (kib << 10))
+            # cold publish: a fresh hash each time = one real write
+            t0 = time.perf_counter()
+            for i in range(8):
+                h = (kib * 1000 + i).to_bytes(32, "big")
+                ref = ring.publish(h, payload)
+                assert ref is not None
+            publish_dt = (time.perf_counter() - t0) / 8
+            # hot forward: same block served over and over — publish
+            # degrades to a slot-reuse lookup, get maps the view
+            h = (kib * 1000).to_bytes(32, "big")
+            t0 = time.perf_counter()
+            for _ in range(n_iter):
+                ref = ring.publish(h, payload)
+                mv = reader.get(ref, h)
+                assert mv is not None and mv.nbytes == len(payload)
+            shm_dt = (time.perf_counter() - t0) / n_iter
+            out[f"shm_publish_{kib}k_us"] = round(publish_dt * 1e6, 1)
+            # socket: the payload crosses a loopback socketpair
+            a, b = socketmod.socketpair()
+            try:
+                a.setsockopt(socketmod.SOL_SOCKET,
+                             socketmod.SO_SNDBUF, 4 << 20)
+                b.setsockopt(socketmod.SOL_SOCKET,
+                             socketmod.SO_RCVBUF, 4 << 20)
+                buf = bytearray(len(payload))
+
+                def pump_one():
+                    view = memoryview(buf)
+                    got = 0
+                    while got < len(payload):
+                        got += b.recv_into(view[got:], len(payload) - got)
+
+                import concurrent.futures as cf
+
+                with cf.ThreadPoolExecutor(1) as pool:
+                    t0 = time.perf_counter()
+                    for _ in range(n_iter):
+                        fut = pool.submit(pump_one)
+                        a.sendall(payload)
+                        fut.result()
+                    sock_dt = (time.perf_counter() - t0) / n_iter
+            finally:
+                a.close()
+                b.close()
+            out[f"shm_forward_{kib}k_us"] = round(shm_dt * 1e6, 1)
+            out[f"shm_socket_{kib}k_us"] = round(sock_dt * 1e6, 1)
+            out[f"shm_vs_socket_{kib}k"] = round(
+                sock_dt / max(shm_dt, 1e-9), 2)
+        ring.close()
+        return out
+
+    try:
+        res = asyncio.run(asyncio.wait_for(scenario(), 300))
+        res.update(shm_vs_socket())
+        return res
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_resize(n_nodes: int = 16, nobj: int = 48, obj_kib: int = 256,
                  leg_s: float = 5.0) -> dict:
     """Zero-downtime cluster resize economics (ISSUE 6): foreground
@@ -2011,6 +2245,15 @@ def main() -> None:
         extra.update(bench_gateway())
     except Exception as e:
         extra["gateway_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # cluster cache tier (ISSUE 15): cluster hot-GET throughput and
+    # decode dedup (tier on vs node-local baseline), remote-hit vs
+    # cold-decode latency, hint-gossip convergence, shm-vs-socket
+    # forward latency
+    try:
+        extra.update(bench_cache_tier())
+    except Exception as e:
+        extra["cache_tier_error"] = f"{type(e).__name__}: {e}"[:300]
     if platform == "cpu":
         maybe_reexec_on_device()
 
@@ -2088,6 +2331,25 @@ if __name__ == "__main__":
             "metric": "bench_metadata",
             **bench_metadata(keys=a.keys,
                              engines=tuple(a.engines.split(","))),
+        }), flush=True)
+        os._exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "bench_cache_tier":
+        # standalone scenario (nightly soak / operator runs):
+        # python bench.py bench_cache_tier --nblocks 24 --nodes 6
+        import argparse
+
+        ap = argparse.ArgumentParser()
+        ap.add_argument("cmd")
+        ap.add_argument("--nblocks", type=int, default=12)
+        ap.add_argument("--block-kib", type=int, default=512)
+        ap.add_argument("--rounds", type=int, default=4)
+        ap.add_argument("--nodes", type=int, default=6)
+        a = ap.parse_args()
+        print(json.dumps({
+            "metric": "bench_cache_tier",
+            **bench_cache_tier(nblocks=a.nblocks,
+                               block_kib=a.block_kib,
+                               rounds=a.rounds, nodes=a.nodes),
         }), flush=True)
         os._exit(0)
     if len(sys.argv) > 1 and sys.argv[1] == "bench_gateway":

@@ -37,7 +37,13 @@ def apply_s3_tuning(garage, spec: dict) -> dict:
     cfg = garage.config
     cache = garage.block_manager.cache
     feeder = garage.block_manager.feeder
+    tier = getattr(garage.block_manager, "cache_tier", None)
     bounds = {"get_readahead_blocks": (0, 64),
+              # cluster cache tier (block/cache_tier.py): runtime
+              # on/off + hint breadth, so an operator can shed the
+              # tier under incident pressure without a restart
+              "cache_tier": (0, 1),
+              "cache_tier_hint_top_n": (1, 256),
               "put_blocks_max_parallel": (1, 64),
               # hot-block read cache (block/cache.py): size + admission
               # knobs, live-resizable so bench sweeps flip the cache
@@ -58,6 +64,10 @@ def apply_s3_tuning(garage, spec: dict) -> dict:
     for k, raw in spec.items():
         if k not in bounds:
             raise BadRequest(f"unknown s3 tuning knob {k!r}")
+        if k.startswith("cache_tier") and tier is None:
+            raise BadRequest(
+                "cache tier is disabled in config "
+                "([block] cache_tier = false); restart to enable")
         lo, hi = bounds[k]
         v = int(raw)
         if v < lo or v > hi:
@@ -69,6 +79,10 @@ def apply_s3_tuning(garage, spec: dict) -> dict:
             cache.configure(max_bytes=v)
         elif k == "read_cache_probation_pct":
             cache.configure(probation_pct=v)
+        elif k == "cache_tier":
+            tier.enabled = bool(v)
+        elif k == "cache_tier_hint_top_n":
+            tier.hint_top_n = v
         elif k.startswith("feeder_"):
             setattr(feeder, k[len("feeder_"):], v)
         else:
@@ -89,6 +103,10 @@ def s3_tuning_state(garage) -> dict:
         "read_cache_max_bytes": cache.max_bytes,
         "read_cache_probation_pct": cache.probation_pct,
         "read_cache": cache.stats(),
+        "cache_tier": (garage.block_manager.cache_tier.stats()
+                       if getattr(garage.block_manager, "cache_tier",
+                                  None) is not None
+                       else {"enabled": False}),
         "feeder_inflight_batches": feeder.inflight_batches,
         "feeder_device_min_bytes": feeder.device_min_bytes,
         "feeder_device_min_items": feeder.device_min_items,
@@ -386,6 +404,57 @@ class AdminHttpServer:
             return _json({"engine": engine, "tables": tables,
                           "compaction": maintenance,
                           "resize_phase_seconds": phases})
+        if path == "/v1/resize" and m == "GET":
+            # operator progress readout for a live layout transition
+            # (ISSUE 15 satellite; PR 6 follow-on): phases with timings
+            # (from the resize_phase_seconds series the orchestrator
+            # records), per-node ack/sync trackers with the LAGGING
+            # nodes named per phase, and the rebalance backlog — one
+            # call answers "how far along is the resize and who is
+            # holding it up".
+            g = self.garage
+            hist = g.system.layout_manager.history
+            helper = g.system.layout_manager.helper
+            current = hist.current().version
+            min_stored = hist.min_stored()
+            trackers = hist.update_trackers
+            nodes = []
+            for n in sorted(hist.all_storage_nodes()):
+                ack = trackers.ack.get(n, min_stored)
+                sync = trackers.sync.get(n, min_stored)
+                sync_ack = trackers.sync_ack.get(n, min_stored)
+                lagging = [ph for ph, v in (("ack", ack),
+                                            ("sync", sync),
+                                            ("commit", sync_ack))
+                           if v < current]
+                nodes.append({"node": n.hex()[:16], "ack": ack,
+                              "sync": sync, "sync_ack": sync_ack,
+                              "lagging": lagging})
+            from ..utils.metrics import registry as _reg
+
+            phases = {}
+            for labels, count, total, mx in _reg().series(
+                    "resize_phase_seconds"):
+                phases[labels.get("phase", "?")] = {
+                    "count": count, "total_s": round(total, 3),
+                    "max_s": round(mx, 3)}
+            completed = sum(
+                c for _l, c, _t, _m in _reg().series(
+                    "resize_transitions_completed"))
+            res = g.block_manager.resync
+            return _json({
+                "layout_version": current,
+                "min_stored": min_stored,
+                "ack_min": helper.ack_map_min(),
+                "sync_min": helper.sync_map_min(),
+                "resizing": min_stored < current,
+                "phases": phases,
+                "transitions_completed": completed,
+                "nodes": nodes,
+                "rebalance_backlog": res.queue_len(),
+                "rebalance_errors": res.errors_len(),
+            })
+
         if path == "/v1/qos" and m == "GET":
             return _json(self._qos_state())
         if path == "/v1/qos" and m == "POST":
@@ -786,6 +855,24 @@ class AdminHttpServer:
         out.append("# TYPE cache_hits counter")
         for k, v in g.block_manager.cache.stats().items():
             gauge(f"cache_{k}", v)
+        # cluster cache tier (block/cache_tier.py): probe economics +
+        # hint-gossip visibility; cache_tier_enabled is the smoke
+        # assertion that the tier plane exists
+        tier = getattr(g.block_manager, "cache_tier", None)
+        gauge("cache_tier_enabled",
+              1 if tier is not None and tier.enabled else 0,
+              "Whether the cluster-wide cache tier is active")
+        if tier is not None:
+            ts = tier.stats()
+            gauge("cache_tier_members", ts["members"])
+            gauge("cache_tier_probes", ts["probes"])
+            gauge("cache_tier_probe_hits", ts["probe_hits"])
+            gauge("cache_tier_probe_misses", ts["probe_misses"])
+            gauge("cache_tier_probe_fails", ts["probe_fails"])
+            gauge("cache_tier_remote_hit_bytes", ts["remote_hit_bytes"])
+            gauge("cache_tier_inserts_pushed", ts["inserts_pushed"])
+            gauge("cache_tier_hints_known", ts["hints_known"])
+            gauge("cache_tier_hints_seen", ts["hints_seen"])
         sw = g.block_manager.scrub_worker
         if sw is not None:
             out.append("# HELP block_scrub_corruptions "

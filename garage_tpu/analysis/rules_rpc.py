@@ -221,7 +221,19 @@ class HedgeOnMutation(Rule):
 GL03_DIRS = re.compile(r"(^|/)(api/s3|block|gateway)/")
 SSE_NAME_RE = re.compile(r"(^|_)sse", re.IGNORECASE)
 CACHE_SEAM = {"rpc_get_block", "rpc_put_block"}
+# the CLUSTER cache tier's cross-node seam (ISSUE 15,
+# block/cache_tier.py): `probe` on a tier/cache receiver must carry the
+# same explicit cacheable= audit flag as the rpc_get/put_block seam —
+# an SSE-C hash must never even be ASKED about across nodes — and
+# `insert_at` is a cache-insert sink like `.insert`
+TIER_PROBE_NAMES = {"probe", "cache_tier_probe"}
+CACHE_INSERT_NAMES = {"insert", "insert_at", "cache_tier_insert"}
 _SSEISH = ("<sse>", "<decrypt>")
+
+
+def _cacheish_recv(recv) -> bool:
+    return any("cache" in s.lower() or "tier" in s.lower()
+               for s in recv)
 
 
 class SsecCacheLeak(Rule):
@@ -310,7 +322,9 @@ class SsecCacheLeak(Rule):
                     origin = f" ({tainted[(fid, p)]})"
                     break
             for rec in fn["calls"]:
-                if rec["name"] in CACHE_SEAM \
+                if (rec["name"] in CACHE_SEAM
+                        or (rec["name"] in TIER_PROBE_NAMES
+                            and _cacheish_recv(rec["recv"]))) \
                         and "cacheable" not in rec["kwargs"]:
                     v = Violation(
                         rule=self.id, path=fn["path"], line=rec["line"],
@@ -319,14 +333,14 @@ class SsecCacheLeak(Rule):
                             f"`{rec['name']}` in an SSE-C scope without "
                             "explicit cacheable=; pass cacheable="
                             "(sse_key is None) so encrypted payloads "
-                            f"never enter the read cache{origin}"),
+                            "never enter the read cache (or cross a "
+                            f"node on the tier probe){origin}"),
                         context=fn["qualname"])
                     v._end_line = rec.get("end_line")  # type: ignore
                     out.append(v)
                     continue
-                if rec["name"] == "insert" \
-                        and any("cache" in s.lower()
-                                for s in rec["recv"]):
+                if rec["name"] in CACHE_INSERT_NAMES \
+                        and _cacheish_recv(rec["recv"]):
                     hot = set()
                     for desc in list(rec["args"]) + \
                             list(rec["kw"].values()):

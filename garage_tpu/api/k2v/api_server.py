@@ -65,6 +65,13 @@ class K2VApiServer:
         await self.http.stop()
 
     async def handle(self, req: Request) -> Response:
+        # claimed key id = per-key fairness identity for the global
+        # request-rate DRR (same discipline as the S3 frontend; reset
+        # per keep-alive request so identity never leaks across)
+        from ...qos.limiter import CURRENT_QOS_KEY
+        from ..signature import claimed_key_id
+
+        qos_key_token = CURRENT_QOS_KEY.set(claimed_key_id(req))
         try:
             # same two-stage qos admission as the S3 frontend: global
             # (cheap, pre-auth) here, per-key/per-bucket in _handle
@@ -89,6 +96,8 @@ class K2VApiServer:
             return json_error("NoSuchKey", 404, str(e))
         except BadRequest as e:
             return json_error("InvalidRequest", 400, str(e))
+        finally:
+            CURRENT_QOS_KEY.reset(qos_key_token)
 
     async def _handle(self, req: Request) -> Response:
         verified = await verify_request(req, self.region,
@@ -106,6 +115,11 @@ class K2VApiServer:
         if qos is not None:
             await qos.admit_scoped(key_id=api_key.key_id,
                                    bucket=bucket_name)
+        # fairness identity for downstream byte charges, now VERIFIED
+        # (reset by handle() per request)
+        from ...qos.limiter import CURRENT_QOS_KEY
+
+        CURRENT_QOS_KEY.set(api_key.key_id)
 
         bucket_id = await self.helper.resolve_global_bucket_name(bucket_name)
         if bucket_id is None:

@@ -15,7 +15,7 @@ from ...utils.error import (BadRequest, NoSuchBucket, NoSuchKey,
                             QuorumError)
 from ..http import HttpError, HttpServer, Request, Response
 from ...qos.limiter import CURRENT_QOS_KEY, SlowDown
-from ..signature import verify_request, wrap_body
+from ..signature import claimed_key_id, verify_request, wrap_body
 from . import bucket as bucket_handlers
 from . import delete as delete_handlers
 from . import get as get_handlers
@@ -95,8 +95,11 @@ class S3ApiServer:
 
     async def handle(self, req: Request) -> Response:
         # one conn task serves many keep-alive requests: the fairness
-        # key must never leak from one request into the next
-        qos_key_token = CURRENT_QOS_KEY.set(None)
+        # key must never leak from one request into the next. Seeded
+        # with the CLAIMED key id (no crypto) so the global request-
+        # rate DRR can queue fairly BEFORE SigV4 runs; replaced by the
+        # verified id once auth resolves.
+        qos_key_token = CURRENT_QOS_KEY.set(claimed_key_id(req))
         try:
             # global admission (qos/): requests/s + declared body bytes
             # + bounded concurrency, BEFORE SigV4 — shedding must stay

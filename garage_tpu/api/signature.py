@@ -119,6 +119,26 @@ class VerifiedRequest:
         self.presigned = presigned
 
 
+def claimed_key_id(req: Request) -> Optional[str]:
+    """The key id the request CLAIMS, parsed without any crypto — the
+    per-key FAIRNESS identity (qos deficit round-robin) available
+    BEFORE SigV4 runs. A forged claim only picks which fair queue the
+    request waits in (and a flood under someone else's id shares that
+    id's 1/K slice — strictly worse for the attacker than spreading
+    out); authorization always uses the VERIFIED identity resolved
+    after signature check."""
+    auth = req.header("authorization")
+    if auth and "Credential=" in auth:
+        cred = auth.split("Credential=", 1)[1].split(",", 1)[0]
+        kid = cred.strip().split("/", 1)[0]
+        return kid or None
+    cred = req.query.get("X-Amz-Credential")
+    if cred:
+        kid = unquote(cred).split("/", 1)[0]
+        return kid or None
+    return None
+
+
 async def verify_request(req: Request, region: str, lookup_secret,
                          service: str = SERVICE
                          ) -> Optional[VerifiedRequest]:

@@ -57,6 +57,11 @@ class BlockCache:
         self._prot: OrderedDict[bytes, bytes] = OrderedDict()
         self._prob_bytes = 0
         self._prot_bytes = 0
+        # per-key hit counts for entries currently IN the cache — the
+        # hot-hash hint source (cache_tier.py gossips the top-N over
+        # peering pings). Bounded by construction: an entry leaves the
+        # map when it leaves the cache.
+        self._hits_by_key: dict[bytes, int] = {}
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -100,6 +105,8 @@ class BlockCache:
                 self._prot.move_to_end(hash32)
                 self.hits += 1
                 self.hit_bytes += len(data)
+                self._hits_by_key[hash32] = \
+                    self._hits_by_key.get(hash32, 0) + 1
                 return data
             data = self._prob.pop(hash32, None)
             if data is not None:
@@ -109,9 +116,21 @@ class BlockCache:
                 self._shed_protected()
                 self.hits += 1
                 self.hit_bytes += len(data)
+                self._hits_by_key[hash32] = \
+                    self._hits_by_key.get(hash32, 0) + 1
                 return data
             self.misses += 1
             return None
+
+    def top_keys(self, n: int) -> list[bytes]:
+        """The n hottest cached hashes by hit count (hint gossip
+        payload). Only actually-hot entries qualify — a key with no
+        second touch is noise, not a hint."""
+        import heapq
+
+        with self._lock:
+            return heapq.nlargest(n, self._hits_by_key,
+                                  key=self._hits_by_key.get)
 
     def insert(self, hash32: bytes, data) -> None:
         """Admit into probation (read-miss fill and PUT write-through
@@ -136,6 +155,7 @@ class BlockCache:
         """Explicit purge (delete_local / rc decref): a ghost of a
         deleted block must not pin RAM."""
         with self._lock:
+            self._hits_by_key.pop(hash32, None)
             data = self._prob.pop(hash32, None)
             if data is not None:
                 self._prob_bytes -= len(data)
@@ -148,6 +168,7 @@ class BlockCache:
         with self._lock:
             self._prob.clear()
             self._prot.clear()
+            self._hits_by_key.clear()
             self._prob_bytes = self._prot_bytes = 0
 
     # ---- internals (lock held) -----------------------------------------
@@ -166,13 +187,15 @@ class BlockCache:
         budget itself shrank below the protected segment."""
         while self._prob_bytes + self._prot_bytes > self.max_bytes \
                 and self._prob:
-            _, data = self._prob.popitem(last=False)
+            h, data = self._prob.popitem(last=False)
             self._prob_bytes -= len(data)
+            self._hits_by_key.pop(h, None)
             self.evictions += 1
         while self._prob_bytes + self._prot_bytes > self.max_bytes \
                 and self._prot:
-            _, data = self._prot.popitem(last=False)
+            h, data = self._prot.popitem(last=False)
             self._prot_bytes -= len(data)
+            self._hits_by_key.pop(h, None)
             self.evictions += 1
 
     # ---- surface -------------------------------------------------------

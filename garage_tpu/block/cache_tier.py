@@ -1,0 +1,294 @@
+"""Cluster-wide read cache tier (ISSUE 15): turn N node-local decoded-
+block caches into ONE cluster cache.
+
+PR 3 gave every node a decoded-block cache and PR 8 sharded it across
+gateway workers — but both stop at the process/node boundary, so N
+cluster nodes still pay N cold erasure decodes (k-shard gather +
+GF(2^8) matmul + verify) for the same hot block and hold N duplicate
+copies. This module is the cross-NODE lane:
+
+  * **Owner routing** — every cacheable block hash has one OWNER node,
+    chosen by rendezvous hashing (gateway/ring.py's weight function,
+    shared so the worker and cluster layers can never disagree) over
+    the layout-derived storage-node roster, FILTERED through the shared
+    PeerHealthTracker: a node whose circuit breaker is open drops out
+    of the ring, so a degraded owner remaps its share to the
+    next-highest weight instead of blackholing reads (Karger et al.,
+    "Web Caching with Consistent Hashing").
+  * **Single-hop probe** — a non-owner read first issues
+    `rpc_cache_probe` to the owner: a read-only, hedge-safe op that
+    answers from the owner's RAM cache and NEVER touches the store
+    (one hop by construction, no probe chains). A hit returns the
+    decoded payload — zero shard gathers and zero decodes anywhere in
+    the cluster — verified against the content address before it is
+    served, the same end-to-end integrity rule as every other remote
+    read. A miss (or an unreachable owner) falls back to today's local
+    path, and the decoded result is then write-through-inserted AT THE
+    OWNER (`rpc_cache_insert`, background, bounded in flight) so the
+    next reader cluster-wide wins. Non-owners do not fill their local
+    cache — one decoded copy per cluster, not per node.
+  * **Hot-hash hints** — each node's top-N cache keys by hit count
+    (BlockCache.top_keys) piggyback on the existing peering pings
+    (net/peering.py hint hooks; ~32 B per hash, bounded both ways).
+    The hint set tells BACKGROUND readers which blocks are worth a
+    probe: resync's replicate fetches route through the tier only for
+    hinted-hot hashes, so a rebalance enumeration of a million cold
+    blocks never sprays a million wasted probe RPCs (the
+    lease/hint-based hot-set placement shape of Nishtala et al.,
+    NSDI'13).
+
+What deliberately does NOT route through the tier: SSE-C payloads
+(`cacheable=False` skips lookup, probe and insert end to end — the
+GL03 taint rule audits the `cache_tier_probe`/`cache_tier_insert`
+seam); erasure SHARD rebuilds (the tier holds decoded plaintext, and
+re-deriving exact stripe bytes would require byte-deterministic
+recompression — a rebuilt shard must match its stripe-mates exactly);
+and scrub (its whole job is to touch the disks the cache exists to
+avoid).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import OrderedDict
+from typing import Optional
+
+from ..gateway.ring import rendezvous_owner
+from ..net.message import PRIO_BACKGROUND, PRIO_NORMAL
+from ..utils.metrics import registry
+
+log = logging.getLogger("garage_tpu.block.cache_tier")
+
+# hints remembered per node (hash -> last-seen monotonic time); beyond
+# this the oldest hint is dropped — an attacker-spun key space must not
+# grow this map without bound
+HINT_MAX = 1024
+HINT_TTL_S = 120.0
+# hashes carried per ping (outbound) and accepted per ping (inbound)
+HINT_TOP_N = 16
+HINT_ACCEPT_MAX = 64
+# a probe is a RAM lookup plus one payload transfer; the flat budget
+# is deliberately TIGHT so a blackholed owner (no RST, packets
+# dropped) costs foreground GETs seconds — not tens of seconds — for
+# the handful of failures it takes to open its breaker and drop it
+# out of the ring. The rpc helper's adaptive per-peer timeout
+# (clamp(p99*4), floor 1 s) tightens under this once samples exist; a
+# legitimately slow transfer that gets cut off just falls back to the
+# local decode path, which is the safe direction.
+PROBE_TIMEOUT_S = 2.0
+# concurrent background owner-insert pushes; beyond this the push is
+# skipped (the next reader warms the owner instead) — a decode burst
+# must not turn into an unbounded RPC fan-out of MiB-scale payloads
+INSERT_INFLIGHT_MAX = 8
+
+
+class ClusterCacheTier:
+    """Router + hint book installed on BlockManager (`manager.cache_tier`)
+    when `[block] cache_tier` is on and the node has a cluster system."""
+
+    def __init__(self, manager, hint_top_n: int = HINT_TOP_N):
+        self.manager = manager
+        self.enabled = True
+        self.hint_top_n = int(hint_top_n)
+        # hash -> last-seen time, LRU-ordered (move_to_end on refresh)
+        self._hints: "OrderedDict[bytes, float]" = OrderedDict()
+        self._insert_inflight = 0
+        self.probes = 0
+        self.probe_hits = 0
+        self.probe_misses = 0
+        self.probe_fails = 0
+        self.probe_corrupt = 0
+        self.remote_hit_bytes = 0
+        self.inserts_pushed = 0
+        self.insert_skips = 0
+        self.hints_sent = 0
+        self.hints_seen = 0
+
+    # ---- ring -----------------------------------------------------------
+
+    def _health(self):
+        return self.manager.rpc.health()
+
+    def members(self) -> list[bytes]:
+        """Live ring membership: the current layout's storage nodes,
+        minus open-breaker peers (a degraded owner drops OUT of the
+        ring — its share remaps — instead of blackholing probes).
+        Breaker state is a local observation, so two nodes can briefly
+        disagree on ownership while a breaker is open; the tier is a
+        cache, so the cost is a duplicate fill, never a wrong answer."""
+        system = self.manager.system
+        nodes = sorted(
+            system.layout_helper.current().storage_nodes())
+        health = self._health()
+        if health is None:
+            return nodes
+        me = system.id
+        now = time.monotonic()
+        return [n for n in nodes
+                if n == me or health.breaker_state(n, now) != "open"]
+
+    def owner_of(self, hash32: bytes) -> Optional[bytes]:
+        """Remote owner to probe, or None when this node should serve
+        locally (it owns the hash, routing is moot, or the tier is
+        off). A node OUTSIDE the roster (gateway worker, draining node)
+        still probes owners — it just never owns anything itself."""
+        if not self.enabled or self.manager.cache.max_bytes <= 0:
+            return None
+        members = self.members()
+        me = self.manager.system.id
+        if not members or (len(members) == 1 and members[0] == me):
+            return None
+        owner = rendezvous_owner(members, hash32)
+        if owner is None or owner == me:
+            return None
+        return owner
+
+    def owns(self, hash32: bytes) -> bool:
+        """Whether this node should hold the cached copy (True when
+        routing is moot — an unrouted cache owns everything it sees)."""
+        if not self.enabled or self.manager.cache.max_bytes <= 0:
+            return True
+        members = self.members()
+        if len(members) < 2:
+            return True
+        owner = rendezvous_owner(members, hash32)
+        return owner is None or owner == self.manager.system.id
+
+    # ---- probe / insert (the cross-node seam) ---------------------------
+
+    async def probe(self, owner: bytes, hash32: bytes,
+                    cacheable: bool = True) -> Optional[bytes]:
+        """Single-hop read-only probe of the owner's cache; -> decoded
+        payload (content-verified) or None (miss / owner unreachable /
+        failed verification). Never raises: a tier failure must degrade
+        to the local path, not fail the read. `cacheable` is the same
+        GL03 audit flag as the rpc_get_block seam — SSE-C state must
+        pass cacheable=False, which makes the probe a no-op (an SSE-C
+        hash is never even ASKED about across nodes)."""
+        if not cacheable:
+            return None
+        self.probes += 1
+        m = self.manager
+        try:
+            resp = await m.rpc.call(
+                m.endpoint, owner,
+                {"op": "cache_probe", "hash": hash32},
+                PRIO_NORMAL, timeout=PROBE_TIMEOUT_S)
+            data = resp.get("data") if isinstance(resp, dict) else None
+        except Exception as e:
+            self.probe_fails += 1
+            registry().inc("cache_tier_probe_fail")
+            log.debug("cache probe of %s at %s failed: %s",
+                      hash32[:4].hex(), owner[:4].hex(), e)
+            return None
+        if data is None:
+            self.probe_misses += 1
+            registry().inc("cache_tier_probe_miss")
+            return None
+        # end-to-end integrity: a remote payload is served only after
+        # it re-derives the content address (the store read paths all
+        # verify remote bytes; the tier must not be the one lane that
+        # trusts the wire). content_hash_matches tolerates the legacy
+        # algo exactly like DataBlock.verify; off-loop — MiB-scale
+        # hashing must not stall sibling requests.
+        from ..utils.data import content_hash_matches
+
+        if not await asyncio.to_thread(content_hash_matches, data,
+                                       hash32):
+            self.probe_corrupt += 1
+            registry().inc("cache_tier_probe_corrupt")
+            log.warning("cache probe of %s at %s returned corrupt "
+                        "payload; falling back to the store",
+                        hash32[:4].hex(), owner[:4].hex())
+            return None
+        self.probe_hits += 1
+        self.remote_hit_bytes += len(data)
+        registry().inc("cache_tier_probe_hit")
+        registry().inc("cache_tier_remote_hit_bytes", len(data))
+        return data
+
+    def insert_at(self, owner: bytes, hash32: bytes, data) -> None:
+        """Write-through at the owner after a local miss-decode: fire a
+        bounded background push so the NEXT reader — on any node —
+        probe-hits instead of re-decoding. Never blocks the caller."""
+        if self._insert_inflight >= INSERT_INFLIGHT_MAX:
+            self.insert_skips += 1
+            return
+        self._insert_inflight += 1
+        from ..utils.background import spawn
+
+        spawn(self._push_insert(owner, hash32, data),
+              "cache-tier-insert")
+
+    async def _push_insert(self, owner: bytes, hash32: bytes,
+                           data) -> None:
+        # background lane: a MiB-scale push over a slow link may
+        # legitimately outlive the tight foreground probe budget
+        m = self.manager
+        try:
+            await m.endpoint.call(
+                owner, {"op": "cache_insert", "hash": hash32,
+                        "data": data},
+                PRIO_BACKGROUND, timeout=15.0)
+            self.inserts_pushed += 1
+            registry().inc("cache_tier_insert_push")
+        except Exception as e:
+            log.debug("cache insert push of %s to %s failed: %s",
+                      hash32[:4].hex(), owner[:4].hex(), e)
+        finally:
+            self._insert_inflight -= 1
+
+    # ---- hot-hash hints (peering ping piggyback) ------------------------
+
+    def hot_hashes(self) -> list[bytes]:
+        """Outbound hint payload: this node's hottest cached hashes."""
+        out = self.manager.cache.top_keys(self.hint_top_n) \
+            if self.enabled else []
+        self.hints_sent += len(out)
+        return out
+
+    def note_hints(self, from_node: bytes, hashes) -> None:
+        """Inbound hints from a peer's ping. Bounded both ways: at most
+        HINT_ACCEPT_MAX per ping, at most HINT_MAX remembered."""
+        now = time.monotonic()
+        for h in list(hashes)[:HINT_ACCEPT_MAX]:
+            if not isinstance(h, bytes) or len(h) != 32:
+                continue
+            self._hints[h] = now
+            self._hints.move_to_end(h)
+            self.hints_seen += 1
+        while len(self._hints) > HINT_MAX:
+            self._hints.popitem(last=False)
+
+    def is_hot(self, hash32: bytes) -> bool:
+        """Whether any peer recently advertised hash32 as hot — the
+        gate background reads (resync fetches) use before spending a
+        probe RPC on a block that is overwhelmingly likely cold."""
+        t = self._hints.get(hash32)
+        if t is None:
+            return False
+        if time.monotonic() - t > HINT_TTL_S:
+            del self._hints[hash32]
+            return False
+        return True
+
+    # ---- surface --------------------------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "members": len(self.members()),
+            "hints_known": len(self._hints),
+            "hint_top_n": self.hint_top_n,
+            "probes": self.probes,
+            "probe_hits": self.probe_hits,
+            "probe_misses": self.probe_misses,
+            "probe_fails": self.probe_fails,
+            "probe_corrupt": self.probe_corrupt,
+            "remote_hit_bytes": self.remote_hit_bytes,
+            "inserts_pushed": self.inserts_pushed,
+            "insert_skips": self.insert_skips,
+            "hints_seen": self.hints_seen,
+        }

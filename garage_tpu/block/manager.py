@@ -178,7 +178,9 @@ class BlockManager:
                  tpu_cfg=None,
                  ram_buffer_max: int = 256 * 1024 * 1024,
                  read_cache_max_bytes: Optional[int] = None,
-                 resync_breaker_aware: bool = True):
+                 resync_breaker_aware: bool = True,
+                 cache_tier: bool = True,
+                 cache_tier_hint_top_n: int = 16):
         self.system = system
         self.db = db
         self.data_layout = data_layout
@@ -231,6 +233,24 @@ class BlockManager:
         self.endpoint = system.netapp.endpoint("garage_tpu/block").set_handler(
             self._handle
         )
+        # CLUSTER cache tier (block/cache_tier.py, ISSUE 15): rendezvous
+        # owner routing over the layout's storage-node roster, breaker-
+        # filtered; non-owner reads probe the owner's cache in one hop
+        # and warm it on miss, so the cluster pays ~1 decode per hot
+        # block instead of 1 per node. `[block] cache_tier = false`
+        # kills the lane (every read serves node-locally as before).
+        self.cache_tier = None
+        peering = getattr(system, "peering", None)
+        if cache_tier and peering is not None:
+            from .cache_tier import ClusterCacheTier
+
+            self.cache_tier = ClusterCacheTier(
+                self, hint_top_n=cache_tier_hint_top_n)
+            # hot-hash hints ride the existing peering pings: the
+            # peering layer stays block-agnostic (plain callables), the
+            # tier decides what is hot and what a hint means
+            peering.hint_provider = self.cache_tier.hot_hashes
+            peering.hint_sink = self.cache_tier.note_hints
         from .resync import BlockResyncManager
 
         self.resync = BlockResyncManager(
@@ -240,7 +260,12 @@ class BlockManager:
         self.scrub_worker = None
         self.metrics = {"bytes_read": 0, "bytes_written": 0,
                         "corruptions": 0, "resync_sent": 0,
-                        "resync_recv": 0, "resync_bytes": 0}
+                        "resync_recv": 0, "resync_bytes": 0,
+                        # full store reads (gather+decode / disk+verify)
+                        # — what the cluster cache tier exists to
+                        # dedupe; bench_cache_tier sums this across
+                        # nodes to prove "~1 decode per hot block"
+                        "store_reads": 0}
         # layout-transition participation (ISSUE 6): a new layout
         # version means every block held or needed here must be
         # re-examined (fetch what moved in, offload what moved away),
@@ -352,14 +377,29 @@ class BlockManager:
             # payload rpc_get_block returns. SSE-C callers pass
             # cacheable=False — never cache payloads the node cannot
             # re-derive without the client's key. Under a sharded
-            # gateway cache only the OWNER worker keeps the copy (a
+            # gateway cache only the OWNER worker keeps the copy, and
+            # under the CLUSTER tier only the owner NODE does (a
             # non-owner write-through would recreate the N-duplicates
-            # problem the sharding exists to kill; the owner fills on
-            # first read instead).
-            if cacheable and (self.cache_router is None
-                              or self.cache_router.owns(hash32)):
-                # lint: ignore[GL03] guarded by the cacheable= audit flag itself: SSE-C callers pass cacheable=False (pinned by conformance tests), so tainted payloads never reach this insert
-                self.cache.insert(hash32, data)
+            # problem the routing exists to kill): a non-owner PUT
+            # warms the cluster owner with a bounded background push
+            # instead of filling its own cache.
+            if cacheable:
+                tier = getattr(self, "cache_tier", None)
+                tier_owner = (tier.owner_of(hash32)
+                              if tier is not None else None)
+                if tier_owner is not None:
+                    # lint: ignore[GL03] guarded by the cacheable= audit flag itself: SSE-C callers pass cacheable=False (pinned by conformance tests), so tainted payloads never reach the tier push
+                    self.cache_tier.insert_at(tier_owner, hash32, data)
+                # a storage node that is not the cluster owner keeps no
+                # local copy; gateway workers (cache_router set) keep
+                # their worker-sharded node-level copy regardless —
+                # the frontend L1 under the cluster tier's L2
+                if (tier_owner is None
+                        or self.cache_router is not None) and (
+                        self.cache_router is None
+                        or self.cache_router.owns(hash32)):
+                    # lint: ignore[GL03] guarded by the cacheable= audit flag itself: SSE-C callers pass cacheable=False (pinned by conformance tests), so tainted payloads never reach this insert
+                    self.cache.insert(hash32, data)
         finally:
             self._ram_sem.release(len(data))
 
@@ -443,11 +483,16 @@ class BlockManager:
 
         `route=False` serves locally even when a gateway cache router
         is installed (the owner-side handler of a forwarded read uses
-        it — one hop, never a chain). `charge=False` skips the qos byte
-        charge (the FORWARDING worker charges its own lease for bytes
-        it serves to its client; the owner must not double-charge)."""
+        it — one hop, never a chain; the CLUSTER tier probe below is a
+        different layer and stays live, so a worker serving a sibling's
+        forward still exploits the cluster owner's cache). `charge=False`
+        skips the qos byte charge (the FORWARDING worker charges its
+        own lease for bytes it serves to its client; the owner must not
+        double-charge)."""
         charge_fn = self.read_qos_charge if charge else None
         fill = cacheable
+        tier = None
+        tier_owner = None
         if cacheable:
             data = self.cache.get(hash32)
             if data is not None:
@@ -471,9 +516,42 @@ class BlockManager:
                     # WITHOUT filling our cache — a transient forward
                     # failure must not seed duplicate copies
                     fill = False
+            # cluster cache tier (block/cache_tier.py): a non-owner
+            # read probes the block's owner NODE in one hedge-safe hop
+            # — a hit is the whole point of the tier (zero gathers,
+            # zero decodes anywhere); a miss or open-breaker owner
+            # falls through to today's local path, and the decoded
+            # result warms the owner below. SSE-C never reaches this
+            # probe: cacheable=False skips the enclosing branch.
+            tier = getattr(self, "cache_tier", None)
+            if tier is not None:
+                tier_owner = tier.owner_of(hash32)
+                if tier_owner is not None:
+                    data = await tier.probe(tier_owner, hash32,
+                                            cacheable=cacheable)
+                    if data is not None:
+                        if charge_fn is not None:
+                            await charge_fn(len(data))
+                        return data
+                    if self.cache_router is None:
+                        # storage node: one decoded copy per CLUSTER —
+                        # the owner gets the write-through, this node
+                        # does not keep one. Gateway WORKERS keep their
+                        # worker-sharded node-level copy (the frontend
+                        # L1; the cluster tier is its L2) — without it
+                        # every hot forward would re-probe the storage
+                        # owner over loopback.
+                        fill = False
         data = await self._get_uncached(hash32)
         if fill:
+            # lint: ignore[GL03] guarded by the cacheable= audit flag: fill is only ever True inside the cacheable branch, and SSE-C callers pass cacheable=False (pinned by conformance tests)
             self.cache.insert(hash32, data)
+        if tier_owner is not None:
+            # write-through at the owner (bounded background push): the
+            # next reader of this block — on any node — probe-hits
+            # instead of paying another gather+decode
+            # lint: ignore[GL03] guarded by the cacheable= audit flag: tier_owner is only resolved inside the cacheable branch, so SSE-C payloads never reach the tier push
+            tier.insert_at(tier_owner, hash32, data)
         if charge_fn is not None:
             # charged symmetrically with the hit path above: a byte
             # budget that only priced one of RAM/store reads would
@@ -482,6 +560,7 @@ class BlockManager:
         return data
 
     async def _get_uncached(self, hash32: bytes) -> bytes:
+        self.metrics["store_reads"] += 1
         if self.erasure:
             # verification happens inside: a decode is retried against
             # every distinct packed_len candidate before giving up
@@ -1094,4 +1173,38 @@ class BlockManager:
         if op == "need":
             needed = await asyncio.to_thread(self.is_shard_needed, h)
             return {"needed": needed}
+        if op == "cache_probe":
+            # cluster cache tier (ISSUE 15): read-only, single-hop,
+            # RAM-only — a miss answers None and NEVER falls through to
+            # the store (the prober's local path is the fallback, so a
+            # probe can't chain or amplify). Hedge-safe by construction:
+            # re-asking an idempotent RAM lookup is free.
+            cache = getattr(self, "cache", None)
+            data = cache.get(h) if cache is not None else None
+            if data is not None:
+                registry().inc("cache_tier_serve_hit")
+            else:
+                registry().inc("cache_tier_serve_miss")
+            return {"data": data}
+        if op == "cache_insert":
+            # write-through from a non-owner's miss-decode. Content-
+            # verified before admission: a content-addressed cache must
+            # never hold bytes that don't hash to their key, or every
+            # future probe hit serves corruption with a straight face.
+            cache = getattr(self, "cache", None)
+            if cache is None or cache.max_bytes <= 0:
+                return {"ok": False}
+            data = payload["data"]
+            from ..utils.data import content_hash_matches
+
+            if not await asyncio.to_thread(content_hash_matches,
+                                           data, h):
+                registry().inc("cache_tier_insert_corrupt")
+                log.warning("tier insert of %s from %s failed content "
+                            "verification; dropped", h[:4].hex(),
+                            from_node[:4].hex())
+                return {"ok": False}
+            cache.insert(h, data)
+            registry().inc("cache_tier_insert_served")
+            return {"ok": True}
         raise RpcError(f"unknown block op {op!r}")

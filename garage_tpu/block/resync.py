@@ -450,9 +450,23 @@ class BlockResyncManager:
                    and health.breaker_state(n, now) == "open")
 
     async def _fetch(self, hash32: bytes) -> None:
-        """Needed but absent: get it (ref: resync.rs:462-505)."""
+        """Needed but absent: get it (ref: resync.rs:462-505).
+
+        Replicate fetches of HINTED-HOT blocks route through the
+        cluster cache tier first (ISSUE 15): if a peer's gossiped
+        hot-hash hints say the block is hot, one probe to its cache
+        owner replaces the remote packed read, and the payload is
+        re-packed locally (any compression variant of the right plain
+        bytes is a valid replica — the content address covers the
+        plain bytes). Cold blocks never probe: a rebalance enumeration
+        of the whole store must not spray one wasted RPC per block.
+        Erasure SHARD fetches never ride the tier — a decoded payload
+        cannot reproduce the exact stripe bytes its shard-mates were
+        cut from without byte-deterministic recompression."""
         m = self.manager
         if not m.erasure:
+            if await self._fetch_via_tier(hash32):
+                return
             try:
                 packed, _verified = await m._get_replicate(hash32)
             except Exception:
@@ -486,6 +500,32 @@ class BlockResyncManager:
         self._defer_counts.pop(hash32, None)
         m.metrics["resync_recv"] += 1
         m.metrics["resync_bytes"] += len(raw)
+
+    async def _fetch_via_tier(self, hash32: bytes) -> bool:
+        """Hint-gated tier read for a replicate fetch: True when the
+        block landed locally via the cache tier (probe hit at the
+        owner, content-verified there, re-packed and stored here)."""
+        m = self.manager
+        tier = getattr(m, "cache_tier", None)
+        if tier is None or not tier.is_hot(hash32):
+            return False
+        owner = tier.owner_of(hash32)
+        if owner is None:
+            return False
+        data = await tier.probe(owner, hash32)
+        if data is None:
+            return False
+        from .block import DataBlock
+
+        blk = (await asyncio.to_thread(DataBlock.compress, data)
+               if m.compression else DataBlock.plain(data))
+        await asyncio.to_thread(m.write_local_payload, hash32,
+                                blk.compression, blk.bytes)
+        registry().inc("cache_tier_resync_hits")
+        self._defer_counts.pop(hash32, None)
+        m.metrics["resync_recv"] += 1
+        m.metrics["resync_bytes"] += len(data)
+        return True
 
     async def _fix_shard_placement(self, hash32: bytes) -> None:
         """After a layout change we may hold shard j but be assigned

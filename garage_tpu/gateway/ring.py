@@ -20,6 +20,21 @@ def _weight(member: bytes, hash32: bytes) -> bytes:
     return hashlib.blake2b(member + hash32, digest_size=8).digest()
 
 
+def rendezvous_owner(members, hash32: bytes) -> Optional[bytes]:
+    """Highest-random-weight owner of `hash32` among `members` (any
+    iterable of node ids), or None when empty. Shared by the worker
+    ring below and the CLUSTER cache tier (block/cache_tier.py), so
+    both layers agree on what 'owner' means and a future weighting
+    change cannot drift between them."""
+    best = None
+    best_w = b""
+    for m in members:
+        w = _weight(m, hash32)
+        if best is None or w > best_w:
+            best, best_w = m, w
+    return best
+
+
 class CacheRing:
     def __init__(self, self_id: bytes):
         self.self_id = self_id

@@ -106,6 +106,29 @@ class GatewayWorkerClient:
         self.endpoint = garage.system.netapp.endpoint(
             GATEWAY_RPC_PATH).set_handler(self._handle)
         self.ring = CacheRing(garage.system.id)
+        # zero-copy shm forwards (gateway/shm.py): the owner side
+        # publishes payloads into its ring, the forwarding side maps
+        # every sibling's ring read-only. `[gateway] shm_forwards =
+        # false` is the kill switch — both stay None and every forward
+        # carries bytes over the socket.
+        self.shm = None
+        self.shm_reader = None
+        if getattr(gw_cfg, "shm_forwards", False):
+            from .shm import ShmReader, ShmRing, ring_path
+
+            try:
+                # the worker's own metadata_dir is stable per index and
+                # unique per cluster — exactly the ring-path key a
+                # respawn must reuse and parallel clusters must not
+                # share. Forwarders never derive paths: the reference
+                # in the RPC reply carries the owner's path verbatim.
+                self.shm = ShmRing(
+                    ring_path(garage.config.metadata_dir, index),
+                    gw_cfg.shm_ring_bytes, gw_cfg.shm_lease_s)
+                self.shm_reader = ShmReader()
+            except OSError as e:
+                log.warning("shm forwards disabled (ring create "
+                            "failed): %s", e)
         self.interval = gw_cfg.lease_interval_s
         self.lease: Optional[dict] = None
         self._last_ok = time.monotonic()
@@ -224,6 +247,8 @@ class GatewayWorkerClient:
         self._stopped = True
         if self._renew_task is not None:
             self._renew_task.cancel()
+        if self.shm is not None:
+            self.shm.close()
 
     # ---- cache router (BlockManager.cache_router duck-type) ------------
 
@@ -235,14 +260,35 @@ class GatewayWorkerClient:
 
     async def forward(self, owner: bytes, hash32: bytes):
         """Read a cacheable block through its owner worker; None means
-        'serve it yourself' (owner unreachable)."""
+        'serve it yourself' (owner unreachable). The owner answers with
+        a shm reference when it can (gateway/shm.py) — the payload then
+        never crosses the socket: we map the owner's ring and hand the
+        memoryview straight down the zero-copy HTTP write path. A
+        reference that fails validation (wrapped ring, stale epoch)
+        falls back to one explicit socket re-fetch."""
         from ..utils.metrics import registry
 
         try:
             resp, _ = await self.endpoint.call(
                 owner, {"op": "cache_get", "hash": hash32},
                 PRIO_NORMAL, timeout=10.0)
-            data = resp.get("data") if isinstance(resp, dict) else None
+            if not isinstance(resp, dict):
+                resp = {}
+            ref = resp.get("shm")
+            if ref is not None and self.shm_reader is not None:
+                mv = self.shm_reader.get(ref, hash32)
+                if mv is not None:
+                    registry().inc("cache_tier_shm_forward")
+                    registry().inc("gateway_cache_forward_ok")
+                    return mv
+                registry().inc("cache_tier_shm_fallback")
+                resp, _ = await self.endpoint.call(
+                    owner, {"op": "cache_get", "hash": hash32,
+                            "no_shm": True},
+                    PRIO_NORMAL, timeout=10.0)
+                if not isinstance(resp, dict):
+                    resp = {}
+            data = resp.get("data")
             if data is not None:
                 registry().inc("gateway_cache_forward_ok")
                 return data
@@ -263,11 +309,21 @@ class GatewayWorkerClient:
             return {"ok": True, "index": self.index}
         if op == "cache_get":
             from ..utils.metrics import registry
+            from .shm import SHM_MIN_BYTES
 
+            h = payload["hash"]
             data = await self.garage.block_manager.rpc_get_block(
-                payload["hash"], cacheable=True, route=False,
-                charge=False)
+                h, cacheable=True, route=False, charge=False)
             registry().inc("gateway_cache_forward_served")
+            # zero-copy reply: publish once into our shm ring and ship
+            # the tiny reference instead of the payload. Small payloads
+            # and a lease-exhausted ring take the socket as before.
+            if self.shm is not None and not payload.get("no_shm") \
+                    and len(data) >= SHM_MIN_BYTES:
+                ref = self.shm.publish(h, data)
+                if ref is not None:
+                    registry().inc("cache_tier_shm_publish")
+                    return {"shm": ref}
             return {"data": data}
         if op == "metrics":
             text = await asyncio.to_thread(self._admin.render_metrics)

@@ -139,6 +139,8 @@ class Garage:
             ram_buffer_max=config.block_ram_buffer_max,
             read_cache_max_bytes=config.block_read_cache_max_bytes,
             resync_breaker_aware=config.block_resync_breaker_aware,
+            cache_tier=config.block_cache_tier,
+            cache_tier_hint_top_n=config.block_cache_tier_hint_top_n,
         )
 
         # ---- tables (ref: garage.rs:178-248) ---------------------------

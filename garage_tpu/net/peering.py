@@ -359,6 +359,14 @@ class PeeringManager:
         self.ping_interval = ping_interval
         self.ping_timeout = ping_timeout
         self.retry_interval = retry_interval
+        # hot-hash hint piggyback (ISSUE 15, block/cache_tier.py): the
+        # cluster cache tier registers a provider (this node's hottest
+        # cache keys) and a sink (a peer's hints). The peering layer
+        # stays block-agnostic — hints are opaque byte strings riding
+        # the pings it already sends, in BOTH directions (request and
+        # reply), so a hint set converges in ~one ping interval.
+        self.hint_provider = None  # () -> list[bytes]
+        self.hint_sink = None      # (from_node: bytes, hints) -> None
         # shared per-peer rpc health (breakers, latency quantiles);
         # PeeringManager is the one per-node object every RpcHelper
         # can reach through system.peering
@@ -441,14 +449,37 @@ class PeeringManager:
             ]
             await asyncio.gather(*(self._ping_one(p) for p in targets))
 
+    def _hot_hints(self) -> list:
+        if self.hint_provider is None:
+            return []
+        try:
+            return list(self.hint_provider())
+        except Exception as e:
+            log.debug("hint provider failed: %s", e)
+            return []
+
+    def _take_hints(self, from_node: bytes, payload: dict) -> None:
+        hot = payload.get("hot")
+        if not hot or self.hint_sink is None:
+            return
+        try:
+            self.hint_sink(from_node, hot)
+        except Exception as e:
+            log.debug("hint sink failed: %s", e)
+
     async def _ping_one(self, peer: _Peer) -> None:
         t0 = time.monotonic()
         try:
+            payload = {"hash": self._peer_list_hash()}
+            hot = self._hot_hints()
+            if hot:
+                payload["hot"] = hot
             resp, _ = await self.ep_ping.call(
-                peer.id, {"hash": self._peer_list_hash()}, PRIO_HIGH, timeout=self.ping_timeout
+                peer.id, payload, PRIO_HIGH, timeout=self.ping_timeout
             )
             peer.record_ping(time.monotonic() - t0)
             self.health.record_ping_ok(peer.id)
+            self._take_hints(peer.id, resp)
             if resp.get("hash") != self._peer_list_hash():
                 await self._pull_peer_list(peer.id)
         except Exception:
@@ -547,7 +578,12 @@ class PeeringManager:
         p = self.peers.get(from_node)
         if p is not None:
             p.last_seen = time.monotonic()
-        return {"hash": self._peer_list_hash()}
+        self._take_hints(from_node, payload)
+        out = {"hash": self._peer_list_hash()}
+        hot = self._hot_hints()
+        if hot:
+            out["hot"] = hot
+        return out
 
     async def _h_list(self, from_node, payload, stream):
         return {

@@ -321,6 +321,19 @@ class DeficitRoundRobin:
     def queued(self) -> int:
         return sum(len(q) for q in self._queues.values())
 
+    def fair_wait_units(self, key: str) -> float:
+        """Tokens a NEW arrival of `key` must expect to see drawn
+        before its own grant under round-robin: everything already
+        queued for ITS key plus ~one quantum per competing key (one
+        rotation round). Deliberately NOT the whole cross-key backlog
+        — that is what the round-robin shields the arrival from, and a
+        shed estimate priced on it would let one flooding key 503
+        every fresh key the DRR would serve almost immediately."""
+        q = self._queues.get(key)
+        own = sum(n for n, _ in q) if q else 0.0
+        others = len(self._queues) - (1 if q else 0)
+        return own + others * self.quantum
+
     async def submit(self, key: str, n: float) -> None:
         if not self._queues and self.bucket.try_acquire(n):
             return  # fast path: no backlog, tokens on hand
@@ -405,6 +418,7 @@ class QosEngine:
         self._key_buckets: dict[str, TokenBucket] = {}
         self._bucket_buckets: dict[str, TokenBucket] = {}
         self._fair: Optional[DeficitRoundRobin] = None
+        self._fair_req: Optional[DeficitRoundRobin] = None
         self.limits = QosLimits()
         self.set_limits(limits or QosLimits())
 
@@ -437,6 +451,17 @@ class QosEngine:
                 self._fair = DeficitRoundRobin(self._bytes_bucket)
         else:
             self._fair = None
+        # per-key DRR for the REQUEST-RATE bucket too (ISSUE 15
+        # satellite; PR 8 landed the bytes bucket only): same engine,
+        # same CURRENT_QOS_KEY identity, quantum = 1 request so
+        # backlogged keys alternate grants strictly
+        if self._req_bucket is not None and limits.fair_keys:
+            if self._fair_req is None or self._fair_req.bucket \
+                    is not self._req_bucket:
+                self._fair_req = DeficitRoundRobin(self._req_bucket,
+                                                   quantum=1.0)
+        else:
+            self._fair_req = None
         if limits.max_concurrent is not None:
             if self._conc is None:
                 self._conc = ConcurrencyLimiter(limits.max_concurrent,
@@ -491,6 +516,35 @@ class QosEngine:
         rps + declared-bytes buckets on enter, the concurrency slot
         held for the request's lifetime."""
         return _Admission(self, api, nbytes)
+
+    async def _admit_request(self, lim: QosLimits) -> float:
+        """Global request-rate draw. With `fair_keys` on and a request
+        identity in hand (the S3/K2V frontends seed CURRENT_QOS_KEY
+        from the request's CLAIMED key id before admission — fairness
+        needs a stable queue key, not a verified one; enforcement
+        still uses the verified identity in admit_scoped), contended
+        grants drain through the per-key deficit round-robin: K
+        backlogged keys each get ~1/K of the request rate instead of
+        whoever queued first. The bounded-wait shed contract is
+        unchanged — the estimated wait (bucket deficit plus the fair
+        queue ahead of us) beyond max_wait_s sheds immediately.
+        Returns seconds waited."""
+        b = self._req_bucket
+        fair = self._fair_req
+        key = CURRENT_QOS_KEY.get() if fair is not None else None
+        if fair is None or key is None:
+            return await b.acquire(1.0, max_wait=lim.max_wait_s,
+                                   scope="global")
+        # shed bound priced at what ROUND-ROBIN will actually make this
+        # arrival wait (its own key's queue + one rotation), not the
+        # whole cross-key backlog — a flooding key throttles itself at
+        # the bound while a fresh key still admits
+        wait = b.wait_for(1.0 + fair.fair_wait_units(key))
+        if wait > lim.max_wait_s:
+            raise SlowDown(wait, "global")
+        t0 = self.clock()
+        await fair.submit(key, 1.0)
+        return self.clock() - t0
 
     async def admit_scoped(self, key_id: Optional[str] = None,
                            bucket: Optional[str] = None) -> None:
@@ -603,8 +657,7 @@ class _Admission:
         debits: list = []
         try:
             if eng._req_bucket is not None:
-                eng._record_wait(await eng._req_bucket.acquire(
-                    1.0, max_wait=lim.max_wait_s, scope="global"))
+                eng._record_wait(await eng._admit_request(lim))
                 debits.append((eng._req_bucket, 1.0))
             if eng._bytes_bucket is not None and self.nbytes:
                 eng._record_wait(await eng._bytes_bucket.acquire(

@@ -109,7 +109,11 @@ class ResizeOrchestrator:
         report = ResizeReport()
         t0 = time.monotonic()
         report.version = v = await self.apply(expect_version)
-        report.phase_seconds["apply"] = time.monotonic() - t0
+        dt = time.monotonic() - t0
+        report.phase_seconds["apply"] = dt
+        # recorded like the wait phases below so the admin /v1/resize
+        # readout shows all four phases, not just the waits
+        registry().observe("resize_phase_seconds", dt, phase="apply")
         for phase, waiter in (("ack", self.wait_acked),
                               ("sync", self.wait_synced),
                               ("commit", self.wait_committed)):

@@ -141,6 +141,17 @@ class GatewayConfig:
     min_share: float = 0.05
     respawn_backoff_s: float = 2.0
     cache_shard: bool = True
+    # zero-copy intra-node cache forwards (ISSUE 15, gateway/shm.py):
+    # the owner worker publishes the decoded payload once into a
+    # shared-memory ring and the forwarding worker serves it via
+    # memoryview — no payload bytes cross the loopback socket. false =
+    # kill switch, every forward carries bytes over the socket again.
+    shm_forwards: bool = True
+    # ring capacity per worker and the reuse lease: a published slot
+    # is never overwritten before its lease expires, which bounds how
+    # long a forwarding worker may keep serving the mapped bytes
+    shm_ring_bytes: int = 64 * 1024 * 1024
+    shm_lease_s: float = 60.0
 
 
 @dataclass
@@ -180,6 +191,18 @@ class Config:
     # whose circuit breaker is open and spread across healthy holders
     # (README "Cluster resize"); off restores blind placement
     block_resync_breaker_aware: bool = True
+    # [block] cache_tier: CLUSTER-wide read cache tier (ISSUE 15,
+    # block/cache_tier.py; README "Cluster cache tier"). Non-owner
+    # reads probe the block's rendezvous-hash owner node in one hop
+    # and warm it on miss, so the cluster pays ~1 decode per hot block
+    # instead of one per node. false = every read serves node-locally
+    # (the pre-tier behavior); the node-local cache itself is governed
+    # by read_cache_max_bytes as before.
+    block_cache_tier: bool = True
+    # [block] cache_tier_hint_top_n: hottest cache keys gossiped per
+    # peering ping (hot-hash hints; background resync reads probe the
+    # tier only for hinted-hot blocks)
+    block_cache_tier_hint_top_n: int = 16
     compression_level: Optional[int] = 1  # zstd level; None disables
     replication_factor: int = 1
     consistency_mode: str = "consistent"  # consistent|degraded|dangerous

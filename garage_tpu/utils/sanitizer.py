@@ -49,6 +49,14 @@ from typing import Optional
 ENV_FLAG = "GARAGE_SANITIZE"
 ENV_THRESHOLD = "GARAGE_SANITIZE_STALL_S"
 DEFAULT_STALL_S = 1.0
+# sample-cadence floor (ISSUE 15 satellite): the stall sampler used to
+# run at threshold/5 only, so at the 1 s default the monitor woke every
+# 200 ms and a sub-200 ms-threshold configuration could sandwich a
+# whole stall between two samples. The period is now capped at 20 ms —
+# we sample at LEAST every 20 ms — and the heartbeat itself reports a
+# stall RETROACTIVELY when it fires late (see _beat), so a stall past
+# the threshold is caught even when it resolves between monitor samples.
+STALL_SAMPLE_FLOOR_S = 0.02
 
 # attribute marking a task as deliberately detached/supervised
 BACKGROUND_ATTR = "_garage_background"
@@ -154,6 +162,13 @@ def _check_conservation() -> None:
 
 # ---- stall detector ------------------------------------------------------
 
+def _sample_period() -> float:
+    """Sampling/heartbeat period: threshold/5, floored at 10 ms and
+    capped at STALL_SAMPLE_FLOOR_S (a minimum cadence — sub-200 ms
+    thresholds stay observable)."""
+    return max(0.01, min(_stall_threshold / 5.0, STALL_SAMPLE_FLOOR_S))
+
+
 def _beat(loop, token: int) -> None:
     ent = _loops.get(id(loop))
     if ent is None or ent[3] != token or loop.is_closed():
@@ -161,11 +176,22 @@ def _beat(loop, token: int) -> None:
         # stopped — without the token check every run_until_complete
         # on a persistent loop would add one more self-re-arming chain
         return
-    ent[1] = time.monotonic()
+    now = time.monotonic()
+    dt = now - ent[1]
+    if dt > _stall_threshold and not ent[2]:
+        # the beat itself arrived late past the threshold: the stall
+        # already RESOLVED (we are running again), so the live stack is
+        # gone, but the episode must still be reported — the monitor
+        # thread can sandwich a short stall between two samples, this
+        # check cannot
+        report("loop_stall",
+               f"event loop was silent for {dt:.2f}s (threshold "
+               f"{_stall_threshold:.2f}s); stall resolved before a "
+               "live stack could be captured")
+    ent[1] = now
     ent[2] = False  # beat recovered: re-arm one report per episode
     try:
-        loop.call_later(max(0.01, _stall_threshold / 5.0), _beat, loop,
-                        token)
+        loop.call_later(_sample_period(), _beat, loop, token)
     except RuntimeError:
         pass  # loop closing under us
 
@@ -179,7 +205,7 @@ def _loop_stack(thread_id: int) -> str:
 
 def _monitor_main() -> None:
     while True:
-        time.sleep(max(0.01, _stall_threshold / 5.0))
+        time.sleep(_sample_period())
         now = time.monotonic()
         for ent in list(_loops.values()):
             tid, last, reported = ent[0], ent[1], ent[2]

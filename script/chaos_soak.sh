@@ -75,6 +75,19 @@ say "gateway smoke: 2-worker kill/respawn drill + bench_gateway --workers $GATEW
     -k "end_to_end or kill_respawn"
 "$PY" bench.py bench_gateway --workers "$GATEWAY_WORKERS" --nobj 8
 
+# cluster cache tier smoke (ISSUE 15): the kill-the-owner drill (zero
+# failed GETs, ring remap, bounded decodes) plus bench_cache_tier —
+# cluster hot-GET GB/s, cluster-wide decode dedup vs the node-local
+# baseline, hint-gossip convergence and shm-vs-socket forward latency
+# land in the nightly trajectory. TIER_BLOCKS overridable.
+TIER_BLOCKS="${TIER_BLOCKS:-16}"
+say "cache tier smoke: kill-owner drill + bench_cache_tier --nblocks $TIER_BLOCKS"
+JAX_PLATFORMS=cpu GARAGE_TPU_DEVICE=off "$PY" -m pytest \
+    tests/test_cache_tier.py -q -p no:cacheprovider \
+    -k "kill_owner or probe_hit or hints_gossip"
+JAX_PLATFORMS=cpu GARAGE_TPU_DEVICE=off "$PY" bench.py bench_cache_tier \
+    --nblocks "$TIER_BLOCKS"
+
 # a stall/leak/conservation report anywhere in the soak — including
 # inside a forked worker whose parent test still passed — fails the
 # job; the report text names the pinned frame

@@ -219,6 +219,20 @@ done
 echo "$CACHE_METRICS" | grep -Eq '^cache_(hits|misses) [1-9]' \
     || die "cache counters never moved ($CACHE_METRICS)"
 
+say "admin: cluster cache tier live on a 3-node cluster"
+# the tier plane must exist (cache_tier_enabled 1 on a multi-node
+# cluster with the cache on) and its probe/serve counters must be
+# exported; the hint book fills as peering pings flow
+for counter in cache_tier_enabled cache_tier_members cache_tier_probes \
+               cache_tier_probe_hits cache_tier_hints_known; do
+    echo "$CACHE_METRICS" | grep -q "^$counter" \
+        || die "cache tier counter $counter missing from /metrics"
+done
+echo "$CACHE_METRICS" | grep -q '^cache_tier_enabled 1' \
+    || die "cache tier not active ($CACHE_METRICS)"
+echo "$CACHE_METRICS" | grep -Eq '^cache_tier_members [2-9]' \
+    || die "cache tier ring has no members"
+
 say "chaos: dead peer injected, writes+reads still reach quorum"
 # from node 1's point of view, every RPC to node 3 now fails — the
 # runtime equivalent of node 3 dropping dead mid-traffic

@@ -32,7 +32,11 @@ def run(coro, timeout=120.0):
     return asyncio.run(asyncio.wait_for(coro, timeout))
 
 
-async def make_block_cluster(tmp_path, n=3, rf=3, erasure=None):
+async def make_block_cluster(tmp_path, n=3, rf=3, erasure=None,
+                             cache_tier=False):
+    # cache_tier=False by default: these suites pin the NODE-LOCAL
+    # cache semantics (PR 3); the cluster tier's own routing semantics
+    # live in tests/test_cache_tier.py
     net = LocalNetwork()
     systems, managers = [], []
     rm = (ReplicationMode.parse(rf, erasure="%d,%d" % erasure)
@@ -63,7 +67,7 @@ async def make_block_cluster(tmp_path, n=3, rf=3, erasure=None):
     for i, s in enumerate(systems):
         db = open_db(str(tmp_path / f"node{i}" / "db"), engine="memory")
         lay = DataLayout.single(str(tmp_path / f"node{i}" / "data"))
-        managers.append(BlockManager(s, db, lay))
+        managers.append(BlockManager(s, db, lay, cache_tier=cache_tier))
     return net, systems, managers, tasks
 
 

@@ -1,0 +1,544 @@
+"""ISSUE 15: cluster-wide read cache tier.
+
+Covers the tentpole end to end: rendezvous owner routing with breaker
+filtering (a degraded owner drops OUT of the ring), the single-hop
+`rpc_cache_probe` (hit = zero decodes anywhere; miss = local fallback
++ write-through at the owner), SSE-C never probed or pushed cross-node,
+hot-hash hint gossip over peering pings, hint-gated resync fetches, the
+clusterbox kill-the-owner drill (zero failed GETs, ring remaps, decode
+count bounded), the shm forward ring's safety protocol, and the GL03
+fixtures for the new cross-node seam.
+"""
+
+import asyncio
+import os
+import textwrap
+import time
+
+import pytest
+
+from garage_tpu.utils.data import blake3sum
+from test_block import make_block_cluster, stop_all
+
+
+def run(coro, timeout=120.0):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+async def tier_cluster(tmp_path, n=4, rf=3, erasure=(2, 1)):
+    net, systems, managers, tasks = await make_block_cluster(
+        tmp_path, n=n, rf=rf, erasure=erasure, cache_tier=True)
+    return net, systems, managers, tasks
+
+
+def by_id(systems, managers):
+    return {s.id: m for s, m in zip(systems, managers)}
+
+
+async def wait_for(cond, timeout=15.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        await asyncio.sleep(0.05)
+    assert cond(), f"timeout waiting for {what}"
+
+
+# ---- ring / routing ------------------------------------------------------
+
+
+def test_rendezvous_owner_shared_by_both_layers():
+    from garage_tpu.gateway.ring import CacheRing, rendezvous_owner
+
+    ids = [bytes([i]) * 32 for i in range(5)]
+    ring = CacheRing(ids[0])
+    ring.set_members(ids)
+    for _ in range(100):
+        h = os.urandom(32)
+        assert ring.owner(h) == rendezvous_owner(ids, h)
+    assert rendezvous_owner([], os.urandom(32)) is None
+
+
+def test_tier_owner_routing_and_breaker_filtering(tmp_path):
+    """An open-breaker owner drops OUT of the ring: its share remaps to
+    the next-highest weight instead of blackholing probes."""
+    async def main():
+        net, systems, managers, tasks = await tier_cluster(tmp_path)
+        try:
+            m = managers[0]
+            tier = m.cache_tier
+            assert tier is not None
+            members = tier.members()
+            assert sorted(members) == sorted(s.id for s in systems)
+            # find a hash owned by a REMOTE node
+            h = os.urandom(32)
+            while tier.owner_of(h) is None:
+                h = os.urandom(32)
+            owner = tier.owner_of(h)
+            health = m.rpc.health()
+            for _ in range(5):  # BREAKER_FAILURES
+                health.record_failure(owner)
+            assert health.breaker_state(owner) == "open"
+            assert owner not in tier.members()
+            remapped = tier.owner_of(h)
+            assert remapped != owner  # remapped or became ours (None)
+            # un-owned hashes of OTHER owners kept their owner
+            h2 = os.urandom(32)
+            while tier.owner_of(h2) in (None, owner):
+                h2 = os.urandom(32)
+            health.record_success(owner)
+            assert owner in tier.members()
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
+def test_tier_disabled_by_knob_and_by_cache_off(tmp_path):
+    async def main():
+        net, systems, managers, tasks = await tier_cluster(tmp_path)
+        try:
+            tier = managers[0].cache_tier
+            h = os.urandom(32)
+            while tier.owner_of(h) is None:
+                h = os.urandom(32)
+            tier.enabled = False
+            assert tier.owner_of(h) is None and tier.owns(h)
+            tier.enabled = True
+            managers[0].cache.configure(max_bytes=0)
+            assert tier.owner_of(h) is None and tier.owns(h)
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
+# ---- probe hit / miss-warms-owner ---------------------------------------
+
+
+def test_probe_hit_serves_without_any_decode(tmp_path):
+    """The acceptance property: once the owner holds the decoded
+    payload, a read from ANY other node performs zero shard gathers and
+    zero decodes anywhere — cluster-wide store reads stay flat."""
+    async def main():
+        net, systems, managers, tasks = await tier_cluster(tmp_path)
+        try:
+            data = os.urandom(150_000)
+            h = blake3sum(data)
+            await managers[0].rpc_put_block(h, data, compress=False)
+            owners = by_id(systems, managers)
+            owner_id = (managers[0].cache_tier.owner_of(h)
+                        or systems[0].id)
+            owner = owners[owner_id]
+            # PUT write-through pushes to the owner in the background
+            await wait_for(lambda: owner.cache.get(h) is not None,
+                           what="owner warmed by put write-through")
+            readers = [m for m in managers
+                       if m.system.id != owner_id]
+            r0 = sum(m.metrics["store_reads"] for m in managers)
+            for m in readers:
+                assert await m.rpc_get_block(h) == data
+            assert sum(m.metrics["store_reads"]
+                       for m in managers) == r0  # zero decodes anywhere
+            probes = sum(m.cache_tier.probe_hits for m in readers)
+            assert probes == len(readers)
+            # readers did NOT fill their local cache: one copy per
+            # cluster, at the owner
+            for m in readers:
+                assert m.cache.get(h) is None
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
+def test_probe_miss_warms_owner_one_decode_cluster_wide(tmp_path):
+    async def main():
+        net, systems, managers, tasks = await tier_cluster(tmp_path)
+        try:
+            data = os.urandom(120_000)
+            h = blake3sum(data)
+            await managers[0].rpc_put_block(h, data, compress=False,
+                                            cacheable=False)  # cold
+            owners = by_id(systems, managers)
+            owner_id = managers[0].cache_tier.owner_of(h)
+            reader = managers[0] if owner_id is not None \
+                else managers[1]
+            owner_id = reader.cache_tier.owner_of(h)
+            assert owner_id is not None
+            owner = owners[owner_id]
+            assert owner.cache.get(h) is None
+            # first read: probe misses, local decode, owner warmed
+            assert await reader.rpc_get_block(h) == data
+            assert reader.cache_tier.probe_misses >= 1
+            await wait_for(lambda: owner.cache.get(h) is not None,
+                           what="owner warmed after miss")
+            # second read from a THIRD node: probe hit, no new decode
+            third = next(m for m in managers
+                         if m.system.id not in (owner_id,
+                                                reader.system.id))
+            r0 = sum(m.metrics["store_reads"] for m in managers)
+            assert await third.rpc_get_block(h) == data
+            assert sum(m.metrics["store_reads"] for m in managers) == r0
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
+def test_probe_rejects_corrupt_payload(tmp_path):
+    """A cache owner answering with bytes that don't hash to the key
+    must not be served: the prober verifies and falls back."""
+    async def main():
+        net, systems, managers, tasks = await tier_cluster(tmp_path)
+        try:
+            data = os.urandom(80_000)
+            h = blake3sum(data)
+            await managers[0].rpc_put_block(h, data, compress=False)
+            owners = by_id(systems, managers)
+            tier = next(m for m in managers
+                        if m.cache_tier.owner_of(h) is not None
+                        ).cache_tier
+            reader = tier.manager
+            owner_id = tier.owner_of(h)
+            owner = owners[owner_id]
+            await wait_for(lambda: owner.cache.get(h) is not None)
+            # poison the owner's cache entry behind the hash
+            owner.cache.discard(h)
+            owner.cache._prob[h] = b"x" * 80_000
+            owner.cache._prob_bytes += 80_000
+            got = await reader.rpc_get_block(h)
+            assert got == data  # served by the store path instead
+            assert tier.probe_corrupt == 1
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
+# ---- SSE-C conformance ---------------------------------------------------
+
+
+def test_ssec_never_probed_or_pushed_cross_node(tmp_path):
+    """cacheable=False must suppress the cross-node lanes end to end:
+    no probe RPC, no insert push, nothing in any cache."""
+    async def main():
+        net, systems, managers, tasks = await tier_cluster(tmp_path)
+        try:
+            data = os.urandom(90_000)
+            h = blake3sum(data)
+            probed = []
+            for m in managers:
+                orig = m.cache_tier.probe
+
+                async def spy(owner, h2, cacheable=True, _o=orig):
+                    probed.append(h2)
+                    return await _o(owner, h2, cacheable=cacheable)
+
+                m.cache_tier.probe = spy
+            await managers[0].rpc_put_block(h, data, compress=False,
+                                            cacheable=False)
+            for m in managers:
+                assert await m.rpc_get_block(h, cacheable=False) == data
+            assert probed == []
+            for m in managers:
+                assert m.cache.get(h) is None
+                assert m.cache_tier.probes == 0
+                assert m.cache_tier.inserts_pushed == 0
+            # and the tier-level guard itself: probe(cacheable=False)
+            # is a no-op even when called directly
+            tier = managers[0].cache_tier
+            owner = tier.owner_of(h) or systems[1].id
+            assert await tier.probe(owner, h, cacheable=False) is None
+            assert tier.probes == 0
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
+# ---- hint gossip + hint-gated resync ------------------------------------
+
+
+def test_hot_hash_hints_gossip_over_pings(tmp_path):
+    async def main():
+        net, systems, managers, tasks = await tier_cluster(tmp_path)
+        try:
+            data = os.urandom(50_000)
+            h = blake3sum(data)
+            m0 = managers[0]
+            m0.cache.insert(h, data)
+            assert m0.cache.get(h) == data  # a HIT makes it hot
+            assert h in m0.cache.top_keys(16)
+            # pings run every ~0.2 s in this harness; hints ride both
+            # directions of each ping
+            await wait_for(
+                lambda: all(m.cache_tier.is_hot(h)
+                            for m in managers[1:]),
+                timeout=20.0, what="hints to converge")
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
+def test_resync_fetch_routes_through_tier_when_hinted(tmp_path):
+    """A hinted-hot replicate fetch is served by one probe instead of a
+    remote packed read — and a COLD block never probes."""
+    async def main():
+        net, systems, managers, tasks = await make_block_cluster(
+            tmp_path, n=4, rf=3, cache_tier=True)  # replicate mode
+        try:
+            data = os.urandom(70_000)
+            h = blake3sum(data)
+            await managers[0].rpc_put_block(h, data, compress=False)
+            owners = by_id(systems, managers)
+            fetcher = next(m for m in managers
+                           if m.cache_tier.owner_of(h) is not None)
+            owner = owners[fetcher.cache_tier.owner_of(h)]
+            await wait_for(lambda: owner.cache.get(h) is not None)
+            fetcher.delete_local(h)
+            assert not fetcher.has_local(h)
+
+            async def boom(*a, **kw):
+                raise AssertionError("remote store read used")
+
+            # cold: no hint -> the tier lane must not even be tried
+            assert not fetcher.cache_tier.is_hot(h)
+            assert not await fetcher.resync._fetch_via_tier(h)
+            # hot: hint it, then the fetch lands via one probe with the
+            # remote store path forbidden
+            fetcher.cache_tier.note_hints(owner.system.id, [h])
+            fetcher._get_replicate = boom
+            await fetcher.resync._fetch(h)
+            assert fetcher.has_local(h)
+            got = await asyncio.to_thread(fetcher.read_local, h)
+            assert got is not None
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
+# ---- clusterbox: kill the owner mid-hot-workload -------------------------
+
+
+@pytest.mark.slow
+def test_kill_owner_mid_hot_workload_zero_failed_gets(tmp_path):
+    """The acceptance drill on a >= 4-node cluster with a Zipf-hot
+    working set: cluster-wide decode count for the hot set stays ~1 per
+    block; killing the cache owner of the hottest blocks mid-workload
+    yields ZERO failed GETs (probes fail fast, reads fall back local)
+    and the ring remaps within one breaker window."""
+    run(_kill_owner_drill(tmp_path), timeout=300.0)
+
+
+async def _kill_owner_drill(tmp_path):
+    from clusterbox import ClusterBox
+
+    box = ClusterBox(tmp_path, n=4, rf=3, erasure=(2, 1))
+    await box.start()
+    try:
+        rng_blocks = [os.urandom(100_000) for _ in range(6)]
+        hashes = [blake3sum(b) for b in rng_blocks]
+        m0 = box.nodes[0].manager
+        for h, b in zip(hashes, rng_blocks):
+            await m0.rpc_put_block(h, b, compress=False)
+        # warm: every node reads every block once (owners fill)
+        for nd in box.nodes:
+            for h, b in zip(hashes, rng_blocks):
+                assert await nd.manager.rpc_get_block(h) == b
+        managers = [nd.manager for nd in box.nodes]
+        decodes_warm = sum(m.metrics["store_reads"] for m in managers)
+
+        # Zipf-hot: hammer the first two blocks from every node
+        hot = list(zip(hashes[:2], rng_blocks[:2]))
+        failures = []
+
+        async def hammer(nd, rounds=40):
+            for i in range(rounds):
+                h, b = hot[i % len(hot)]
+                try:
+                    got = await nd.manager.rpc_get_block(h)
+                    if got != b:
+                        failures.append(f"corrupt read on {nd.index}")
+                except Exception as e:  # noqa: BLE001 - ledger test
+                    failures.append(f"get on node {nd.index}: {e!r}")
+                await asyncio.sleep(0.01)
+
+        # kill the owner of the hottest block mid-hammer
+        owner_id = None
+        for nd in box.nodes:
+            o = nd.manager.cache_tier.owner_of(hot[0][0])
+            if o is not None:
+                owner_id = o
+                break
+        assert owner_id is not None
+        victim = next(nd for nd in box.nodes if nd.id == owner_id)
+        survivors = [nd for nd in box.nodes if nd is not victim]
+
+        tasks = [asyncio.ensure_future(hammer(nd)) for nd in survivors]
+        await asyncio.sleep(0.15)
+        await box.stop_node(victim)
+        await asyncio.gather(*tasks)
+        assert failures == [], failures[:5]
+        # ring remapped off the dead owner on every survivor
+        for nd in survivors:
+            o = nd.manager.cache_tier.owner_of(hot[0][0])
+            assert o != owner_id
+        # decode work stayed bounded: the hot hammer (240 GETs) must
+        # not have re-decoded per GET — only the fallback window while
+        # the breaker opened pays decodes
+        live = [nd.manager for nd in survivors]
+        decodes_now = sum(m.metrics["store_reads"] for m in live)
+        hammered = sum(1 for _ in survivors) * 40
+        assert decodes_now - decodes_warm < hammered / 2, (
+            decodes_now, decodes_warm)
+    finally:
+        await box.stop()
+
+
+# ---- shm forward ring ----------------------------------------------------
+
+
+def test_shm_ring_roundtrip_reuse_and_validation(tmp_path):
+    from garage_tpu.gateway.shm import ShmReader, ShmRing, ring_path
+
+    p = ring_path(str(tmp_path), 0)
+    ring = ShmRing(p, 1 << 20, lease_s=30.0)
+    payload = os.urandom(200_000)
+    h = b"\x01" * 32
+    ref = ring.publish(h, payload)
+    assert ref is not None
+    rd = ShmReader()
+    mv = rd.get(ref, h)
+    assert isinstance(mv, memoryview) and bytes(mv) == payload
+    # a hot hash is written once per lease, not once per forward
+    assert ring.publish(h, payload) == ref and ring.reused == 1
+    # wrong hash / stale seq / truncated refs all refuse
+    assert rd.get(ref, b"\x02" * 32) is None
+    assert rd.get({**ref, "seq": ref["seq"] + 1}, h) is None
+    assert rd.get({**ref, "off": ring.size * 2}, h) is None
+    assert rd.get({"path": p}, h) is None
+
+
+def test_shm_ring_lease_blocks_overwrite_then_expires(tmp_path):
+    from garage_tpu.gateway.shm import ShmReader, ShmRing, ring_path
+
+    p = ring_path(str(tmp_path), 1)
+    ring = ShmRing(p, 1 << 19, lease_s=0.2)  # 512 KiB
+    rd = ShmReader()
+    refs = [(os.urandom(32), os.urandom(100_000)) for _ in range(8)]
+    out = [ring.publish(h, b) for h, b in refs]
+    # the ring cannot host 800 KB of leased slots in 512 KiB: some
+    # publishes fall back instead of overwriting a leased slot
+    assert any(r is None for r in out)
+    assert ring.fallbacks > 0
+    # every reference that WAS handed out still validates
+    for (h, b), r in zip(refs, out):
+        if r is not None:
+            assert bytes(rd.get(r, h)) == b
+    time.sleep(0.25)  # leases expire -> space frees
+    assert ring.publish(b"\x07" * 32, os.urandom(100_000)) is not None
+
+
+def test_shm_oversize_payload_falls_back(tmp_path):
+    from garage_tpu.gateway.shm import ShmRing, ring_path
+
+    ring = ShmRing(ring_path(str(tmp_path), 2), 1 << 16, lease_s=1.0)
+    assert ring.publish(b"\x01" * 32, os.urandom(1 << 17)) is None
+
+
+def test_shm_crash_respawn_preserves_leased_slots(tmp_path):
+    """A CRASH-respawned owner (no clean close) reopens the same inode
+    WITHOUT zeroing it — a sibling still streaming a leased slot out
+    of its mapping must keep seeing the published bytes — and
+    references minted by the previous incarnation fail the seq-epoch
+    check instead of serving whatever now occupies the slot."""
+    from garage_tpu.gateway.shm import ShmReader, ShmRing, ring_path
+
+    p = ring_path(str(tmp_path), 3)
+    ring1 = ShmRing(p, 1 << 18, lease_s=30.0)
+    h1 = b"\x01" * 32
+    data1 = os.urandom(70_000)
+    old_ref = ring1.publish(h1, data1)
+    rd = ShmReader()
+    mv_in_flight = rd.get(old_ref, h1)  # a slow client mid-stream
+    assert mv_in_flight is not None
+    # crash: NO close() — the inode (and its contents) survive
+    ring2 = ShmRing(p, 1 << 18, lease_s=30.0)  # the respawn
+    # the in-flight view still reads the original bytes (no memset)
+    assert bytes(mv_in_flight) == data1
+    h2 = b"\x02" * 32
+    data2 = os.urandom(70_000)
+    new_ref = ring2.publish(h2, data2)
+    # same inode: the reader's EXISTING mapping serves the new slot
+    assert bytes(rd.get(new_ref, h2)) == data2
+    # the old incarnation's reference refuses (fresh seq epoch)
+    assert rd.get(old_ref, h1) is None
+
+
+def test_shm_clean_close_unlinks_and_reader_remaps(tmp_path):
+    """Clean shutdown unlinks the ring (ephemeral clusters must not
+    accumulate resident tmpfs files); a reader still holding the OLD
+    inode's mapping detects the recreate and remaps on its next
+    validation failure."""
+    from garage_tpu.gateway.shm import ShmReader, ShmRing, ring_path
+
+    p = ring_path(str(tmp_path), 4)
+    ring1 = ShmRing(p, 1 << 18, lease_s=30.0)
+    h1 = b"\x01" * 32
+    ref1 = ring1.publish(h1, os.urandom(60_000))
+    rd = ShmReader()
+    assert rd.get(ref1, h1) is not None  # reader mapped inode #1
+    ring1.close()
+    assert not os.path.exists(p)  # unlinked on clean close
+    ring2 = ShmRing(p, 1 << 18, lease_s=30.0)  # fresh inode
+    h2 = b"\x02" * 32
+    data2 = os.urandom(60_000)
+    ref2 = ring2.publish(h2, data2)
+    # the cached old-inode map fails validation -> remap -> serve
+    assert bytes(rd.get(ref2, h2)) == data2
+    ring2.close()
+
+
+# ---- GL03: the new cross-node seam --------------------------------------
+
+
+def _lint(src: str, rel_path: str):
+    from garage_tpu.analysis import analyze_source, default_rules
+
+    ctx = analyze_source(textwrap.dedent(src), default_rules(),
+                         rel_path=rel_path)
+    return sorted({v.rule for v in ctx.violations if v.active})
+
+
+def test_gl03_fires_on_tier_probe_in_ssec_scope():
+    assert _lint("""
+        async def stream(mgr, h, sse_key):
+            tier = mgr.cache_tier
+            return await tier.probe(owner_of(h), h)
+    """, "garage_tpu/api/s3/fake_tier.py") == ["GL03"]
+
+
+def test_gl03_quiet_with_cacheable_on_tier_probe():
+    assert _lint("""
+        async def stream(mgr, h, sse_key):
+            tier = mgr.cache_tier
+            return await tier.probe(owner_of(h), h,
+                                    cacheable=sse_key is None)
+    """, "garage_tpu/api/s3/fake_tier.py") == []
+
+
+def test_gl03_fires_on_tainted_payload_into_tier_insert():
+    assert _lint("""
+        def warm(mgr, owner, h, sse_payload):
+            mgr.cache_tier.insert_at(owner, h, sse_payload)
+    """, "garage_tpu/block/fake_tier.py") == ["GL03"]
+
+
+def test_gl03_quiet_on_untainted_tier_insert():
+    assert _lint("""
+        def warm(mgr, owner, h, payload):
+            mgr.cache_tier.insert_at(owner, h, payload)
+    """, "garage_tpu/block/fake_tier.py") == []

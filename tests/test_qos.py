@@ -197,6 +197,128 @@ def test_shed_visibility_per_key_and_bucket():
     run(main())
 
 
+def test_request_rate_drr_bounded_share_between_keys():
+    """ISSUE 15 satellite: the global REQUEST-RATE bucket drains
+    through the same per-key deficit round-robin as the bytes bucket.
+    Key A floods the admission queue first, key B arrives after; the
+    grants must interleave (~1/K each) instead of draining A's backlog
+    first — the bounded-share property, now for requests."""
+    from garage_tpu.qos.limiter import CURRENT_QOS_KEY
+
+    eng = QosEngine(QosLimits(global_rps=2000.0, global_burst=2000.0,
+                              max_wait_s=5.0, fair_keys=True))
+    assert eng._fair_req is not None
+    order = []
+
+    async def scenario():
+        eng._req_bucket.tokens = 0.0  # force contention immediately
+
+        async def one(key):
+            tok = CURRENT_QOS_KEY.set(key)
+            try:
+                async with eng.admit("s3"):
+                    order.append(key)
+            finally:
+                CURRENT_QOS_KEY.reset(tok)
+
+        tasks = [asyncio.ensure_future(one("A")) for _ in range(10)]
+        await asyncio.sleep(0)  # A's backlog queues first
+        tasks += [asyncio.ensure_future(one("B")) for _ in range(10)]
+        await asyncio.gather(*tasks)
+
+    run(scenario())
+    assert len(order) == 20
+    first_half = order[:10]
+    assert 3 <= first_half.count("B") <= 7, order
+    assert eng.counters.admitted == 20 and eng.counters.shed == 0
+
+
+def test_request_rate_drr_keeps_bounded_wait_shed_contract():
+    """Fairness must not weaken shedding: an arrival whose estimated
+    wait (bucket deficit + the fair queue ahead of it) exceeds
+    max_wait_s sheds immediately with SlowDown, keyed or not."""
+    from garage_tpu.qos.limiter import CURRENT_QOS_KEY
+
+    eng = QosEngine(QosLimits(global_rps=10.0, global_burst=10.0,
+                              max_wait_s=0.05, fair_keys=True))
+
+    async def scenario():
+        eng._req_bucket.tokens = 0.0  # ~0.1 s deficit > max_wait
+        tok = CURRENT_QOS_KEY.set("A")
+        try:
+            with pytest.raises(SlowDown) as ei:
+                async with eng.admit("s3"):
+                    pass
+            assert ei.value.scope == "global"
+        finally:
+            CURRENT_QOS_KEY.reset(tok)
+        # anonymous requests (no key) keep the legacy debt path
+        with pytest.raises(SlowDown):
+            async with eng.admit("s3"):
+                pass
+
+    run(scenario())
+    assert eng.counters.shed == 2 and eng.counters.admitted == 0
+
+
+def test_request_rate_drr_flooding_key_cannot_shed_fresh_keys():
+    """Review pin: the shed estimate prices what round-robin will make
+    THIS arrival wait (own queue + one rotation), not the global
+    backlog — key A's flood throttles A at the bound while fresh key B
+    still admits."""
+    from garage_tpu.qos.limiter import CURRENT_QOS_KEY
+
+    eng = QosEngine(QosLimits(global_rps=200.0, global_burst=200.0,
+                              max_wait_s=0.1, fair_keys=True))
+
+    async def scenario():
+        eng._req_bucket.tokens = 0.0
+        results = {"A": [], "B": []}
+
+        async def one(key):
+            tok = CURRENT_QOS_KEY.set(key)
+            try:
+                async with eng.admit("s3"):
+                    results[key].append("ok")
+            except SlowDown:
+                results[key].append("shed")
+            finally:
+                CURRENT_QOS_KEY.reset(tok)
+
+        # A floods far past what 0.1 s of budget (20 reqs) can hold
+        tasks = [asyncio.ensure_future(one("A")) for _ in range(60)]
+        await asyncio.sleep(0)
+        # B's first requests arrive while A's backlog is deep
+        tasks += [asyncio.ensure_future(one("B")) for _ in range(3)]
+        await asyncio.gather(*tasks)
+        return results
+
+    results = run(scenario())
+    assert results["B"] == ["ok", "ok", "ok"], results["B"]
+    assert "shed" in results["A"]  # the flooder pays its own bound
+
+def test_claimed_key_id_parsed_without_crypto():
+    from garage_tpu.api.signature import claimed_key_id
+
+    class Req:
+        def __init__(self, auth=None, query=None):
+            self._auth = auth
+            self.query = query or {}
+
+        def header(self, name):
+            return self._auth if name == "authorization" else None
+
+    assert claimed_key_id(Req(
+        "AWS4-HMAC-SHA256 Credential=GKkey1/20260804/garage/s3/"
+        "aws4_request, SignedHeaders=host, Signature=deadbeef"
+    )) == "GKkey1"
+    assert claimed_key_id(Req(
+        query={"X-Amz-Credential":
+               "GKkey2%2F20260804%2Fgarage%2Fs3%2Faws4_request"}
+    )) == "GKkey2"
+    assert claimed_key_id(Req()) is None
+
+
 def test_shed_entity_map_is_bounded():
     """An attacker spraying distinct key ids must not grow the shed
     attribution maps without bound: past the cap, new entities

@@ -181,6 +181,55 @@ def test_hedged_write_unsticks_hung_shard_holder(tmp_path):
 # ---- cluster: the three transitions, mid-workload, chaos armed ---------
 
 
+def test_admin_resize_readout(tmp_path):
+    """ISSUE 15 satellite (PR 6 follow-on): GET /v1/resize builds an
+    operator progress readout from the existing resize_phase_seconds
+    series and the gossiped ack/sync trackers — phases with timings,
+    per-node lag, and the rebalance backlog."""
+
+    async def main():
+        import json as _json
+
+        from garage_tpu.admin.http import AdminHttpServer
+
+        box = ClusterBox(tmp_path, n=3, rf=3)
+        await box.start()
+        try:
+            node = await box.add_node()
+            orch = box.orchestrator()
+            orch.stage_add(node.id, "z1", 1 << 30)
+            await orch.run(timeout=120.0)
+
+            class _Req:
+                method = "GET"
+                path = "/v1/resize"
+                query = {}
+
+                @staticmethod
+                def header(name):
+                    return None
+
+            adm = AdminHttpServer(box.nodes[0].garage)
+            resp = await adm._route_v1(_Req())
+            body = _json.loads(bytes(resp.body))
+            assert body["layout_version"] == 2
+            assert body["transitions_completed"] >= 1
+            # all four phases recorded with timings
+            assert set(body["phases"]) >= {"apply", "ack", "sync",
+                                           "commit"}
+            for ph in body["phases"].values():
+                assert ph["count"] >= 1 and ph["total_s"] >= 0
+            # converged: nothing lagging, not resizing
+            assert body["resizing"] is False
+            assert all(n["lagging"] == [] for n in body["nodes"])
+            assert len(body["nodes"]) == 4
+            assert body["rebalance_backlog"] == 0
+        finally:
+            await box.stop()
+
+    run(main())
+
+
 def test_add_node_under_load_with_chaos(tmp_path):
     """Scale-up: a new node joins mid-workload with net faults armed.
     The transition completes, zero quorum ops fail, the rebalance

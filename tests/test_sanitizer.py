@@ -68,6 +68,33 @@ def test_one_report_per_stall_episode(fast_stall):
     assert len(stalls) == 1
 
 
+def test_sub_200ms_stall_detected_at_low_threshold():
+    """ISSUE 15 satellite: the sampler used to run at threshold/5
+    only, so a threshold below 200 ms could sandwich a whole stall
+    between two samples AND between two heartbeats. The 20 ms cadence
+    floor plus the heartbeat's retroactive late-arrival check make a
+    seeded sub-200 ms stall deterministic to catch."""
+    sanitizer.install()
+    prev = sanitizer.stall_threshold()
+    sanitizer.configure(0.08)
+    try:
+        assert sanitizer._sample_period() <= 0.02
+
+        async def _short_stall():
+            await asyncio.sleep(0.05)  # let the beat chain settle
+            time.sleep(0.15)           # 150 ms pin, over the 80 ms bar
+            await asyncio.sleep(0.05)  # beats resume -> retro check
+
+        asyncio.run(_short_stall())
+        time.sleep(0.1)
+        stalls = [r for r in sanitizer.drain_reports()
+                  if r["kind"] == "loop_stall"]
+        assert stalls, "sub-200ms stall went unseen"
+    finally:
+        sanitizer.configure(prev)
+        sanitizer.drain_reports()
+
+
 def test_no_stall_report_below_threshold(fast_stall):
     async def _quick():
         time.sleep(0.05)
