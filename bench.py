@@ -1536,6 +1536,130 @@ def bench_resize(n_nodes: int = 16, nobj: int = 48, obj_kib: int = 256,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_zone(nblocks: int = 12, block_kib: int = 256,
+               rounds: int = 3, wan_ms: float = 20.0) -> dict:
+    """Zone-aware read economics (ISSUE 16). A 3-zone / 6-node
+    cluster-in-a-box with a chaos-injected WAN delay on every
+    cross-zone link out of the reading node, reading blocks the reader
+    does NOT hold locally (the remote-read shape):
+
+      zone_local_get_p50_ms /      local-zone-first ordering serves the
+      zone_local_get_p99_ms        same-zone replica: one LAN hop, the
+                                   WAN delay never paid
+      zone_cross_get_p50_ms /      the same reads with the same-zone
+      zone_cross_get_p99_ms        replica's link severed — forced
+                                   cross-zone, each GET pays the WAN
+      zone_local_cross_mb /        block_cross_zone_read_bytes delta per
+      zone_cross_cross_mb          leg: ~0 for the local leg is the
+                                   routing claim as a byte counter
+    """
+    import pathlib
+    import shutil
+    import sys
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    for p in (here, os.path.join(here, "tests")):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    from clusterbox import ClusterBox
+
+    from garage_tpu.chaos import FaultSpec, arm, disarm
+    from garage_tpu.utils.data import blake3sum
+    from garage_tpu.utils.metrics import registry
+
+    block_len = block_kib << 10
+    tmp = tempfile.mkdtemp(
+        prefix="gt_zone_",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
+
+    async def scenario() -> dict:
+        box = await ClusterBox(
+            pathlib.Path(tmp), n=6, rf=3,
+            zones=["z1", "z1", "z2", "z2", "z3", "z3"],
+            zone_redundancy=2).start()
+        try:
+            m0 = box.nodes[0].manager
+            layout = box.nodes[0].system.layout_helper.current()
+            rng = np.random.default_rng(16)
+            # blocks the reader does NOT hold: every read is remote,
+            # and the spread-maximizing layout guarantees the one z1
+            # replica is node 1 — the same-zone lane we then sever
+            hashes = []
+            while len(hashes) < nblocks:
+                b = rng.integers(0, 256, block_len,
+                                 dtype=np.uint8).tobytes()
+                h = blake3sum(b)
+                if box.nodes[0].id in layout.nodes_of_hash(h):
+                    continue
+                await m0.rpc_put_block(h, b, compress=False,
+                                       cacheable=False)
+                hashes.append(h)
+
+            n0 = box.nodes[0].id.hex()[:8]
+            n1 = box.nodes[1].id.hex()[:8]
+
+            def wan_faults(c):
+                # WAN model: every frame node0 sends across a zone
+                # boundary pays wan_ms (pings included — they survive)
+                for nd, zone in zip(box.nodes, box.zones):
+                    if zone != "z1":
+                        c.add(FaultSpec(kind="net_delay", node=n0,
+                                        peer=nd.id.hex()[:8],
+                                        delay_s=wan_ms / 1e3))
+
+            async def sweep() -> list:
+                lat = []
+                for _ in range(rounds):
+                    for h in hashes:
+                        t0 = time.perf_counter()
+                        got = await m0.rpc_get_block(h, cacheable=False)
+                        lat.append(time.perf_counter() - t0)
+                        assert len(got) == block_len
+                return lat
+
+            def pctl(xs, q):
+                s = sorted(xs)
+                return round(
+                    s[min(len(s) - 1, int(q * len(s)))] * 1e3, 2)
+
+            def cross_mb() -> float:
+                return registry().totals(
+                    "block_cross_zone_read_bytes")[1] / 1e6
+
+            # ---- local leg: same-zone replica reachable ---------------
+            c = arm(seed=16)
+            wan_faults(c)
+            x0 = cross_mb()
+            local = await sweep()
+            local_cross = cross_mb() - x0
+
+            # ---- cross leg: sever node0 <-> node1, pay the WAN --------
+            c.add(FaultSpec(kind="net_disconnect", node=n0, peer=n1))
+            c.add(FaultSpec(kind="net_disconnect", node=n1, peer=n0))
+            x0 = cross_mb()
+            cross = await sweep()
+            cross_bytes = cross_mb() - x0
+            disarm()
+
+            return {
+                "zone_local_get_p50_ms": pctl(local, 0.5),
+                "zone_local_get_p99_ms": pctl(local, 0.99),
+                "zone_cross_get_p50_ms": pctl(cross, 0.5),
+                "zone_cross_get_p99_ms": pctl(cross, 0.99),
+                "zone_local_cross_mb": round(local_cross, 2),
+                "zone_cross_cross_mb": round(cross_bytes, 2),
+            }
+        finally:
+            disarm()
+            await box.stop()
+
+    try:
+        return asyncio.run(asyncio.wait_for(scenario(), 300))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_metadata(keys: int = 150_000, engines=("sqlite", "lsm"),
                    delim_prefixes: int = 256, list_reps: int = 24,
                    sync_missing: int = 1_000) -> dict:
@@ -2254,6 +2378,14 @@ def main() -> None:
         extra.update(bench_cache_tier())
     except Exception as e:
         extra["cache_tier_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # zone-aware reads (ISSUE 16): local-zone-first vs forced
+    # cross-zone GET latency under an injected WAN delay, with the
+    # cross-zone byte counter as the routing proof
+    try:
+        extra.update(bench_zone())
+    except Exception as e:
+        extra["zone_error"] = f"{type(e).__name__}: {e}"[:300]
     if platform == "cpu":
         maybe_reexec_on_device()
 
@@ -2350,6 +2482,24 @@ if __name__ == "__main__":
             **bench_cache_tier(nblocks=a.nblocks,
                                block_kib=a.block_kib,
                                rounds=a.rounds, nodes=a.nodes),
+        }), flush=True)
+        os._exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "bench_zone":
+        # standalone scenario (nightly soak / operator runs):
+        # python bench.py bench_zone --wan-ms 40
+        import argparse
+
+        ap = argparse.ArgumentParser()
+        ap.add_argument("cmd")
+        ap.add_argument("--nblocks", type=int, default=12)
+        ap.add_argument("--block-kib", type=int, default=256)
+        ap.add_argument("--rounds", type=int, default=3)
+        ap.add_argument("--wan-ms", type=float, default=20.0)
+        a = ap.parse_args()
+        print(json.dumps({
+            "metric": "bench_zone",
+            **bench_zone(nblocks=a.nblocks, block_kib=a.block_kib,
+                         rounds=a.rounds, wan_ms=a.wan_ms),
         }), flush=True)
         os._exit(0)
     if len(sys.argv) > 1 and sys.argv[1] == "bench_gateway":

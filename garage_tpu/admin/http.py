@@ -368,6 +368,14 @@ class AdminHttpServer:
                 return None
             return _json(chaos_inj.controller().state())
 
+        if path == "/v1/zones" and m == "GET":
+            # per-zone health rollup (garage_tpu/zones/, ISSUE 16):
+            # up / degraded / partitioned per zone, derived live from
+            # peering state — during a zone partition this flips within
+            # one ping interval, observer-relative (each side of the
+            # cut sees the OTHER side partitioned)
+            return _json(self.garage.system.zone_health.snapshot())
+
         if path == "/v1/metadata" and m == "GET":
             # metadata-engine observability (README "Metadata at
             # scale"): per-engine internals (lsm: segments, compaction

@@ -10,11 +10,20 @@ copies. This module is the cross-NODE lane:
   * **Owner routing** — every cacheable block hash has one OWNER node,
     chosen by rendezvous hashing (gateway/ring.py's weight function,
     shared so the worker and cluster layers can never disagree) over
-    the layout-derived storage-node roster, FILTERED through the shared
-    PeerHealthTracker: a node whose circuit breaker is open drops out
-    of the ring, so a degraded owner remaps its share to the
-    next-highest weight instead of blackholing reads (Karger et al.,
-    "Web Caching with Consistent Hashing").
+    the roster, FILTERED through the shared PeerHealthTracker: a node
+    whose circuit breaker is open drops out of the ring, so a degraded
+    owner remaps its share to the next-highest weight instead of
+    blackholing reads (Karger et al., "Web Caching with Consistent
+    Hashing"). The roster is PER ZONE (ISSUE 16): a storage node's
+    ring is the current layout's storage nodes IN ITS OWN ZONE, so a
+    hot-block probe is an intra-zone hop, never a cross-WAN one, and a
+    cold zone warms from its own decode instead of a cross-zone shm
+    miss. Each zone therefore holds one decoded copy of its hot set —
+    deliberate: a WAN round-trip costs more than the decode it would
+    save, and a zone partition must not sever the cache lane. A node
+    with NO zone (gateway worker, zoneless test rig) falls back to the
+    global ring, which is also the pre-zone behavior when every node
+    shares one zone.
   * **Single-hop probe** — a non-owner read first issues
     `rpc_cache_probe` to the owner: a read-only, hedge-safe op that
     answers from the owner's RAM cache and NEVER touches the store
@@ -30,6 +39,10 @@ copies. This module is the cross-NODE lane:
   * **Hot-hash hints** — each node's top-N cache keys by hit count
     (BlockCache.top_keys) piggyback on the existing peering pings
     (net/peering.py hint hooks; ~32 B per hash, bounded both ways).
+    Hints are INTRA-ZONE like the ring (ISSUE 16): a hint arriving
+    from a peer in another zone is dropped on receipt, so is_hot()
+    reflects this ZONE's hot set and a background probe gated on it
+    never targets a cross-WAN owner.
     The hint set tells BACKGROUND readers which blocks are worth a
     probe: resync's replicate fetches route through the tier only for
     hinted-hot hashes, so a rebalance enumeration of a million cold
@@ -105,26 +118,45 @@ class ClusterCacheTier:
         self.insert_skips = 0
         self.hints_sent = 0
         self.hints_seen = 0
+        self.cross_zone_probes = 0
+        self.hints_dropped_cross_zone = 0
 
     # ---- ring -----------------------------------------------------------
 
     def _health(self):
         return self.manager.rpc.health()
 
+    def _zone_of(self, node: bytes) -> Optional[str]:
+        role = self.manager.system.layout_helper.current().node_role(node)
+        if role is None or not role.zone:
+            return None
+        return role.zone
+
     def members(self) -> list[bytes]:
-        """Live ring membership: the current layout's storage nodes,
-        minus open-breaker peers (a degraded owner drops OUT of the
-        ring — its share remaps — instead of blackholing probes).
-        Breaker state is a local observation, so two nodes can briefly
-        disagree on ownership while a breaker is open; the tier is a
-        cache, so the cost is a duplicate fill, never a wrong answer."""
+        """Live ring membership: the current layout's storage nodes IN
+        THIS NODE'S ZONE (the whole cluster when this node has no zone
+        — gateway worker, zoneless rig; with every node in one zone the
+        two are the same roster), minus open-breaker peers (a degraded
+        owner drops OUT of the ring — its share remaps — instead of
+        blackholing probes). Breaker state is a local observation, so
+        two nodes can briefly disagree on ownership while a breaker is
+        open; the tier is a cache, so the cost is a duplicate fill,
+        never a wrong answer. Zone membership comes from the shared
+        layout, so all nodes of a zone DO agree on the zone roster."""
         system = self.manager.system
+        me = system.id
         nodes = sorted(
             system.layout_helper.current().storage_nodes())
+        my_zone = self._zone_of(me)
+        if my_zone is not None:
+            # per-zone ring (ISSUE 16): hot-block probes stay
+            # intra-zone; a zoneless node in the roster is unreachable
+            # as "same zone" and drops out too
+            nodes = [n for n in nodes
+                     if n == me or self._zone_of(n) == my_zone]
         health = self._health()
         if health is None:
             return nodes
-        me = system.id
         now = time.monotonic()
         return [n for n in nodes
                 if n == me or health.breaker_state(n, now) != "open"]
@@ -171,6 +203,14 @@ class ClusterCacheTier:
             return None
         self.probes += 1
         m = self.manager
+        my_zone = self._zone_of(m.system.id)
+        if my_zone is not None and self._zone_of(owner) != my_zone:
+            # the per-zone ring makes this structurally unreachable for
+            # storage nodes; the counter is the drill's assertion that
+            # it STAYS that way (a regression here turns every hot read
+            # into a WAN round-trip)
+            self.cross_zone_probes += 1
+            registry().inc("cache_tier_cross_zone_probe")
         try:
             resp = await m.rpc.call(
                 m.endpoint, owner,
@@ -251,7 +291,16 @@ class ClusterCacheTier:
 
     def note_hints(self, from_node: bytes, hashes) -> None:
         """Inbound hints from a peer's ping. Bounded both ways: at most
-        HINT_ACCEPT_MAX per ping, at most HINT_MAX remembered."""
+        HINT_ACCEPT_MAX per ping, at most HINT_MAX remembered. Filtered
+        to THIS zone on receipt (the outbound ping payload is shared by
+        all peers, so the receive side is where intra-zone hint gossip
+        is enforced): another zone's hot set must not make is_hot()
+        send our background reads probing across the WAN."""
+        my_zone = self._zone_of(self.manager.system.id)
+        if my_zone is not None and self._zone_of(from_node) != my_zone:
+            self.hints_dropped_cross_zone += 1
+            registry().inc("cache_tier_hint_drop_cross_zone")
+            return
         now = time.monotonic()
         for h in list(hashes)[:HINT_ACCEPT_MAX]:
             if not isinstance(h, bytes) or len(h) != 32:
@@ -279,7 +328,10 @@ class ClusterCacheTier:
     def stats(self) -> dict:
         return {
             "enabled": self.enabled,
+            "zone": self._zone_of(self.manager.system.id),
             "members": len(self.members()),
+            "cross_zone_probes": self.cross_zone_probes,
+            "hints_dropped_cross_zone": self.hints_dropped_cross_zone,
             "hints_known": len(self._hints),
             "hint_top_n": self.hint_top_n,
             "probes": self.probes,

@@ -629,15 +629,18 @@ class BlockManager:
                 # that failed in the same wait round must have its
                 # exception retrieved, or asyncio logs an orphan
                 won = None
+                won_node = None
                 for _node, was_hedged, t in done:
                     try:
                         resp = t.result()
                         if won is None and resp.get("data") is not None:
                             won = resp["data"]
+                            won_node = _node
                             race.note_success(was_hedged)
                     except Exception as e:
                         errs.append(e)
                 if won is not None:
+                    self._count_remote_read(won_node, len(won))
                     return won, False
                 # every holder in this round failed or had no copy:
                 # move down the list
@@ -648,6 +651,21 @@ class BlockManager:
             # still needs its exception consumed
             race.cancel_pending()
         raise MissingBlock(hash32)
+
+    def _count_remote_read(self, node: bytes, nbytes: int) -> None:
+        """Remote-read byte accounting by zone locality (ISSUE 16):
+        request_order keeps reads local-zone-first, so the cross-zone
+        series should stay a small fraction of the total — bench_zone
+        and the zone-partition drill assert on exactly that ratio."""
+        registry().inc("block_remote_read_bytes", nbytes)
+        layout = self.system.layout_helper.current()
+        mine = layout.node_role(self.system.id)
+        theirs = layout.node_role(node)
+        if mine is None or theirs is None \
+                or not mine.zone or not theirs.zone:
+            return
+        if mine.zone != theirs.zone:
+            registry().inc("block_cross_zone_read_bytes", nbytes)
 
     async def _get_erasure(self, hash32: bytes) -> bytes:
         """Gather k shards, decode, verify against the content address.

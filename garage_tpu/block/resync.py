@@ -195,6 +195,17 @@ class BlockResyncManager:
         lm = getattr(s, "layout_manager", None)
         if lm is None:
             return False
+        # pessimistic tracker (ISSUE 16 residual): hold the report until
+        # every OTHER sync source (the table syncers) has reported v.
+        # block_ref rows land — and enqueue their fetches via the ref
+        # trigger — strictly BEFORE their table source reports, so once
+        # the tables are through and our queue/error/in-flight state is
+        # empty, every row-triggered fetch has genuinely drained. Until
+        # then an empty queue may only mean the rows haven't arrived
+        # yet, and reporting would let the cluster GC a layout version
+        # this node still needs to source those blocks from.
+        if not lm.sources_synced_through(v, exclude="blocks"):
+            return False
         lm.sync_until_from("blocks", v)
         return True
 

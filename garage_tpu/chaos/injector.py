@@ -45,6 +45,16 @@ Fault kinds:
   rpc_hang        the call never completes: sleep out the caller's full
                   timeout, then raise asyncio.TimeoutError (exactly the
                   caller-visible shape of a hung peer)
+  partition_zone  sever every CROSS-zone link of the zone named by the
+                  `zone` scope field (ISSUE 16): a frame whose two
+                  endpoints straddle the zone boundary dies with
+                  ConnectionError in both directions, while intra-zone
+                  links — inside AND outside the named zone — stay up.
+                  Zone membership comes from the controller's
+                  `zone_resolver` (installed from the layout by the
+                  composition root; nodes it can't resolve are never
+                  matched). This is the whole-failure-domain drill the
+                  single-link net_disconnect can't express.
 
 The controller is process-global (`arm()`/`disarm()`); a live node also
 exposes it through admin `GET/POST /v1/chaos` and the `[chaos]` config
@@ -63,10 +73,12 @@ from typing import Optional
 from ..utils.metrics import registry
 
 NET_KINDS = ("net_delay", "net_drop", "net_disconnect", "net_slow")
+ZONE_KINDS = ("partition_zone",)
 DISK_READ_KINDS = ("disk_read_error", "disk_bitrot")
 DISK_WRITE_KINDS = ("disk_write_error", "disk_torn_write")
 RPC_KINDS = ("rpc_error", "rpc_hang")
-ALL_KINDS = NET_KINDS + DISK_READ_KINDS + DISK_WRITE_KINDS + RPC_KINDS
+ALL_KINDS = (NET_KINDS + ZONE_KINDS + DISK_READ_KINDS
+             + DISK_WRITE_KINDS + RPC_KINDS)
 
 _HANG_FALLBACK = 3600.0  # a hang with no caller timeout still ends
 
@@ -90,6 +102,7 @@ class FaultSpec:
     peer: str = ""        # remote node id hex prefix (net/rpc faults)
     endpoint: str = ""    # rpc endpoint path prefix
     hash_prefix: str = ""  # block hash hex prefix (disk faults)
+    zone: str = ""        # partitioned zone name (partition_zone)
     delay_s: float = 0.05
     rate_bps: float = 1 << 20
     id: int = 0
@@ -103,6 +116,7 @@ class FaultSpec:
             "id": self.id, "kind": self.kind, "prob": self.prob,
             "count": self.count, "node": self.node, "peer": self.peer,
             "endpoint": self.endpoint, "hash_prefix": self.hash_prefix,
+            "zone": self.zone,
             "delay_s": self.delay_s, "rate_bps": self.rate_bps,
             "fired": self.fired, "exhausted": self.exhausted(),
         }
@@ -115,6 +129,12 @@ class ChaosController:
         self.seed = seed
         self.rng = random.Random(seed)
         self.faults: list[FaultSpec] = []
+        # node_id -> zone name (or None): installed by the composition
+        # root / test harness from the layout (zones/health.py
+        # layout_zone_resolver) so partition_zone faults can tell which
+        # side of a frame sits in the named zone. Resolution only runs
+        # while a partition_zone fault is armed.
+        self.zone_resolver = None
         self._next_id = 1
         # seam-crossing evaluation happens on the event loop; arming
         # can come from admin handlers on the same loop or from test
@@ -166,13 +186,20 @@ class ChaosController:
     # ---- matching ------------------------------------------------------
 
     def _fire(self, kinds, node: bytes = b"", peer: bytes = b"",
-              endpoint: str = "", hash32: bytes = b"") -> Optional[FaultSpec]:
+              endpoint: str = "", hash32: bytes = b"",
+              zone_pair=None) -> Optional[FaultSpec]:
         """First armed, in-scope, in-budget fault of one of `kinds`
         whose probability draw passes — with its fired counter already
         advanced. Runs under the lock: disk seams cross from
         asyncio.to_thread worker threads while net/rpc seams run on
         the event loop, and both the count budget and the seeded draw
-        order must survive that."""
+        order must survive that.
+
+        `zone_pair` is (local_zone, peer_zone) as resolved by the net
+        seam — a partition_zone fault matches only when both sides
+        resolved and EXACTLY ONE of them sits in the named zone (the
+        cross-zone links of that zone; intra-zone traffic anywhere
+        stays untouched)."""
         node_hex = node.hex() if node else ""
         peer_hex = peer.hex() if peer else ""
         hash_hex = hash32.hex() if hash32 else ""
@@ -180,6 +207,14 @@ class ChaosController:
             for f in self.faults:
                 if f.kind not in kinds or f.exhausted():
                     continue
+                if f.kind in ZONE_KINDS:
+                    if not f.zone or zone_pair is None:
+                        continue
+                    lz, pz = zone_pair
+                    if lz is None or pz is None:
+                        continue
+                    if (lz == f.zone) == (pz == f.zone):
+                        continue
                 if f.node and not node_hex.startswith(f.node):
                     continue
                 if f.peer and not peer_hex.startswith(f.peer):
@@ -205,8 +240,14 @@ class ChaosController:
                         nbytes: int) -> bool:
         """Net seam, called per frame from Conn send/recv. Returns False
         when the frame must be DROPPED; may sleep (delay/slow) or raise
-        ConnectionError (disconnect)."""
-        f = self._fire(NET_KINDS, node=local, peer=peer)
+        ConnectionError (disconnect / partition_zone)."""
+        zone_pair = None
+        if self.zone_resolver is not None and local and peer \
+                and any(f.kind in ZONE_KINDS for f in self.faults):
+            zone_pair = (self.zone_resolver(local),
+                         self.zone_resolver(peer))
+        f = self._fire(NET_KINDS + ZONE_KINDS, node=local, peer=peer,
+                       zone_pair=zone_pair)
         if f is None:
             return True
         if f.kind == "net_delay":
@@ -217,6 +258,9 @@ class ChaosController:
             return True
         if f.kind == "net_drop":
             return False
+        if f.kind == "partition_zone":
+            raise ConnectionError(
+                f"chaos: zone {f.zone} partitioned ({direction})")
         raise ConnectionError(
             f"chaos: injected disconnect ({direction})")
 

@@ -222,7 +222,17 @@ class Garage:
 
         # ---- fault injection ([chaos] section) -------------------------
         # boot-time arming for chaos experiments / CI; runtime control
-        # stays available through admin GET/POST /v1/chaos either way
+        # stays available through admin GET/POST /v1/chaos either way.
+        # The zone resolver is installed unconditionally (cheap: one
+        # attribute write) so a partition_zone fault armed later via
+        # admin POST /v1/chaos can resolve frame endpoints to zones —
+        # every node converges on the same layout, so any node's view
+        # serves the process-global controller.
+        from ..chaos import controller as chaos_controller
+        from ..zones import layout_zone_resolver
+
+        chaos_controller().zone_resolver = layout_zone_resolver(
+            self.system.layout_manager)
         if config.chaos.enable:
             from ..chaos import FaultSpec, arm
 

@@ -449,8 +449,12 @@ class Conn:
         channel: SecureChannel,
         handler: Callable[..., Awaitable],
         initiator: bool,
+        local_id: bytes = b"",
     ):
         self.peer_id = peer_id
+        # our own node id, for chaos scoping only (partition_zone needs
+        # BOTH endpoints of a frame; defaulted empty for bare tests)
+        self.local_id = local_id
         self.chan = channel
         self.handler = handler  # (peer_id, path, prio, order, payload, stream)
         self._next_id = 2 if initiator else 3
@@ -627,7 +631,7 @@ class Conn:
         No-op fast path when chaos is disarmed."""
         if _chaos.ACTIVE is None:
             return True
-        return await _chaos.ACTIVE.net_frame(direction, b"",
+        return await _chaos.ACTIVE.net_frame(direction, self.local_id,
                                              self.peer_id, nbytes)
 
     async def _send_one_chunk(self, item: _SendItem) -> None:

@@ -244,7 +244,8 @@ class NetApp:
                 chan.close()
                 return
             spawn(old.close(), "netapp-replace-conn-close")
-        conn = Conn(peer_id, chan, self._handle_request, initiator)
+        conn = Conn(peer_id, chan, self._handle_request, initiator,
+                    local_id=self.id)
         self.conns[peer_id] = conn
         conn.start()
         conn.closed.add_done_callback(lambda _: self._on_conn_closed(peer_id, conn))

@@ -13,6 +13,15 @@ guarantees, independent implementation:
    (partition -> node) edges present in the previous layout and cost 1
    to new ones, then cancelling negative cycles until the flow is
    min-cost.
+3. Zone spread is maximized BEYOND the zone_redundancy floor: the
+   (partition -> zone) edge is split into a cap-1 cost-0 slot plus a
+   cap-(rf-zr+1-1) slot costing SPREAD_COST per doubled replica, with
+   SPREAD_COST > the largest possible total movement cost so the
+   min-cost pass lexicographically prefers one-replica-per-zone
+   placements and only then minimizes movement. Without this, a
+   6-node/3-zone rf=3 zr=2 cluster legally doubles two replicas of
+   every partition into one zone — losing that zone then kills
+   R=2/W=2 quorums even though zone_redundancy=2 was satisfied.
 
 `check_against_naive` (tests/test_layout.py) mirrors the reference's
 optimality test: the computed partition size must be >= a naive greedy
@@ -27,6 +36,12 @@ from .graph import FlowGraph
 from .version import N_PARTITIONS, LayoutVersion, NodeRole
 
 SRC, SINK = "src", "sink"
+
+# Cost of placing a second/third replica of a partition into a zone
+# that already holds one. Movement cost totals at most
+# N_PARTITIONS * rf (= 768 at rf 3), so any value above that makes
+# spread maximization strictly dominate movement minimization.
+SPREAD_COST = 1024
 
 
 class LayoutError(Exception):
@@ -52,14 +67,24 @@ def _build_graph(
 ) -> FlowGraph:
     g = FlowGraph()
     per_zone_cap = rf - zr + 1
+    costed = prev_edges is not None
     for p in range(N_PARTITIONS):
         g.add_edge(SRC, ("p", p), rf)
         for z in set(z for z in zones):
-            g.add_edge(("p", p), ("pz", p, z), per_zone_cap)
+            if costed and per_zone_cap > 1:
+                # parallel edges: the first replica in a zone is free,
+                # every doubled one costs SPREAD_COST — min-cost flow
+                # then spreads replicas across zones whenever capacity
+                # allows, with zr still the hard feasibility floor
+                g.add_edge(("p", p), ("pz", p, z), 1, 0)
+                g.add_edge(("p", p), ("pz", p, z), per_zone_cap - 1,
+                           SPREAD_COST)
+            else:
+                g.add_edge(("p", p), ("pz", p, z), per_zone_cap)
     for i, (node, role) in enumerate(storage):
         for p in range(N_PARTITIONS):
-            cost = 0 if prev_edges is not None and (p, i) in prev_edges else 1
-            g.add_edge(("pz", p, role.zone), ("n", i), 1, cost if prev_edges is not None else 0)
+            cost = 0 if costed and (p, i) in prev_edges else 1
+            g.add_edge(("pz", p, role.zone), ("n", i), 1, cost if costed else 0)
         g.add_edge(("n", i), SINK, role.capacity // size if size > 0 else 0)
     return g
 

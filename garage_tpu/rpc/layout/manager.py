@@ -158,6 +158,18 @@ class LayoutManager:
             self._sync_done[name] = version
         self._report_sync(min(self._sync_done.values()))
 
+    def sources_synced_through(self, version: int,
+                               exclude: str = "") -> bool:
+        """Whether every registered sync source other than `exclude`
+        has reported `version` (vacuously true with none registered).
+        The block layer gates its own report on this (resync.py
+        maybe_report_synced): a block_ref row that lands AFTER blocks
+        reported — but before its table's round finished — would
+        otherwise be unprotected by the tracker, so blocks reporting
+        before the tables is exactly the premature-report hazard."""
+        return all(v >= version for name, v in self._sync_done.items()
+                   if name != exclude)
+
     def sync_table_until(self, version: int) -> None:
         """Un-sourced report — single-layer deployments and tests that
         drive the tracker directly (ref: manager.rs:120-133)."""

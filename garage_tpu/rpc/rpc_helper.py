@@ -41,6 +41,18 @@ tracker (net/peering.py PeerHealthTracker) and reads it back:
 - **Named errors**: every transport failure is wrapped so the surfaced
   message carries the peer id and endpoint (`QuorumError.errors`
   entries included) — a bare `TimeoutError` gives operators nothing.
+- **Zone-aware quorums** (ISSUE 16, garage_tpu/zones/): request_order
+  already prefers same-zone peers, so reads are local-zone-first and
+  hedges naturally spill cross-zone; on top of that, nodes sitting in
+  a zone `ZoneHealth` reports PARTITIONED sort dead last even while
+  their conn state flaps through reconnect churn. Writes pre-verify
+  that every quorum set actually spans the layout's `zone_redundancy`
+  zones and raise the typed `ZoneSpanError` when placement can't — a
+  mis-spread set would otherwise "succeed" W=2 inside one failure
+  domain. A per-request `ConsistencyMode.DEGRADED` override on
+  `RequestStrategy` lets a caller serve a read from whatever zones
+  survive a partition (effective quorum 1, Dynamo-style sloppy read)
+  without flipping the whole cluster out of consistent mode.
 """
 
 from __future__ import annotations
@@ -52,8 +64,9 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from ..net.message import PRIO_NORMAL
-from ..utils.error import QuorumError, RpcError
+from ..utils.error import QuorumError, RpcError, ZoneSpanError
 from ..utils.metrics import registry
+from .replication_mode import ConsistencyMode
 from .system import System
 
 
@@ -192,6 +205,14 @@ class RequestStrategy:
     # True/False forces it for this call (bench A/B, writes that must
     # never duplicate)
     hedge: Optional[bool] = None
+    # per-request consistency override (ISSUE 16): DEGRADED lets THIS
+    # read serve from the surviving zones during a zone partition
+    # (effective quorum 1) while the cluster default stays consistent;
+    # None = use strategy.quorum as given
+    consistency: Optional[ConsistencyMode] = None
+    # required distinct zones per write set: None = derive from the
+    # current layout's zone_redundancy; 0 = skip the check explicitly
+    zone_span: Optional[int] = None
 
 
 class QuorumSetResultTracker:
@@ -265,25 +286,40 @@ class RpcHelper:
     # ---- node ordering (ref: rpc_helper.rs:621-660) --------------------
 
     def request_order(self, nodes: list[bytes]) -> list[bytes]:
-        """self first; then breaker state (open/exhausted peers last),
-        same-zone, ping."""
+        """self first; then nodes in partitioned zones last, breaker
+        state (open/exhausted peers behind healthy), same-zone, ping.
+
+        The same-zone rank is what makes reads local-zone-FIRST: the
+        initial `quorum` launches land in-zone whenever enough local
+        replicas exist, and hedges walk the order into other zones only
+        when the local ones stall — cross-WAN reads are the fallback,
+        not the default. The partitioned-zone rank (zones/health.py)
+        exists because a severed link flaps: reconnect succeeds, the
+        first frame dies, and for that window conn state + breaker both
+        look healthy while every call into the zone will fail."""
         my_zone = None
         role = self.system.layout_helper.current().node_role(self.netapp.id)
         if role is not None:
             my_zone = role.zone
         health = self.health()
+        zone_health = getattr(self.system, "zone_health", None)
+        dead_zones = (zone_health.partitioned_zones()
+                      if zone_health is not None else set())
         now = time.monotonic()
 
         def key(n: bytes):
             if n == self.netapp.id:
-                return (0, 0, 0, 0.0)
+                return (0, 0, 0, 0, 0.0)
             role = self.system.layout_helper.current().node_role(n)
             same_zone = role is not None and my_zone is not None and role.zone == my_zone
+            partitioned = (role is not None and bool(role.zone)
+                           and role.zone in dead_zones)
             ping = self.system.peering.ping_avg(n)
             connected = self.system.is_up(n)
             brk = health.breaker_rank(n, now) if health is not None else 0
             return (
                 1,
+                1 if partitioned else 0,
                 brk,
                 1 if (same_zone and connected) else (2 if connected else 3),
                 ping if ping is not None else 1.0,
@@ -358,6 +394,13 @@ class RpcHelper:
         costs one hedge delay, not its whole timeout. First success
         wins; with interrupt_stragglers the losers are cancelled."""
         quorum = strategy.quorum
+        if strategy.consistency == ConsistencyMode.DEGRADED and quorum > 1:
+            # per-request sloppy read: any one replica answers — the
+            # caller chose availability over read-your-writes for THIS
+            # request (a zone is partitioned and the consistent quorum
+            # would need it)
+            registry().inc("rpc_degraded_read", endpoint=endpoint.path)
+            quorum = 1
         if quorum > len(nodes):
             raise QuorumError(quorum, 1, 0, len(nodes), ["not enough nodes"])
         order = self.request_order(list(nodes))
@@ -407,6 +450,56 @@ class RpcHelper:
             # retrieved"
             race.cancel_pending(cancel=strategy.interrupt_stragglers)
 
+    # ---- zone-span verification (ISSUE 16) -----------------------------
+
+    def _verify_zone_span(self, endpoint, write_sets, strategy,
+                          node_of) -> None:
+        """Pre-flight: every write set must span the required number of
+        distinct zones, else raise the typed ZoneSpanError BEFORE any
+        replica is written. `strategy.zone_span` overrides (0 = skip);
+        None derives the requirement from the current layout's
+        zone_redundancy. Conservative by design: a set containing a
+        node with no zone in the current layout (old-version member
+        mid-transition, zoneless test stub) is skipped rather than
+        failed — the check exists to catch mis-spread placement, not to
+        wedge transitions. A DEGRADED-override write also skips it: the
+        caller already chose availability over placement guarantees."""
+        if strategy.zone_span == 0 \
+                or strategy.consistency == ConsistencyMode.DEGRADED:
+            return
+        layout = self.system.layout_helper.current()
+        required = strategy.zone_span
+        if required is None:
+            zr = getattr(layout, "zone_redundancy", None)
+            if zr == "maximum":
+                all_zones = set()
+                for n in layout.storage_nodes():
+                    role = layout.node_role(n)
+                    if role is not None and role.zone:
+                        all_zones.add(role.zone)
+                required = min(layout.replication_factor, len(all_zones))
+            elif isinstance(zr, int):
+                required = zr
+            else:
+                return
+        if required <= 1:
+            return
+        for s in write_sets:
+            zones = set()
+            for key in s:
+                role = layout.node_role(node_of(key))
+                if role is None or not role.zone:
+                    zones = None
+                    break
+                zones.add(role.zone)
+            if zones is None:
+                continue
+            if len(zones) < required:
+                registry().inc("rpc_zone_span_reject",
+                               endpoint=endpoint.path)
+                raise ZoneSpanError(required, len(zones), sorted(zones),
+                                    len(s))
+
     # ---- try_write_many_sets (ref: rpc_helper.rs:413-538) --------------
 
     async def try_write_many_sets(
@@ -446,6 +539,8 @@ class RpcHelper:
             # quorum keys are node ids, or (node, shard_index) tuples on
             # the erasure path
             return key[0] if isinstance(key, tuple) else key
+
+        self._verify_zone_span(endpoint, write_sets, strategy, node_of)
 
         async def one(key, hedged: bool = False):
             t0 = time.monotonic()

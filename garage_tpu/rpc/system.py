@@ -162,6 +162,13 @@ class System:
         self.layout_manager = LayoutManager(netapp, meta_dir, replication)
         self.node_status: dict[bytes, tuple[float, NodeStatus]] = {}
 
+        # per-zone health rollup (garage_tpu/zones/): stateless
+        # derivation over peering + layout, serves GET /v1/zones and
+        # the zone-aware quorum strategy's partitioned-zone checks
+        from ..zones import ZoneHealth
+
+        self.zone_health = ZoneHealth(self)
+
         self.ep = netapp.endpoint("garage_rpc/system").set_handler(self._handle)
         netapp.on_connected.append(self._on_peer_connected)
         self._stop = asyncio.Event()

@@ -189,17 +189,25 @@ class Table:
             self._control_invalidate(
                 (e.partition_key(), e.sort_key()))
 
-    async def get(self, pk: bytes, sk: bytes) -> Optional[Entry]:
+    async def get(self, pk: bytes, sk: bytes,
+                  consistency=None) -> Optional[Entry]:
         """Read-quorum get with CRDT merge + background read-repair.
-        ref: table.rs:287-361."""
+        ref: table.rs:287-361.
+
+        `consistency=ConsistencyMode.DEGRADED` (ISSUE 16) is the
+        per-request escape hatch for zone partitions: serve from any
+        one surviving replica instead of failing the consistent
+        quorum. The merge/read-repair machinery still runs on whatever
+        replicas answered."""
         from ..utils.metrics import registry
         from ..utils.tracing import span
 
         registry().inc("table_get_total", table=self.name)
         async with span("table.get", table=self.name):
-            return await self._get_traced(pk, sk)
+            return await self._get_traced(pk, sk, consistency)
 
-    async def _get_traced(self, pk: bytes, sk: bytes) -> Optional[Entry]:
+    async def _get_traced(self, pk: bytes, sk: bytes,
+                          consistency=None) -> Optional[Entry]:
         ph = partition_hash(pk)
         nodes = self.replication.read_nodes(ph)
         # Gateway node reading a full-copy (control) table: it holds no
@@ -220,7 +228,8 @@ class Table:
             self.endpoint,
             nodes,
             {"op": "read_entry", "pk": pk, "sk": sk},
-            RequestStrategy(quorum=self.replication.read_quorum()),
+            RequestStrategy(quorum=self.replication.read_quorum(),
+                            consistency=consistency),
         )
         ret: Optional[Entry] = None
         raws = []
@@ -242,8 +251,9 @@ class Table:
                         flt=None, limit: int = 100,
                         reverse: bool = False,
                         prefix_sk: Optional[bytes] = None,
-                        end_sk: Optional[bytes] = None) -> list[Entry]:
-        """ref: table.rs:363-483."""
+                        end_sk: Optional[bytes] = None,
+                        consistency=None) -> list[Entry]:
+        """ref: table.rs:363-483. `consistency` as in get()."""
         ph = partition_hash(pk)
         nodes = self.replication.read_nodes(ph)
         resps = await self.rpc.try_call_many(
@@ -252,7 +262,8 @@ class Table:
             {"op": "read_range", "pk": pk, "start_sk": start_sk,
              "limit": limit, "reverse": reverse, "filter": flt,
              "prefix_sk": prefix_sk, "end_sk": end_sk},
-            RequestStrategy(quorum=self.replication.read_quorum()),
+            RequestStrategy(quorum=self.replication.read_quorum(),
+                            consistency=consistency),
         )
         by_key: dict[tuple, Entry] = {}
         raw_seen: dict[tuple, set] = {}

@@ -23,6 +23,24 @@ class QuorumError(GarageError):
         )
 
 
+class ZoneSpanError(QuorumError):
+    """A write quorum set cannot span the required number of zones
+    (ISSUE 16 zone-aware quorums). Subclasses QuorumError so callers
+    that already treat quorum failures as retryable/unavailable degrade
+    gracefully; the distinct type lets operators tell "placement can't
+    satisfy zone_redundancy" apart from "nodes were down"."""
+
+    def __init__(self, required: int, got: int, zones: list[str], total: int):
+        self.required_zones, self.got_zones, self.zone_list = required, got, zones
+        super(QuorumError, self).__init__(
+            f"write set spans {got} zone(s) {zones} < required zone span "
+            f"{required} across {total} node(s)"
+        )
+        # QuorumError field shape, for handlers that introspect it
+        self.quorum, self.sets, self.ok, self.total, self.errors = (
+            required, None, got, total, [])
+
+
 class CorruptData(GarageError):
     def __init__(self, hash_: bytes):
         self.hash = hash_

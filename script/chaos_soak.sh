@@ -88,6 +88,19 @@ JAX_PLATFORMS=cpu GARAGE_TPU_DEVICE=off "$PY" -m pytest \
 JAX_PLATFORMS=cpu GARAGE_TPU_DEVICE=off "$PY" bench.py bench_cache_tier \
     --nblocks "$TIER_BLOCKS"
 
+# zone subsystem smoke (ISSUE 16): the 3-zone partition drill — a
+# whole zone severed under Zipf load with zero failed consistent
+# quorum ops, DEGRADED-override reads from both sides of the cut, and
+# counter-asserted intra-zone cache probes — plus bench_zone, whose
+# local-vs-forced-cross-zone GET latency split and cross-zone byte
+# counters land in the nightly trajectory. Runs under the sanitizer
+# like everything above: a zone partition that wedges a loop fails.
+say "zone smoke: partition-a-whole-zone drill + bench_zone"
+JAX_PLATFORMS=cpu GARAGE_TPU_DEVICE=off "$PY" -m pytest \
+    tests/test_zones.py -q -p no:cacheprovider \
+    -k "drill or degraded_override or partition_zone_fault"
+JAX_PLATFORMS=cpu GARAGE_TPU_DEVICE=off "$PY" bench.py bench_zone
+
 # a stall/leak/conservation report anywhere in the soak — including
 # inside a forked worker whose parent test still passed — fails the
 # job; the report text names the pinned frame

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import random
 import time
 from typing import Optional
 
@@ -63,12 +64,23 @@ class ClusterBox:
                  governor: bool = False,
                  status_interval: float = 0.1,
                  ping_interval: float = 0.3,
-                 resync_retry_delay: float = 0.25):
+                 resync_retry_delay: float = 0.25,
+                 zones: Optional[list[str]] = None,
+                 zone_redundancy=None):
         self.tmp = str(tmp_path)
         self.n = n
         self.rf = rf
         self.erasure = erasure
         self.storage = set(range(n)) if storage is None else set(storage)
+        # zone topology (ISSUE 16): one zone name per node index, e.g.
+        # ["z1","z1","z2","z2","z3","z3"]. Default: everyone in "z1",
+        # which keeps every pre-zone test byte-identical in behavior.
+        # zone_redundancy (int or "maximum") is staged with the first
+        # layout when given; None leaves the layout default intact.
+        if zones is not None and len(zones) != n:
+            raise ValueError(f"zones has {len(zones)} entries for {n} nodes")
+        self.zones = zones if zones is not None else ["z1"] * n
+        self.zone_redundancy = zone_redundancy
         self.db_engine = db_engine
         self.governor = governor
         self.status_interval = status_interval
@@ -127,13 +139,18 @@ class ClusterBox:
         lm = self.nodes[0].system.layout_manager
         for i, nd in enumerate(self.nodes):
             if i in self.storage:
-                # one zone for everyone: with zone_redundancy "maximum"
-                # a 3-zone spread forces every partition onto the
-                # single-node zones and a newly added node in a full
-                # zone would get ZERO partitions — resize experiments
-                # want capacity-driven movement, not zone pinning
+                # default topology is one zone for everyone: with
+                # zone_redundancy "maximum" a per-node-zone spread
+                # forces every partition onto the single-node zones and
+                # a newly added node in a full zone would get ZERO
+                # partitions — resize experiments want capacity-driven
+                # movement, not zone pinning. Zone drills pass zones=
+                # (+ usually an explicit zone_redundancy) instead.
                 lm.history.stage_role(
-                    nd.id, NodeRole(zone="z1", capacity=1 << 30))
+                    nd.id, NodeRole(zone=self.zones[i],
+                                    capacity=1 << 30))
+        if self.zone_redundancy is not None:
+            lm.history.stage_parameters(self.zone_redundancy)
         lm.apply_staged(None)
         await self.wait(lambda: all(
             nd.system.layout_manager.history.current().version == 1
@@ -227,11 +244,18 @@ class Workload:
     instrument behind 'zero failed quorum reads/writes mid-resize'."""
 
     def __init__(self, box: ClusterBox, obj_kib: int = 64,
-                 period: float = 0.03, op_timeout: float = 30.0):
+                 period: float = 0.03, op_timeout: float = 30.0,
+                 zipf: Optional[float] = None, zipf_seed: int = 1234):
         self.box = box
         self.obj_kib = obj_kib
         self.period = period
         self.op_timeout = op_timeout
+        # Zipf-like GET skew (ISSUE 16 zone drill): with exponent s,
+        # read index = floor(len * u**s) for u ~ U(0,1) — s=0/None is
+        # the old round-robin, s>=3 concentrates reads on the oldest
+        # few objects (the "hot set" the cache tier should own)
+        self.zipf = zipf
+        self._zrng = random.Random(zipf_seed)
         self.bucket_id = gen_uuid()
         self.stored: list[tuple[bytes, bytes]] = []  # (hash, data)
         self.put_lat: list[float] = []
@@ -269,7 +293,13 @@ class Workload:
                     self.stored.append((h, data))
                     self.put_lat.append(time.perf_counter() - t0)
                 else:
-                    h, data = self.stored[self._n % len(self.stored)]
+                    if self.zipf:
+                        idx = int(len(self.stored)
+                                  * (self._zrng.random() ** self.zipf))
+                        idx = min(idx, len(self.stored) - 1)
+                    else:
+                        idx = self._n % len(self.stored)
+                    h, data = self.stored[idx]
                     got = await asyncio.wait_for(
                         g0.block_manager.rpc_get_block(
                             h, cacheable=False),

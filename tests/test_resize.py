@@ -81,6 +81,56 @@ def test_sync_tracker_gated_on_all_sources(tmp_path):
     run(main())
 
 
+def test_blocks_report_held_until_tables_synced(tmp_path):
+    """Regression (ISSUE 16 residual): the block layer's sync report is
+    PESSIMISTIC. block_ref rows land — and enqueue their block fetches
+    via the ref trigger — strictly before their table source reports a
+    version, so a drained resync backlog proves nothing while a table
+    round is still running: the rows that would refill the queue may
+    simply not have arrived. maybe_report_synced must hold the "blocks"
+    report until every other registered source is through."""
+    import types
+
+    from garage_tpu.block.resync import BlockResyncManager
+    from garage_tpu.db import open_db
+
+    async def main():
+        net = LocalNetwork()
+        app = NetApp(b"resize-test")
+        net.register(app)
+        lm = LayoutManager(app, str(tmp_path), ReplicationMode.parse(1))
+        lm.history.stage_role(app.id, NodeRole(zone="z", capacity=1 << 30))
+        lm.apply_staged(None)
+        lm.register_sync_source("table:a")
+        lm.register_sync_source("blocks")
+
+        db = open_db(str(tmp_path / "resync"), engine="memory")
+        system = types.SimpleNamespace(layout_manager=lm,
+                                       layout_helper=lm.helper)
+        rsm = BlockResyncManager(
+            types.SimpleNamespace(system=system), db)
+        # enumeration for v1 completed, backlog fully drained — the
+        # exact state that used to report prematurely
+        rsm._enumerated_version = 1
+        assert rsm.queue_len() == 0 and rsm.errors_len() == 0
+
+        sync = lm.history.update_trackers.sync
+        assert not rsm.maybe_report_synced(), \
+            "blocks reported while table:a was still syncing"
+        assert lm._sync_done["blocks"] == 0
+        assert sync.get(app.id, 0) == 0
+
+        lm.sync_until_from("table:a", 1)
+        assert rsm.maybe_report_synced()
+        assert lm._sync_done["blocks"] == 1
+        assert sync.get(app.id, 0) == 1
+        # idempotent re-report stays true once through
+        assert rsm.maybe_report_synced()
+        await asyncio.sleep(0)  # let spawned broadcasts settle
+
+    run(main())
+
+
 def test_governor_resync_backlog_signal():
     """A deep rebalance backlog pushes pressure UP while foreground
     traffic is active (rebalance yields to p99) and is ignored when

@@ -1,0 +1,533 @@
+"""Zones as a first-class subsystem (ISSUE 16).
+
+Unit coverage for the zone layer — ZoneHealth rollup, zone-aware
+request ordering, write zone-span verification, the per-request
+DEGRADED consistency override, the per-zone cache-tier ring, the
+partition_zone chaos fault — plus the acceptance drill: a 3-zone /
+6-node cluster-in-a-box under Zipf load loses a whole zone and must
+keep serving consistent quorums with zero failures, report the
+partition via GET /v1/zones within about one peering interval, serve
+DEGRADED-override reads from the surviving side of the cut, and keep
+hot-block cache probes strictly intra-zone (counter-asserted).
+"""
+
+import asyncio
+import json
+import socket
+import time
+import urllib.request
+
+import pytest
+
+from garage_tpu.chaos import FaultSpec, arm, disarm
+from garage_tpu.chaos.injector import ChaosController
+from garage_tpu.rpc import ReplicationMode, RequestStrategy, RpcHelper
+from garage_tpu.rpc.layout import NodeRole
+from garage_tpu.rpc.replication_mode import ConsistencyMode
+from garage_tpu.utils.error import QuorumError, ZoneSpanError
+from garage_tpu.utils.metrics import registry
+from garage_tpu.zones import ZoneState
+from garage_tpu.zones.health import SUSPECT_FAILED_PINGS
+
+from clusterbox import ClusterBox, Workload
+from test_rpc import _wait, make_cluster, stop_cluster
+
+
+def run(coro, timeout=240.0):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    disarm()
+    yield
+    disarm()
+
+
+def apply_zoned_layout(systems, zones, rf=3, zone_redundancy=None):
+    """Stage every system with a zone from `zones` (by index) and
+    apply on node 0."""
+    lm = systems[0].layout_manager
+    for s, z in zip(systems, zones):
+        lm.history.stage_role(s.id, NodeRole(zone=z, capacity=1 << 30))
+    if zone_redundancy is not None:
+        lm.history.stage_parameters(zone_redundancy)
+    lm.apply_staged(None)
+
+
+# ---- ZoneHealth ---------------------------------------------------------
+
+
+def test_zone_health_rollup(tmp_path):
+    """up -> degraded -> partitioned as a zone's nodes drop, from the
+    surviving observer's point of view; the local zone never reports
+    partitioned to itself."""
+
+    async def main():
+        net, systems, tasks = await make_cluster(tmp_path, 4)
+        try:
+            apply_zoned_layout(systems, ["z1", "z1", "z2", "z2"])
+            await _wait(lambda: all(
+                s.layout_manager.history.current().version == 1
+                for s in systems), 10)
+            zh = systems[0].zone_health
+            assert zh.local_zone() == "z1"
+            assert set(zh.zone_nodes()) == {"z1", "z2"}
+            await _wait(lambda: zh.zone_state("z1") == ZoneState.UP
+                        and zh.zone_state("z2") == ZoneState.UP, 10)
+
+            # half of z2 gone: degraded
+            for other in systems[:3]:
+                net.partition(other.id, systems[3].id)
+            await _wait(lambda: zh.zone_state("z2") == ZoneState.DEGRADED,
+                        15)
+            # all of z2 gone: partitioned — and the snapshot agrees
+            for other in systems[:2]:
+                net.partition(other.id, systems[2].id)
+            await _wait(
+                lambda: zh.zone_state("z2") == ZoneState.PARTITIONED, 15)
+            snap = zh.snapshot()
+            assert snap["localZone"] == "z1"
+            by_zone = {z["zone"]: z for z in snap["zones"]}
+            assert by_zone["z2"]["state"] == "partitioned"
+            assert by_zone["z2"]["nodesUp"] == 0
+            assert len(by_zone["z2"]["downNodes"]) == 2
+            # the observer's own zone stays up (self is always up)
+            assert by_zone["z1"]["state"] == "up"
+            assert zh.partitioned_zones() == {"z2"}
+        finally:
+            await stop_cluster(systems, tasks)
+
+    run(main())
+
+
+def test_zone_health_unknown_zone_and_suspect_pings(tmp_path):
+    """A node with no layout role resolves to no zone (gateways are not
+    zone members); consecutive failed pings alone mark a node down
+    before the conn state machine gives up on the link."""
+
+    async def main():
+        net, systems, tasks = await make_cluster(tmp_path, 3, rf=2)
+        try:
+            # only two nodes get roles: the third is a gateway
+            lm = systems[0].layout_manager
+            for s in systems[:2]:
+                lm.history.stage_role(
+                    s.id, NodeRole(zone="z1", capacity=1 << 30))
+            lm.apply_staged(None)
+            await _wait(lambda: all(
+                s.layout_manager.history.current().version == 1
+                for s in systems), 10)
+            zh = systems[0].zone_health
+            assert zh.zone_of(systems[2].id) is None
+            assert set(zh.zone_nodes()) == {"z1"}
+            assert all(systems[2].id not in members
+                       for members in zh.zone_nodes().values())
+            # suspect-ping path: simulate the counter the ping loop
+            # bumps — two misses is enough to call the node down even
+            # while its conn still looks CONNECTED
+            peer = systems[0].peering.peers[systems[1].id]
+            assert not zh.node_down(systems[1].id)
+            peer.failed_pings = SUSPECT_FAILED_PINGS
+            assert zh.node_down(systems[1].id)
+            assert zh.zone_state("z1") == ZoneState.DEGRADED
+            peer.failed_pings = 0
+        finally:
+            await stop_cluster(systems, tasks)
+
+    run(main())
+
+
+# ---- zone-aware request order + degraded reads --------------------------
+
+
+def test_request_order_shuns_partitioned_zone(tmp_path):
+    """Local zone first; nodes whose whole zone is partitioned sort
+    dead last even while their conn state flaps."""
+
+    async def main():
+        net, systems, tasks = await make_cluster(tmp_path, 4)
+        try:
+            apply_zoned_layout(systems, ["z1", "z1", "z2", "z2"])
+            await _wait(lambda: all(
+                s.layout_manager.history.current().version == 1
+                for s in systems), 10)
+            rpc = RpcHelper(systems[0])
+            ids = [s.id for s in systems]
+            order = rpc.request_order(list(ids))
+            # self first, then the same-zone peer, then z2
+            assert order[0] == systems[0].id
+            assert order[1] == systems[1].id
+            # partition all of z2: its nodes must sort last regardless
+            # of conn flaps — force the scenario via the health rollup
+            for target in systems[2:]:
+                for other in systems:
+                    if other is not target:
+                        net.partition(other.id, target.id)
+            zh = systems[0].zone_health
+            await _wait(
+                lambda: zh.zone_state("z2") == ZoneState.PARTITIONED, 15)
+            order = rpc.request_order(list(ids))
+            assert set(order[2:]) == {systems[2].id, systems[3].id}
+        finally:
+            await stop_cluster(systems, tasks)
+
+    run(main())
+
+
+def test_degraded_override_reads_one_replica(tmp_path):
+    """try_call_many with consistency=DEGRADED succeeds on a single
+    reachable replica where the consistent quorum fails."""
+
+    async def main():
+        net, systems, tasks = await make_cluster(tmp_path, 3)
+        try:
+            apply_zoned_layout(systems, ["z1", "z2", "z3"],
+                               zone_redundancy=2)
+            await _wait(lambda: all(
+                s.layout_manager.history.current().version == 1
+                for s in systems), 10)
+            async def h(frm, payload, stream):
+                return {"ok": True}
+
+            for s in systems:
+                s.netapp.endpoint("test/zdeg").set_handler(h)
+            ep = systems[0].netapp.endpoint("test/zdeg")
+            rpc = RpcHelper(systems[0])
+            ids = [s.id for s in systems]
+            # sever both peers: consistent quorum 2 cannot be met
+            net.partition(systems[0].id, systems[1].id)
+            net.partition(systems[0].id, systems[2].id)
+            await _wait(lambda: not systems[0].is_up(systems[1].id)
+                        and not systems[0].is_up(systems[2].id), 15)
+            with pytest.raises(QuorumError):
+                await rpc.try_call_many(
+                    ep, ids, {"op": "x"},
+                    RequestStrategy(quorum=2, timeout=5.0))
+            before = registry().totals("rpc_degraded_read")[0]
+            resps = await rpc.try_call_many(
+                ep, ids, {"op": "x"},
+                RequestStrategy(quorum=2, timeout=5.0,
+                                consistency=ConsistencyMode.DEGRADED))
+            assert len(resps) >= 1 and resps[0]["ok"]
+            assert registry().totals("rpc_degraded_read")[0] == before + 1
+        finally:
+            await stop_cluster(systems, tasks)
+
+    run(main())
+
+
+# ---- write zone-span verification ---------------------------------------
+
+
+def test_write_zone_span_verification(tmp_path):
+    """A write set confined to fewer zones than zone_redundancy raises
+    the typed ZoneSpanError before any replica is written; spanning
+    sets, unknown-zone sets, zone_span=0 and DEGRADED overrides pass."""
+
+    async def main():
+        net, systems, tasks = await make_cluster(tmp_path, 3)
+        try:
+            apply_zoned_layout(systems, ["z1", "z1", "z2"],
+                               zone_redundancy=2)
+            await _wait(lambda: all(
+                s.layout_manager.history.current().version == 1
+                for s in systems), 10)
+            rpc = RpcHelper(systems[0])
+            ep = type("E", (), {"path": "test/span"})()
+            a, b, c = [s.id for s in systems]
+            node_of = lambda k: k[0] if isinstance(k, tuple) else k  # noqa: E731
+
+            def verify(sets, **kw):
+                rpc._verify_zone_span(ep, sets,
+                                      RequestStrategy(quorum=2, **kw),
+                                      node_of)
+
+            verify([[a, b, c]])            # spans z1+z2: fine
+            verify([[a, c]])               # spans both: fine
+            with pytest.raises(ZoneSpanError) as ei:
+                verify([[a, b]])           # z1 only
+            assert ei.value.required_zones == 2
+            assert ei.value.got_zones == 1
+            assert isinstance(ei.value, QuorumError)  # typed subclass
+            # erasure-style (node, shard) keys resolve through node_of
+            with pytest.raises(ZoneSpanError):
+                verify([[(a, 0), (b, 1)]])
+            # explicit opt-outs and overrides
+            verify([[a, b]], zone_span=0)
+            verify([[a, b]],
+                   consistency=ConsistencyMode.DEGRADED)
+            with pytest.raises(ZoneSpanError):
+                verify([[a, b, c]], zone_span=3)  # stricter than layout
+            # a set containing an unknown node is skipped (conservative)
+            verify([[a, b, b"\x00" * 32]])
+            # end-to-end: try_write_many_sets rejects before writing
+            wep = systems[0].netapp.endpoint("test/span_rpc")
+            with pytest.raises(ZoneSpanError):
+                await rpc.try_write_many_sets(
+                    wep, [[a, b]], {"op": "w"},
+                    RequestStrategy(quorum=2, timeout=5.0))
+        finally:
+            await stop_cluster(systems, tasks)
+
+    run(main())
+
+
+# ---- partition_zone chaos fault -----------------------------------------
+
+
+def test_partition_zone_fault_matching():
+    """The fault severs exactly the named zone's cross-zone links:
+    intra-zone traffic (inside and outside the zone) and unresolvable
+    endpoints pass untouched."""
+
+    async def main():
+        zones = {b"a" * 32: "z1", b"b" * 32: "z1",
+                 b"c" * 32: "z2", b"d" * 32: None}
+        c = ChaosController(seed=7)
+        c.zone_resolver = zones.get
+        c.add(FaultSpec(kind="partition_zone", zone="z2"))
+
+        async def ok(local, peer):
+            return await c.net_frame("send", local, peer, 100)
+
+        assert await ok(b"a" * 32, b"b" * 32)     # intra z1
+        assert await ok(b"c" * 32, b"c" * 32)     # intra z2
+        assert await ok(b"a" * 32, b"d" * 32)     # unresolvable side
+        assert await ok(b"", b"c" * 32)           # no local id: skipped
+        with pytest.raises(ConnectionError):
+            await ok(b"a" * 32, b"c" * 32)        # z1 -> z2 severed
+        with pytest.raises(ConnectionError):
+            await ok(b"c" * 32, b"b" * 32)        # z2 -> z1 severed
+        assert c.total_fired == 2
+        assert c.faults[0].to_dict()["zone"] == "z2"
+        # a fault with no zone scope never matches anything
+        c.clear()
+        c.add(FaultSpec(kind="partition_zone"))
+        assert await ok(b"a" * 32, b"c" * 32)
+        # without a resolver the fault is inert, not an error
+        c.clear()
+        c.zone_resolver = None
+        c.add(FaultSpec(kind="partition_zone", zone="z2"))
+        assert await ok(b"a" * 32, b"c" * 32)
+
+    run(main())
+
+
+# ---- per-zone cache-tier ring -------------------------------------------
+
+
+class _StubCache:
+    max_bytes = 1 << 20
+
+    def top_keys(self, n):
+        return []
+
+
+class _StubRpc:
+    def health(self):
+        return None
+
+
+def _tier_on(system):
+    from garage_tpu.block.cache_tier import ClusterCacheTier
+
+    mgr = type("M", (), {})()
+    mgr.system = system
+    mgr.rpc = _StubRpc()
+    mgr.cache = _StubCache()
+    return ClusterCacheTier(mgr)
+
+
+def test_cache_tier_ring_is_per_zone(tmp_path):
+    """members() restricts to the local node's zone; hints from other
+    zones are dropped on receipt; a zoneless node keeps the global
+    ring (the pre-zone behavior)."""
+
+    async def main():
+        net, systems, tasks = await make_cluster(tmp_path, 4)
+        try:
+            apply_zoned_layout(systems, ["z1", "z1", "z2", "z2"])
+            await _wait(lambda: all(
+                s.layout_manager.history.current().version == 1
+                for s in systems), 10)
+            tier = _tier_on(systems[0])
+            ids = [s.id for s in systems]
+            assert set(tier.members()) == {ids[0], ids[1]}
+            # every owned hash maps inside the zone
+            for i in range(32):
+                owner = tier.owner_of(bytes([i]) * 32)
+                assert owner in (None, ids[1])
+            # cross-zone hints are dropped, same-zone accepted
+            h = b"\x07" * 32
+            tier.note_hints(ids[2], [h])
+            assert not tier.is_hot(h)
+            assert tier.hints_dropped_cross_zone == 1
+            tier.note_hints(ids[1], [h])
+            assert tier.is_hot(h)
+            assert tier.stats()["zone"] == "z1"
+
+            # zoneless observer (a node with no layout role, e.g. a
+            # gateway worker): the pre-zone global roster survives
+            mgr = tier.manager
+            mgr.system = type("S", (), {})()
+            mgr.system.id = b"\xff" * 32  # not in the layout at all
+            mgr.system.layout_helper = systems[0].layout_helper
+            assert set(tier.members()) == set(ids)
+        finally:
+            await stop_cluster(systems, tasks)
+
+    run(main())
+
+
+# ---- the acceptance drill -----------------------------------------------
+
+
+def test_zone_partition_drill(tmp_path):
+    """3-zone / 6-node, rf=3, zone_redundancy=2, sustained Zipf load:
+    partitioning ALL of z3 must cost zero failed quorum ops in
+    consistent mode, GET /v1/zones flips to partitioned within about
+    one peering-detection interval, DEGRADED-override reads serve from
+    the surviving zones on BOTH sides of the cut, and hot-block cache
+    probes never leave their zone (counter-asserted)."""
+
+    async def main():
+        from test_model import put_object_like_api
+
+        box = await ClusterBox(
+            tmp_path, n=6, rf=3,
+            zones=["z1", "z1", "z2", "z2", "z3", "z3"],
+            zone_redundancy=2).start()
+        zone_of = {nd.id: box.zones[i] for i, nd in enumerate(box.nodes)}
+        wl = None
+        srv = None
+        try:
+            # placement precondition (the spread-maximizing solver):
+            # every partition has one replica in EVERY zone — losing a
+            # whole zone leaves 2/3 replicas, so R=2/W=2 quorums hold
+            v = box.nodes[0].system.layout_manager.history.current()
+            assert v.zone_redundancy == 2
+            for p in range(256):
+                assert len({zone_of[n] for n in v.nodes_of(p)}) == 3, \
+                    f"partition {p} does not span all zones"
+
+            g0 = box.nodes[0].garage
+            wl = Workload(box, obj_kib=16, period=0.02, zipf=4.0).start()
+            await wl.wait_ops(puts=6, gets=6, timeout=90)
+            # a pinned object for the cross-cut DEGRADED read
+            pin = b"zone-drill-pinned " * 100
+            await put_object_like_api(g0, wl.bucket_id, "drill-pin", pin)
+
+            # hot-set reads through the cache tier (the workload's own
+            # gets bypass it with cacheable=False): warms the per-zone
+            # lane and feeds the probe counters
+            hot = [h for h, _ in wl.stored[:4]]
+            for h in hot:
+                assert (await box.nodes[0].manager.rpc_get_block(h)) \
+                    is not None
+
+            cross0 = registry().totals("block_cross_zone_read_bytes")[1]
+            total0 = registry().totals("block_remote_read_bytes")[1]
+
+            # ---- sever z3 ------------------------------------------
+            c = arm(seed=1606)
+            c.zone_resolver = zone_of.get
+            c.add(FaultSpec(kind="partition_zone", zone="z3"))
+            t_armed = time.monotonic()
+            zh0 = box.nodes[0].system.zone_health
+            await box.wait(
+                lambda: zh0.zone_state("z3") == ZoneState.PARTITIONED,
+                20, "z3 partitioned in node0's zone health")
+            detect_s = time.monotonic() - t_armed
+            # detection = SUSPECT_FAILED_PINGS missed pings at the
+            # box's 0.3 s cadence (+ jitter) — "within one peering
+            # interval" with CI headroom
+            assert detect_s < 5.0, f"zone partition took {detect_s:.1f}s"
+
+            # admin surface: GET /v1/zones serves the same rollup
+            from garage_tpu.admin.http import AdminHttpServer
+
+            g0.config.admin_token = "zones-drill-token"
+            srv = AdminHttpServer(g0)
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                port = s.getsockname()[1]
+            await srv.start("127.0.0.1", port)
+            loop = asyncio.get_running_loop()
+
+            def fetch_zones():
+                r = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v1/zones",
+                    headers={"authorization":
+                             "Bearer zones-drill-token"})
+                with urllib.request.urlopen(r, timeout=10) as resp:
+                    return json.loads(resp.read().decode())
+
+            snap = await loop.run_in_executor(None, fetch_zones)
+            assert snap["localZone"] == "z1"
+            states = {z["zone"]: z["state"] for z in snap["zones"]}
+            assert states["z3"] == "partitioned"
+            assert states["z1"] == "up"
+
+            # ---- sustained consistent load through the partition ----
+            puts0, gets0 = len(wl.put_lat), len(wl.get_lat)
+            await wl.wait_ops(puts=puts0 + 10, gets=gets0 + 10,
+                              timeout=120)
+            # hot-set reads keep landing through the per-zone cache lane
+            for h in hot:
+                assert (await box.nodes[0].manager.rpc_get_block(h)) \
+                    is not None
+
+            # DEGRADED-override read from the SURVIVING side
+            obj = await g0.object_table.get(
+                wl.bucket_id, b"drill-pin",
+                consistency=ConsistencyMode.DEGRADED)
+            assert obj is not None
+            # ...and from the SEVERED side, where the consistent quorum
+            # is genuinely unreachable
+            g4 = box.nodes[4].garage
+            zh4 = box.nodes[4].system.zone_health
+            await box.wait(
+                lambda: zh4.partitioned_zones() == {"z1", "z2"},
+                20, "node4 sees the rest of the world partitioned")
+            with pytest.raises(QuorumError):
+                await g4.object_table.get(wl.bucket_id, b"drill-pin")
+            obj4 = await g4.object_table.get(
+                wl.bucket_id, b"drill-pin",
+                consistency=ConsistencyMode.DEGRADED)
+            assert obj4 is not None
+            assert obj4.bucket_id == obj.bucket_id
+
+            stats = await wl.stop()
+            wl = None
+            assert stats["failures"] == [], \
+                f"quorum ops failed during zone partition: {stats}"
+            assert stats["corrupt"] == 0
+
+            # cache probes never crossed a zone, on any node
+            for nd in box.live():
+                tier = nd.manager.cache_tier
+                if tier is not None:
+                    assert tier.cross_zone_probes == 0, \
+                        f"node{nd.index} probed across zones"
+            assert registry().totals("cache_tier_cross_zone_probe")[0] \
+                == 0
+            # cross-zone read fraction on the remote-read byte stream
+            # stays bounded: local-zone-first ordering means z1 serves
+            # z1 (hedges may occasionally spill over)
+            cross = registry().totals(
+                "block_cross_zone_read_bytes")[1] - cross0
+            total = registry().totals(
+                "block_remote_read_bytes")[1] - total0
+            if total > 0:
+                assert cross / total <= 0.5, \
+                    f"cross-zone read fraction {cross}/{total}"
+        finally:
+            disarm()
+            if wl is not None:
+                await wl.stop()
+            if srv is not None:
+                await srv.stop()
+            await box.stop()
+
+    run(main())
