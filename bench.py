@@ -734,6 +734,190 @@ def bench_s3_put(nobj: int, obj_mib: int = 4, device: bool = False) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_put_path(nobj: int = 8, obj_mib: int = 6,
+                   stub_gbps: str = "0.02,0.08,0.04",
+                   ingest_pool: bool = True) -> dict:
+    """Stage-level proof of the wire->device PUT path (ISSUE 17): live
+    S3 PUTs into an in-process erasure(4,2) cluster with the STUB
+    device backend required and its stage rates pinned LOW, so the
+    deterministic modelled sleeps dominate the real CPU work and the
+    number that comes out measures how well the FRONTEND feeds the
+    device, not the host's kernels.
+
+    Arithmetic of the gate: every body byte rides the feeder twice
+    (hash_md5 + encode_put), so per body byte the modelled h2d and
+    compute stages each move 2 bytes and d2h moves (k+m)/k (the shard
+    payloads). The pipelined ceiling is 1/max(stage multiples/rate);
+    a path that serializes the stages gets 1/sum(...) — ~0.6 of the
+    ceiling at the default rates. frontend_efficiency = achieved /
+    ceiling; >= 0.8 is the CI gate (device_smoke.py).
+
+    Also reported: the copy audit (s3_put_copy_bytes by path vs body
+    bytes — the tentpole's "copy-count-one" claim, <= ~1.1x with the
+    pinned ingest pool vs >= 3x for the classic path), ingest-pool
+    occupancy, and a signed aws-chunked leg that proves the SigV4
+    chunk-sha256 lane batches through the same device pipeline."""
+    import concurrent.futures
+    import pathlib
+    import shutil
+    import socket as _socket
+    import sys
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    for p in (here, os.path.join(here, "tests")):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    from s3util import S3Client
+    from test_model import make_garage_cluster, stop_all
+
+    from garage_tpu.api.s3.api_server import S3ApiServer
+    from garage_tpu.model.helper import GarageHelper, allow_all
+    from garage_tpu.utils.metrics import registry
+
+    rates = [float(x) for x in stub_gbps.split(",")]
+    env_keys = ("GARAGE_TPU_DEVICE", "GARAGE_TPU_DEVICE_BACKEND",
+                "GARAGE_TPU_STUB_GBPS", "JAX_PLATFORMS")
+    saved = {k: os.environ.get(k) for k in env_keys}
+    os.environ.update({"GARAGE_TPU_DEVICE": "require",
+                       "GARAGE_TPU_DEVICE_BACKEND": "stub",
+                       "GARAGE_TPU_STUB_GBPS": stub_gbps,
+                       # the stub needs no accelerator; pinning cpu
+                       # keeps plugin discovery out of the measurement
+                       "JAX_PLATFORMS": "cpu"})
+    tmp = tempfile.mkdtemp(
+        prefix="gt_putpath_",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
+    pool = concurrent.futures.ThreadPoolExecutor(max(8, nobj))
+
+    def copy_snapshot() -> dict[str, float]:
+        return {labels.get("path", "?"): total
+                for labels, _cnt, total, _mx
+                in registry().series("s3_put_copy_bytes")}
+
+    async def scenario() -> dict:
+        net, garages, tasks = await make_garage_cluster(
+            pathlib.Path(tmp), n=6, rf=3, erasure=(4, 2))
+        g = garages[0]
+        # the pool must cover every stream's in-flight window (1 block
+        # being hashed + up to put_parallelism encodes) or lease
+        # exhaustion stalls the chunker and the device goes idle —
+        # exactly the sizing guidance in DEVICE_PATH.md.
+        # ingest_pool=False (--no-ingest-pool) is the A/B control: the
+        # classic copy path under identical modelled rates.
+        g.config.s3_ingest_buffers = (4 * max(8, nobj)
+                                      if ingest_pool else 0)
+        helper = GarageHelper(g)
+        key = await helper.create_key("putpath-bench")
+        bucket = await helper.create_bucket("putpath")
+        await helper.set_bucket_key_permissions(bucket.id, key.key_id,
+                                                allow_all())
+        srv = S3ApiServer(g)
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        await srv.start("127.0.0.1", port)
+        cli = S3Client("127.0.0.1", port, key.key_id,
+                       key.params.secret_key, region=g.config.s3_region)
+        loop = asyncio.get_running_loop()
+        size = obj_mib << 20
+        data = np.random.default_rng(17).integers(
+            0, 256, size, dtype=np.uint8).tobytes()
+        feeder = g.block_manager.feeder
+        k, m = g.block_manager.codec.k, g.block_manager.codec.m
+
+        def put(i):
+            st, _, b = cli.request("PUT", f"/putpath/o{i}", body=data,
+                                   unsigned_payload=True, timeout=120.0)
+            assert st == 200, b[:200]
+
+        try:
+            # warm: probe verdict, pool allocation, first stub batch
+            await loop.run_in_executor(pool, put, 0)
+            copy0 = copy_snapshot()
+            items0 = feeder.stats["device_items"]
+            t0 = time.perf_counter()
+            await asyncio.gather(*[loop.run_in_executor(pool, put, i)
+                                   for i in range(nobj)])
+            dt = time.perf_counter() - t0
+            put_gbps = nobj * size / dt / 1e9
+            put_items = feeder.stats["device_items"] - items0
+
+            copy1 = copy_snapshot()
+            copy_by_path = {p: copy1.get(p, 0.0) - copy0.get(p, 0.0)
+                            for p in copy1
+                            if copy1.get(p, 0.0) > copy0.get(p, 0.0)}
+            body_bytes = float(nobj * size)
+
+            # modelled ceiling at the pinned rates (see docstring)
+            mults = (2.0, 2.0, (k + m) / k)
+            ceiling = 1.0 / max(mu / r for mu, r in zip(mults, rates))
+            serial = 1.0 / sum(mu / r for mu, r in zip(mults, rates))
+
+            pl = feeder.pipeline_stats()
+            ipool = getattr(g.block_manager, "_ingest_pool", None)
+
+            # signed aws-chunked leg: per-chunk sha256 through the
+            # feeder lane (1 MiB client chunks, concurrent streams)
+            sha_items0 = feeder.stats["device_items"]
+            chunks = [data[o:o + (1 << 20)]
+                      for o in range(0, size, 1 << 20)]
+
+            def put_signed(i):
+                st, _, b = cli.put_chunked(f"/putpath/s{i}", chunks)
+                assert st == 200, b[:200]
+
+            nsig = min(nobj, 4)
+            t0 = time.perf_counter()
+            await asyncio.gather(*[
+                loop.run_in_executor(pool, put_signed, i)
+                for i in range(nsig)])
+            sig_dt = time.perf_counter() - t0
+
+            return {
+                "put_path_gbps": round(put_gbps, 4),
+                "put_path_modeled_ceiling_gbps": round(ceiling, 4),
+                "put_path_modeled_serial_gbps": round(serial, 4),
+                "frontend_efficiency": round(put_gbps / ceiling, 3),
+                "put_copy_bytes_by_path": {
+                    p: int(v) for p, v in sorted(copy_by_path.items())},
+                "put_copy_ratio": round(
+                    sum(copy_by_path.values()) / body_bytes, 3),
+                "put_feeder_device_items": put_items,
+                "put_pipeline_overlap": pl.get("overlap_efficiency", 0.0),
+                "put_ingest_pool": (ipool.stats()
+                                    if ipool is not None else None),
+                "put_signed_chunked_gbps": round(
+                    nsig * size / sig_dt / 1e9, 4),
+                "put_sha256_device_items":
+                    feeder.stats["device_items"] - sha_items0,
+                "put_stub_gbps": stub_gbps,
+                # per-lane calibration ledger ([MB, s] per op/backend,
+                # exponentially forgotten) and the per-stage busy split
+                # — the two readings the TPU recapture runbook
+                # (DEVICE_PATH.md) interprets
+                "put_lane_perf": {f"{o}/{be}": [round(bb / 1e6, 1),
+                                                round(tt, 3)]
+                                  for (o, be), (bb, tt)
+                                  in feeder._perf.items()},
+                "put_stage_busy": pl,
+            }
+        finally:
+            await srv.stop()
+            await stop_all(garages, tasks)
+
+    try:
+        return asyncio.run(asyncio.wait_for(scenario(), 300))
+    finally:
+        pool.shutdown(wait=False)
+        for kk, v in saved.items():
+            if v is None:
+                os.environ.pop(kk, None)
+            else:
+                os.environ[kk] = v
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_qos(duration: float = 6.0, nthreads: int = 8,
               obj_mib: int = 1) -> dict:
     """QoS admission control under pressure: sustained S3 PUTs against
@@ -2482,6 +2666,27 @@ if __name__ == "__main__":
             **bench_cache_tier(nblocks=a.nblocks,
                                block_kib=a.block_kib,
                                rounds=a.rounds, nodes=a.nodes),
+        }), flush=True)
+        os._exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "bench_put_path":
+        # standalone scenario (CI gate / nightly soak):
+        # python bench.py bench_put_path --nobj 8 --obj-mib 4
+        import argparse
+
+        ap = argparse.ArgumentParser()
+        ap.add_argument("cmd")
+        ap.add_argument("--nobj", type=int, default=8)
+        ap.add_argument("--obj-mib", type=int, default=6)
+        ap.add_argument("--stub-gbps", default="0.02,0.08,0.04")
+        ap.add_argument("--no-ingest-pool", action="store_true",
+                        help="A/B control: classic copy path under "
+                             "identical modelled rates")
+        a = ap.parse_args()
+        print(json.dumps({
+            "metric": "bench_put_path",
+            **bench_put_path(nobj=a.nobj, obj_mib=a.obj_mib,
+                             stub_gbps=a.stub_gbps,
+                             ingest_pool=not a.no_ingest_pool),
         }), flush=True)
         os._exit(0)
     if len(sys.argv) > 1 and sys.argv[1] == "bench_zone":

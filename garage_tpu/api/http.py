@@ -91,6 +91,23 @@ class BodyReader:
             await self.r.readexactly(2)  # CRLF
         return data
 
+    async def readinto1(self, mv: memoryview) -> int:
+        """One read landed directly into `mv` (a leased ingest-buffer
+        slice, ISSUE 17); -> bytes written, 0 at end of body. asyncio's
+        StreamReader has no recv_into, so the socket bytes materialize
+        once in read() — the copy into `mv` here is the PUT path's ONE
+        allowed materialization (counted under s3_put_copy_bytes
+        path="ingest"); everything downstream reads views over the
+        same buffer."""
+        chunk = await self.read(len(mv))
+        n = len(chunk)
+        if n:
+            mv[:n] = chunk
+            from ..utils.metrics import registry
+
+            registry().inc("s3_put_copy_bytes", n, path="ingest")
+        return n
+
     async def read_all(self, limit: int = 1 << 30) -> bytes:
         out = bytearray()
         while True:

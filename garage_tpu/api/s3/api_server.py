@@ -136,7 +136,8 @@ class S3ApiServer:
     async def _handle(self, req: Request) -> Response:
         verified = await verify_request(req, self.region,
                                         self.helper.key_secret)
-        req.body = wrap_body(req, verified, self.region)
+        req.body = wrap_body(req, verified, self.region,
+                             feeder=self.garage.block_manager.feeder)
         bucket_name, key = self._split_bucket_key(req)
 
         api_key = None

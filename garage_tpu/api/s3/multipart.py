@@ -115,7 +115,14 @@ async def handle_put_part(ctx, req: Request) -> Response:
     await ctx.garage.mpu_table.insert(mpu2)
     version = Version.new(version_uuid, (BACKLINK_MPU, mpu.upload_id))
     await ctx.garage.version_table.insert(version)
-    chunker = Chunker(req.body, ctx.garage.config.block_size)
+    # same zero-copy ingest pool as PutObject (put.save_stream): big
+    # uploads arrive as parts, so UploadPart is the hotter wire path
+    pool = None
+    if sse_key is None:
+        pool = ctx.garage.block_manager.ingest_pool(
+            ctx.garage.config.block_size,
+            getattr(ctx.garage.config, "s3_ingest_buffers", 0))
+    chunker = Chunker(req.body, ctx.garage.config.block_size, pool=pool)
     first = await chunker.next()
     if first is None:
         raise S3Error("EntityTooSmall", 400, "empty part")
@@ -130,6 +137,9 @@ async def handle_put_part(ctx, req: Request) -> Response:
                 and checksummer.b64() != expected_checksum[1]:
             raise S3Error("BadDigest", 400, "checksum mismatch")
     except BaseException:
+        if hasattr(first, "release"):
+            first.release()  # idempotent: a handed-over lease already
+            # went back via its put task's finally
         # interrupted part: tombstone its version so block refs get
         # dropped now instead of leaking until abort/complete
         # (ref: multipart.rs:165-258 InterruptedCleanup)

@@ -25,6 +25,7 @@ from ...model.s3.object_table import (Object, ObjectVersion,
 from ...model.s3.version_table import BACKLINK_OBJECT, Version
 from ...utils.crdt import now_msec
 from ...utils.data import gen_uuid
+from ...utils.metrics import registry
 from ..http import Request, Response
 from .xml import S3Error, bad_request
 
@@ -50,19 +51,89 @@ class Chunker:
     missing byte count (read() never over-returns) means blocks
     assemble with ONE join copy — zero when a read yields the whole
     block — instead of the old bytearray extend+slice+memmove trio,
-    which was a measurable share of the one-core PUT path."""
+    which was a measurable share of the one-core PUT path.
 
-    def __init__(self, body, block_size: int, shape=None):
+    With `pool` (a hostbuf.HostBufPool — the zero-copy ingest path,
+    ISSUE 17), full blocks land DIRECTLY in a leased stripe-layout
+    buffer via the reader's readinto1 and next() returns the
+    BlockLease; partial tail blocks (and readers without readinto1)
+    degrade to bytes through the classic path. The CALLER owns each
+    returned lease and must release it."""
+
+    def __init__(self, body, block_size: int, shape=None, pool=None):
         self.body = body
         self.block_size = block_size
         self.eof = False
         # qos byte-shaper (async callable) for bodies whose length was
         # unknown at admission time — see qos.QosEngine.shape_bytes
         self.shape = shape
+        self.pool = pool
         self._rest = b""  # overshoot carry (AwsChunkedReader returns
         # whole decoded client chunks, ignoring the requested size)
 
-    async def next(self) -> Optional[bytes]:
+    async def next(self):
+        if self.pool is not None:
+            return await self._next_lease()
+        return await self._next_bytes()
+
+    async def _next_lease(self):
+        """Fill a leased buffer in place. Returns the lease (full
+        block), bytes (the sub-block tail — its true shard length
+        differs, so it takes the classic staging path), or None."""
+        if self.eof and not self._rest:
+            return None
+        lease = await self.pool.acquire()
+        mv = lease.body_mv()
+        have = 0
+        try:
+            if self._rest:
+                # a carry only exists after a readinto1-less fallback
+                # read over-returned; land it first (counted: it IS a
+                # copy into the buffer)
+                r = self._rest
+                n = min(len(r), self.block_size)
+                mv[:n] = r[:n]
+                registry().inc("s3_put_copy_bytes", n, path="assemble")
+                self._rest = r[n:] if n < len(r) else b""
+                have = n
+            readinto = getattr(self.body, "readinto1", None)
+            while not self.eof and have < self.block_size:
+                if readinto is not None:
+                    n = await readinto(mv[have:self.block_size])
+                    if not n:
+                        self.eof = True
+                        break
+                    have += n
+                else:
+                    chunk = await self.body.read(self.block_size - have)
+                    if not chunk:
+                        self.eof = True
+                        break
+                    fit = min(len(chunk), self.block_size - have)
+                    mv[have:have + fit] = chunk[:fit]
+                    registry().inc("s3_put_copy_bytes", fit,
+                                   path="ingest")
+                    if fit < len(chunk):
+                        self._rest = chunk[fit:]
+                    have += fit
+            if not have:
+                lease.release()
+                return None
+            if self.shape is not None:
+                await self.shape(have)
+            if have == self.block_size:
+                lease.length = have
+                out, lease = lease, None  # ownership moves to the caller
+                return out
+            # tail block: materialize once and recycle the buffer
+            out = bytes(mv[:have])
+            registry().inc("s3_put_copy_bytes", have, path="assemble")
+            return out
+        finally:
+            if lease is not None:
+                lease.release()
+
+    async def _next_bytes(self) -> Optional[bytes]:
         chunks: list = []
         have = 0
         if self._rest:
@@ -76,9 +147,15 @@ class Chunker:
                 break
             chunks.append(chunk)
             have += len(chunk)
+            # every read() materializes fresh bytes between the socket
+            # and the block — the copy the leased path deletes
+            registry().inc("s3_put_copy_bytes", len(chunk), path="read")
         if not have:
             return None
         whole = chunks[0] if len(chunks) == 1 else b"".join(chunks)
+        if len(chunks) > 1:
+            registry().inc("s3_put_copy_bytes", len(whole),
+                           path="assemble")
         if have > self.block_size:
             # memoryview carry: the overshoot (an AwsChunkedReader can
             # return a many-MiB client chunk) is carried as a zero-copy
@@ -92,7 +169,11 @@ class Chunker:
             await self.shape(len(whole))
         # downstream (hashing, encryption, the block RPC) expects real
         # bytes; a view materializes here — ONE copy per block total
-        return whole if isinstance(whole, bytes) else bytes(whole)
+        if not isinstance(whole, bytes):
+            registry().inc("s3_put_copy_bytes", len(whole),
+                           path="assemble")
+            whole = bytes(whole)
+        return whole
 
 
 def extract_metadata_headers(req: Request) -> dict:
@@ -199,16 +280,39 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
     qos = getattr(garage, "qos", None)
     shape = (qos.shape_bytes if qos is not None
              and content_length is None else None)
-    chunker = Chunker(body, block_size, shape=shape)
+    # zero-copy ingest pool (ISSUE 17): erasure-mode plaintext PUTs
+    # land full blocks straight into stripe-layout lease buffers.
+    # SSE-C keeps the classic path — encryption rewrites every byte
+    # anyway, so in-place staging buys nothing there.
+    pool = None
+    if sse_key is None:
+        pool = garage.block_manager.ingest_pool(
+            block_size, getattr(garage.config, "s3_ingest_buffers", 0))
+    chunker = Chunker(body, block_size, shape=shape, pool=pool)
     async with span("s3.put.first_read_and_lookup"):
         first_block, existing = await asyncio.gather(
             chunker.next(), garage.object_table.get(bucket_id, key.encode())
         )
-    if quotas is None:  # callers with a loaded ReqCtx pass them in
-        quotas = await get_bucket_quotas(garage, bucket_id)
-    await check_quotas(garage, bucket_id, content_length, existing,
-                       quotas=quotas)
+    try:
+        if quotas is None:  # callers with a loaded ReqCtx pass them in
+            quotas = await get_bucket_quotas(garage, bucket_id)
+        await check_quotas(garage, bucket_id, content_length, existing,
+                           quotas=quotas)
+    except BaseException:
+        # a leased first block must go back to the pool on ANY early
+        # exit (quota reached, table error) — release is idempotent,
+        # so later owners double-releasing is harmless
+        if hasattr(first_block, "release"):
+            first_block.release()
+        raise
     first_block = first_block or b""
+    if hasattr(first_block, "release") \
+            and len(first_block) < INLINE_THRESHOLD:
+        # only reachable with a sub-threshold block_size: the inline
+        # branch stores bytes, so materialize and recycle the lease
+        _l = first_block
+        first_block = bytes(_l.view())
+        _l.release()
     uuid = gen_uuid()
     ts = next_timestamp(existing)
     from ... import native
@@ -242,14 +346,15 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
         await garage.object_table.insert(Object(bucket_id, key, [ov]))
         return uuid, ts, etag, len(first_block)
 
-    # register the upload, then stream blocks
-    up = Object(bucket_id, key, [ObjectVersion(
-        uuid, ts, ObjectVersionState.uploading(headers, multipart=False))])
-    await garage.object_table.insert(up)
-    version = Version.new(uuid, (BACKLINK_OBJECT, bucket_id, key))
-    await garage.version_table.insert(version)
-
     try:
+        # register the upload, then stream blocks
+        up = Object(bucket_id, key, [ObjectVersion(
+            uuid, ts,
+            ObjectVersionState.uploading(headers, multipart=False))])
+        await garage.object_table.insert(up)
+        version = Version.new(uuid, (BACKLINK_OBJECT, bucket_id, key))
+        await garage.version_table.insert(version)
+
         total, md5_hex, etag, first_hash = await read_and_put_blocks(
             garage, version, 1, first_block, chunker, md5,
             checksummer=checksummer, sse_key=sse_key)
@@ -272,6 +377,8 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
         await garage.object_table.insert(done)
         return uuid, ts, etag, total
     except BaseException:
+        if hasattr(first_block, "release"):
+            first_block.release()  # idempotent (see above)
         # interrupted upload: mark aborted so refs get cleaned up
         # (ref: put.rs InterruptedCleanup)
         try:
@@ -326,34 +433,43 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
     queued_vkeys: set[bytes] = set()
     queued_bkeys: set[bytes] = set()
 
-    async def put_one(blk: bytes, off: int, plain_len: int, h: bytes):
+    async def put_one(blk, off: int, plain_len: int, h: bytes):
+        """`blk` is bytes or a BlockLease (zero-copy path). This task
+        owns a lease once created: release rides its finally, which
+        runs on success, failure AND cancellation."""
         from ...utils.tracing import span
 
-        async with sem, span("s3.put.block", offset=off, size=len(blk)):
-            v = Version(version.uuid, version.deleted,
-                        version.blocks.put((part_number, off),
-                                           (h, plain_len)),
-                        version.backlink)
-            # version/block_ref rows ride the LOCAL insert queue (ONE
-            # tiny db tx for both rows) instead of two quorum RPCs per
-            # block — the reference's structure (put.rs:545);
-            # read_and_put_blocks flushes the queues through the quorum
-            # path before the caller commits the Complete row, so
-            # read-your-writes is preserved
-            from ...table.table import queue_insert_local_many
+        try:
+            async with sem, span("s3.put.block", offset=off,
+                                 size=len(blk)):
+                v = Version(version.uuid, version.deleted,
+                            version.blocks.put((part_number, off),
+                                               (h, plain_len)),
+                            version.backlink)
+                # version/block_ref rows ride the LOCAL insert queue
+                # (ONE tiny db tx for both rows) instead of two quorum
+                # RPCs per block — the reference's structure
+                # (put.rs:545); read_and_put_blocks flushes the queues
+                # through the quorum path before the caller commits the
+                # Complete row, so read-your-writes is preserved
+                from ...table.table import queue_insert_local_many
 
-            # lint: ignore[GL10] measured (ISSUE 9): this deliberately tiny two-row tx (see comment above) costs less than the to_thread handoff on the per-block PUT path
-            vk, bk = queue_insert_local_many([
-                (garage.version_table, v),
-                (garage.block_ref_table, BlockRef.new(h, version.uuid)),
-            ])
-            queued_vkeys.add(vk)
-            queued_bkeys.add(bk)
-            # SSE-C blocks are never cached (cacheable=False): the
-            # stored payload is ciphertext tied to the client's key
-            await garage.block_manager.rpc_put_block(
-                h, blk, compress=False if sse_key is not None else None,
-                cacheable=sse_key is None)
+                # lint: ignore[GL10] measured (ISSUE 9): this deliberately tiny two-row tx (see comment above) costs less than the to_thread handoff on the per-block PUT path
+                vk, bk = queue_insert_local_many([
+                    (garage.version_table, v),
+                    (garage.block_ref_table, BlockRef.new(h, version.uuid)),
+                ])
+                queued_vkeys.add(vk)
+                queued_bkeys.add(bk)
+                # SSE-C blocks are never cached (cacheable=False): the
+                # stored payload is ciphertext tied to the client's key
+                await garage.block_manager.rpc_put_block(
+                    h, blk,
+                    compress=False if sse_key is not None else None,
+                    cacheable=sse_key is None)
+        finally:
+            if hasattr(blk, "release"):
+                blk.release()
 
     from ...utils.tracing import span
 
@@ -370,6 +486,10 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
         feeder.active_streams += 1
     try:
         while block is not None:
+            # a leased block is read EVERYWHERE below through a view
+            # over the pinned buffer — digests, checksums and the
+            # feeder all walk the same memory the socket filled
+            data = block.view() if hasattr(block, "view") else block
             # md5 (ETag) and the declared checksum are independent
             # digests of the same block: run them concurrently in
             # worker threads (both release the GIL) so the cost is
@@ -378,21 +498,23 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
             jobs = []
             if not fused:
                 if _MULTICORE and len(block) >= 65536:
-                    jobs.append(asyncio.to_thread(md5.update, block))
+                    jobs.append(asyncio.to_thread(md5.update, data))
                 else:
-                    md5.update(block)
+                    md5.update(data)
             if checksummer is not None:
-                jobs.append(asyncio.to_thread(checksummer.update, block))
+                jobs.append(asyncio.to_thread(checksummer.update, data))
             if jobs:
                 await asyncio.gather(*jobs)
             plain_len = len(block)
             stored = (await asyncio.to_thread(sse_key.encrypt_block, block)
                       if sse_key is not None else block)
-            async with span("s3.put.hash", size=len(stored)):
+            async with span("s3.put.hash", size=plain_len
+                            if stored is block else len(stored)):
                 if fused:
-                    h = await garage.block_manager.hash_block_md5(block, md5)
+                    h = await garage.block_manager.hash_block_md5(data, md5)
                 else:
-                    h = await garage.block_manager.hash_block(stored)
+                    h = await garage.block_manager.hash_block(
+                        data if stored is block else stored)
             if first_hash is None:
                 first_hash = h
             tasks.append(asyncio.create_task(
@@ -423,6 +545,12 @@ async def read_and_put_blocks(garage, version: Version, part_number: int,
         # tombstone, or a late block_ref insert could race past it
         if tasks:
             await asyncio.gather(*tasks, return_exceptions=True)
+        # the in-flight block may be a lease not yet handed to a
+        # put_one (e.g. the checksum threw between next() and
+        # create_task); handed-over ones were just released by their
+        # task's finally, so this idempotent release never double-frees
+        if hasattr(block, "release"):
+            block.release()
         # flush queued rows BEFORE the caller's aborted-object tombstone:
         # the tombstone's trigger queue-inserts Version(deleted=True),
         # which would CRDT-merge into a still-queued per-block row and

@@ -267,6 +267,25 @@ class SignedPayloadReader:
         self.expect = expect_hex
         self._hash_task: Optional[asyncio.Task] = None
 
+    async def readinto1(self, mv: memoryview) -> int:
+        """Zero-copy ingest (ISSUE 17): land the next span directly in
+        a leased buffer slice and advance the running body hash over
+        the view — no per-chunk bytes object. The whole-body digest is
+        inherently serial, so the update runs inline (a ≤64 KiB span
+        hashes in tens of microseconds; MiB-scale spans never occur —
+        the chunker asks for at most one socket read's worth)."""
+        if self._hash_task is not None:
+            # a prior read()'s off-thread hash must land first to keep
+            # update order; mixed read()/readinto1 use is legal
+            task, self._hash_task = self._hash_task, None
+            await task
+        n = await self.inner.readinto1(mv)
+        if n:
+            self.h.update(mv[:n])
+        elif self.h.hexdigest() != self.expect:
+            raise HttpError(400, "payload checksum mismatch")
+        return n
+
     async def read(self, n: int = 65536) -> bytes:
         if self._hash_task is not None:
             task, self._hash_task = self._hash_task, None
@@ -323,7 +342,8 @@ class AwsChunkedReader:
     def __init__(self, inner: BodyReader, verified: VerifiedRequest,
                  region: str, amz_date: str, signed: bool,
                  trailer: bool = False,
-                 trailer_algo: Optional[str] = None):
+                 trailer_algo: Optional[str] = None,
+                 feeder=None):
         self.inner = inner
         self.v = verified
         self.region = region
@@ -336,6 +356,21 @@ class AwsChunkedReader:
         # previously returned chunk awaiting verification:
         # (data, sig, hash_task | None)
         self._pending: Optional[tuple] = None
+        # cross-connection hash batching (ISSUE 17): when set, whole-
+        # chunk sha256 jobs route through the device feeder so
+        # concurrent PUT streams' chunk hashes coalesce into one
+        # padded launch (the feeder keeps the host path as the small/
+        # low-concurrency floor, same routing discipline as decode)
+        self._feeder = feeder
+        # zero-copy mode state (readinto1): the current chunk's
+        # remaining payload bytes, its declared signature, the spans it
+        # landed in the CURRENT lease (hashed as one batched feeder
+        # message at chunk end), and the host hasher spans fold into
+        # when the chunk outlives a lease
+        self._chunk_left = 0
+        self._chunk_sig: Optional[str] = None
+        self._chunk_spans: list = []
+        self._chunk_hasher = None
         self._checksummer = None
         if trailer_algo is not None:
             from .checksum import Checksummer
@@ -371,6 +406,13 @@ class AwsChunkedReader:
         ])
 
     def _start_hash(self, data: bytes):
+        if len(data) >= _HASH_OFFLOAD_MIN and self._feeder is not None:
+            # feeder lane: concurrent PUT streams' chunk hashes batch
+            # into one device launch; the feeder itself falls back to
+            # an inline host hash when the stream is alone or the
+            # device is losing (routing floor) — either way the task
+            # resolves to the hex digest _settle expects
+            return asyncio.create_task(self._feeder.sha256_hex(data))
         if _MULTICORE and len(data) >= _HASH_OFFLOAD_MIN:
             return asyncio.create_task(
                 asyncio.to_thread(lambda: _sha256(data)))
@@ -435,6 +477,107 @@ class AwsChunkedReader:
         await self._read_exact(2)  # CRLF after data
         self._pending = (data, sig, self._start_hash(data))
         return data
+
+    async def readinto1(self, mv: memoryview) -> int:
+        """Zero-copy ingest (ISSUE 17): decode the aws-chunked framing
+        but land payload bytes directly in `mv` (a leased ingest-buffer
+        slice), -> bytes written, 0 at end. A client chunk larger than
+        `mv` is consumed across calls; its sha256 accumulates
+        incrementally and the signature verifies at the chunk's last
+        span — strictly EARLIER than the pipelined read() path settles
+        (which is one read later), so the forged-chunk guarantee is
+        preserved. Do not interleave with read() mid-chunk."""
+        if self._done:
+            return 0
+        if self._chunk_left == 0:
+            await self._settle()  # a prior read()'s pending chunk
+            header = await self._read_line()
+            size_part, _, ext = header.partition(b";")
+            try:
+                size = int(size_part, 16)
+            except ValueError:
+                raise HttpError(400, "bad aws-chunk header")
+            sig = None
+            if ext.startswith(b"chunk-signature="):
+                sig = ext[len(b"chunk-signature="):].decode()
+            if self.signed and sig is None:
+                raise HttpError(403, "missing chunk signature")
+            if size == 0:
+                if self.signed:
+                    self._verify_chunk_sig(_sha256(b""), sig)
+                if self.trailer:
+                    await self._verify_trailer()
+                else:
+                    await self._read_exact(2)  # final CRLF
+                await self.inner.drain()
+                self._done = True
+                return 0
+            self._chunk_left = size
+            self._chunk_sig = sig
+            self._chunk_spans = []
+            self._chunk_hasher = None
+        want = min(len(mv), self._chunk_left)
+        if self._buf:
+            # spill: a header-line read overshot into payload; those
+            # bytes hop through _buf before landing (bounded by one
+            # socket read per chunk — counted so the copy budget in
+            # bench_put_path stays honest)
+            n = min(want, len(self._buf))
+            mv[:n] = self._buf[:n]
+            del self._buf[:n]
+            from ..utils.metrics import registry
+
+            registry().inc("s3_put_copy_bytes", n, path="spill")
+        else:
+            n = await self.inner.readinto1(mv[:want])
+            if not n:
+                raise HttpError(400, "truncated aws-chunked body")
+        span = mv[:n]
+        if self.signed:
+            self._chunk_spans.append(span)
+        if self._checksummer is not None:
+            self._checksummer.update(span)
+        self._chunk_left -= n
+        if self._chunk_left == 0:
+            await self._read_exact(2)  # CRLF after data
+            if self.signed:
+                self._verify_chunk_sig(await self._chunk_sha_hex(),
+                                       self._chunk_sig)
+        elif self.signed and n == len(mv):
+            # the destination (a leased block buffer) just filled: the
+            # caller hands it to the put pipeline, which recycles it on
+            # release — fold its spans into a host hasher NOW, while
+            # the bytes are still this chunk's to read
+            self._fold_spans()
+        return n
+
+    def _fold_spans(self) -> None:
+        if self._chunk_hasher is None:
+            self._chunk_hasher = hashlib.sha256()
+        for s in self._chunk_spans:
+            self._chunk_hasher.update(s)
+        self._chunk_spans = []
+
+    async def _chunk_sha_hex(self) -> str:
+        """Digest of the just-completed chunk. A chunk wholly resident
+        in the live lease rides the feeder's batched sha256 lane as its
+        span list — concurrent streams' chunk hashes coalesce into one
+        device launch with zero host copies (the SHA pad-in IS the h2d
+        staging). A chunk that crossed a lease boundary was folded into
+        a host hasher at the handoff and finishes there."""
+        spans, self._chunk_spans = self._chunk_spans, []
+        if self._chunk_hasher is not None:
+            h, self._chunk_hasher = self._chunk_hasher, None
+            for s in spans:
+                h.update(s)
+            return h.hexdigest()
+        if self._feeder is not None \
+                and sum(len(s) for s in spans) >= _HASH_OFFLOAD_MIN:
+            return await self._feeder.sha256_hex(spans)
+        h = hashlib.sha256()
+        for s in spans:
+            h.update(s)
+        return h.hexdigest()
 
     async def _verify_trailer(self) -> None:
         """Parse `name:value[\\n]\\r\\n` (+ x-amz-trailer-signature for
@@ -504,15 +647,18 @@ class AwsChunkedReader:
 
 
 def wrap_body(req: Request, verified: Optional[VerifiedRequest],
-              region: str):
+              region: str, feeder=None):
     """Give the handler a body reader enforcing the payload integrity
-    mode the client declared."""
+    mode the client declared. `feeder` (the block manager's device
+    feeder, when the caller has one) lets aws-chunked per-chunk sha256
+    jobs batch across concurrent connections (ISSUE 17)."""
     if verified is None:
         return req.body
     cs = verified.content_sha256
     amz_date = req.header("x-amz-date") or ""
     if cs == STREAMING_SIGNED:
-        return AwsChunkedReader(req.body, verified, region, amz_date, True)
+        return AwsChunkedReader(req.body, verified, region, amz_date, True,
+                                feeder=feeder)
     if cs in (STREAMING_UNSIGNED_TRAILER, STREAMING_SIGNED_TRAILER):
         from .checksum import trailer_algorithm
 
@@ -522,7 +668,8 @@ def wrap_body(req: Request, verified: Optional[VerifiedRequest],
             raise HttpError(400, str(e))
         return AwsChunkedReader(req.body, verified, region, amz_date,
                                 cs == STREAMING_SIGNED_TRAILER,
-                                trailer=True, trailer_algo=talgo)
+                                trailer=True, trailer_algo=talgo,
+                                feeder=feeder)
     if cs and cs != UNSIGNED_PAYLOAD:
         return SignedPayloadReader(req.body, cs)
     return req.body

@@ -177,6 +177,21 @@ class ClusterCacheTier:
             return None
         return owner
 
+    def local_owner(self, hash32: bytes) -> bool:
+        """True when a real multi-node ring elects THIS node the
+        hash's cache owner — the gateway-worker shortcut's test: a
+        local GET on the owner can serve straight from its own cache
+        ring slot instead of paying a loopback router hop. Distinct
+        from owns(): moot routing (tier off, lone member) is False
+        here — the shortcut only fires when the ring genuinely routed
+        the hash home."""
+        if not self.enabled or self.manager.cache.max_bytes <= 0:
+            return False
+        members = self.members()
+        if len(members) < 2:
+            return False
+        return rendezvous_owner(members, hash32) == self.manager.system.id
+
     def owns(self, hash32: bytes) -> bool:
         """Whether this node should hold the cached copy (True when
         routing is moot — an unrouted cache owns everything it sees)."""

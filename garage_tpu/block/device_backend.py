@@ -82,8 +82,15 @@ def bucket_len(n: int, quantum: int = 1024) -> int:
 
 def group_bytes(op: str, blobs: list) -> int:
     """Payload bytes of one op group (the feeder's accounting rule)."""
-    if op in ("verify", "encode_put", "hash_md5"):  # 2-tuples
-        return sum(len(b) for _, b in blobs)
+    if op in ("verify", "encode_put", "hash_md5"):
+        # 2-tuples, except encode_put also carries ingest leases
+        # (scheme byte + body in one pool buffer, sized total_len)
+        return sum(b.total_len if hasattr(b, "total_len") else len(b[1])
+                   for b in blobs)
+    if op == "sha256":  # item = one message: a buffer or a span list
+        from ..ops.sha256 import part_len
+
+        return sum(part_len(b) for b in blobs)
     if op == "parity_check":  # item = one stripe (shard list)
         return sum(len(b) for s in blobs for b in s)
     if op == "decode":  # item = (present, shards, plain_len)
@@ -247,8 +254,15 @@ class JaxDeviceBackend:
         if op in ("hash", "verify", "hash_md5"):
             datas = blobs if op == "hash" else [d for _, d in blobs]
             return (op, blobs, self._stage_hash(datas))
+        if op == "sha256":
+            return (op, blobs, self._stage_sha256(blobs))
         if op in ("encode", "encode_put"):
-            blocks = blobs if op == "encode" else [p + d for p, d in blobs]
+            # encode_put items: (prefix, data) tuples, or ingest leases
+            # whose stripe() already IS the split layout — those skip
+            # the concatenate entirely
+            blocks = (blobs if op == "encode" else
+                      [b if hasattr(b, "stripe") else b[0] + b[1]
+                       for b in blobs])
             return (op, blobs, self._stage_rs(blocks, "encode"))
         if op == "parity_check":
             return (op, blobs, self._stage_parity(blobs))
@@ -283,13 +297,44 @@ class JaxDeviceBackend:
                            jax.device_put(lengths)))
         return (len(datas), staged)
 
-    def _stage_rs(self, blocks: list[bytes], tag: str):
+    def _stage_sha256(self, datas: list):
+        import jax
+
+        from ..ops import sha256 as sha
+
+        groups: dict[int, list[int]] = {}
+        for i, d in enumerate(datas):
+            groups.setdefault(
+                sha.blocks_bucket(sha.n_blocks_for(sha.part_len(d))),
+                []).append(i)
+        staged = []
+        for npad, idxs in groups.items():
+            b = bucket_items(len(idxs), self.pad_buckets)
+            buf = np.zeros((b, npad * sha.BLOCK), dtype=np.uint8)
+            # pad rows compress one zero block; the mask freezes the
+            # rest and readback never reads them
+            nbs = np.ones(b, dtype=np.int32)
+            for row, i in enumerate(idxs):
+                nbs[row] = sha.pad_row_into(buf[row], datas[i])
+            waste = (b * npad * sha.BLOCK
+                     - sum(sha.part_len(datas[i]) for i in idxs))
+            self._note_shape(("sha256", npad, b), waste)
+            staged.append((idxs, jax.device_put(buf),
+                           jax.device_put(nbs), npad))
+        return (len(datas), staged)
+
+    def _stage_rs(self, blocks: list, tag: str):
         import jax
 
         from ..ops import rs
 
         k, m = self.codec.k, self.codec.m
-        slens = [rs.shard_len(len(b), k) for b in blocks]
+
+        def blen(b):
+            return b.total_len if hasattr(b, "total_len") else len(b)
+
+        slens = [b.slen if hasattr(b, "slen") else rs.shard_len(len(b), k)
+                 for b in blocks]
         smax = bucket_len(max(slens))
         bpad = bucket_items(len(blocks), self.pad_buckets)
         mesh = (self._get_mesh()
@@ -298,12 +343,39 @@ class JaxDeviceBackend:
             dp, tp = mesh.shape["dp"], mesh.shape["tp"]
             bpad = ((bpad + dp - 1) // dp) * dp
             smax = ((smax + tp - 1) // tp) * tp
-        batch = np.zeros((bpad, k, smax), dtype=np.uint8)
-        for i, b in enumerate(blocks):
-            sh = rs.split_stripe(b, k)
-            batch[i, :, : sh.shape[1]] = sh
-        waste = bpad * k * smax - sum(len(b) for b in blocks)
+        waste = bpad * k * smax - sum(blen(b) for b in blocks)
         self._note_shape((tag, k, m, bpad, smax, mesh is not None), waste)
+        if mesh is None and blocks \
+                and all(hasattr(b, "stripe") for b in blocks) \
+                and len(set(slens)) == 1:
+            # all-lease leg: the pool buffer IS the stripe layout, so
+            # h2d reads it directly — no host-side re-pack copy. The
+            # pad to (bpad, k, smax) happens on-device; batch=None
+            # tells readback to slice the data shards straight from
+            # the leases (host memory) instead of a staging array.
+            import jax.numpy as jnp
+
+            dev = jnp.stack([jax.device_put(b.stripe()) for b in blocks])
+            if bpad > len(blocks) or smax > slens[0]:
+                dev = jnp.pad(dev, ((0, bpad - len(blocks)), (0, 0),
+                                    (0, smax - slens[0])))
+            return (blocks, slens, None, dev, None, smax)
+        batch = np.zeros((bpad, k, smax), dtype=np.uint8)
+        copied = 0
+        for i, b in enumerate(blocks):
+            if hasattr(b, "stripe"):
+                sh = b.stripe()
+                copied += sh.size
+            else:
+                sh = rs.split_stripe(b, k)
+            batch[i, :, : sh.shape[1]] = sh
+        if copied and tag == "encode":
+            # a lease fell off the zero-copy leg (mesh round-up or a
+            # mixed-shape batch): the pad copy is real data-plane
+            # bytes, so the wire->device copy audit must see it
+            from ..utils.metrics import registry
+
+            registry().inc("s3_put_copy_bytes", copied, path="stage_pack")
         if mesh is not None:
             from ..parallel import mesh as pmesh
 
@@ -413,6 +485,13 @@ class JaxDeviceBackend:
             launched = [(c, idxs, treehash.hash_fn(c)(buf, lens))
                         for c, idxs, buf, lens in groups]
             return (op, blobs, (n, launched))
+        if op == "sha256":
+            from ..ops import sha256 as sha
+
+            n, groups = inner
+            launched = [(idxs, sha.hash_fn(npad)(buf, nbs))
+                        for idxs, buf, nbs, npad in groups]
+            return (op, blobs, (n, launched))
         if op in ("encode", "encode_put"):
             from ..ops import rs
 
@@ -486,6 +565,15 @@ class JaxDeviceBackend:
 
                 native.md5_update_many(list(blobs))
             return digests
+        if op == "sha256":
+            from ..ops import sha256 as sha
+
+            n, launched = inner
+            out: list = [None] * n
+            for idxs, cvs in launched:
+                for i, hx in zip(idxs, sha.digests_to_hex(cvs)):
+                    out[i] = hx
+            return out
         if op in ("encode", "encode_put"):
             blocks, slens, batch, parity = inner
             k, m = self.codec.k, self.codec.m
@@ -493,13 +581,20 @@ class JaxDeviceBackend:
             out = []
             for i in range(len(blocks)):
                 sl = slens[i]
-                out.append([bytes(batch[i, j, :sl]) for j in range(k)]
+                # batch=None: all-lease leg — the data shards live in
+                # the lease buffers (still held by the PUT tasks, which
+                # await this op before releasing), no staging array
+                src = blocks[i].stripe() if batch is None else batch[i]
+                out.append([bytes(src[j, :sl]) for j in range(k)]
                            + [bytes(par[i, j, :sl]) for j in range(m)])
             if op == "encode_put":
                 from .manager import pack_shard
 
-                return [[pack_shard(pp, len(p) + len(d)) for pp in parts]
-                        for (p, d), parts in zip(blobs, out)]
+                return [[pack_shard(pp, b.total_len
+                                    if hasattr(b, "total_len")
+                                    else len(b[0]) + len(b[1]))
+                         for pp in parts]
+                        for b, parts in zip(blobs, out)]
             return out
         if op == "parity_check":
             n, ok = inner
@@ -591,6 +686,8 @@ class StubDeviceBackend:
         if op in ("hash", "verify", "hash_md5"):
             datas = blobs if op == "hash" else [d for _, d in blobs]
             res = f._do_hash(list(datas), "host")
+        elif op == "sha256":
+            res = f._do_sha256(list(blobs), "host")
         elif op == "encode":
             res = f._do_encode(list(blobs), "host")
         elif op == "encode_put":
@@ -608,7 +705,7 @@ class StubDeviceBackend:
     def readback(self, op: str, handle) -> list:
         self._maybe_hang("d2h")
         op, blobs, res = handle
-        if op in ("hash", "verify", "hash_md5"):
+        if op in ("hash", "verify", "hash_md5", "sha256"):
             out_bytes = 32 * len(res)
         elif op in ("encode", "encode_put"):
             out_bytes = sum(len(b) for parts in res for b in parts)

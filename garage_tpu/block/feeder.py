@@ -45,6 +45,7 @@ no caller future is ever lost.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import json
 import logging
 import os
@@ -96,6 +97,12 @@ _TRIAL_MAX_BYTES = 4 << 20
 # axon tunnel can block inside XLA calls); the batch re-runs host-side
 # and the device path is disabled
 _BATCH_TIMEOUT = 300.0
+
+# ops whose per-stream cadence makes a short gather window pay: each PUT
+# stream keeps at most one of these in flight per block, so lanes only
+# line up if the dispatcher lingers for the other streams' submissions
+# ([tpu] batch_linger_ms)
+_LINGER_OPS = ("hash_md5", "hash", "encode_put", "sha256")
 
 PROBE_TIMEOUT = 60.0
 
@@ -264,14 +271,24 @@ class DeviceFeeder:
         self.trial_max_bytes = int(knob("trial_max_bytes",
                                         _TRIAL_MAX_BYTES))
         # staged-pipeline depth: batches concurrently in flight through
-        # the h2d/compute/d2h stages (2 = classic double buffering)
-        self.inflight_batches = max(1, int(knob("inflight_batches", 2)))
+        # the h2d/compute/d2h stages. A batch's dispatch slot is held
+        # until its d2h readback drains, so depth 2 (double buffering)
+        # leaves h2d idle whenever compute+d2h of the batch ahead
+        # outlast its own h2d — matching the depth to the THREE stages
+        # keeps the transfer engine fed (bench_put_path: ~0.80 -> 0.86
+        # frontend_efficiency at pinned stub rates)
+        self.inflight_batches = max(1, int(knob("inflight_batches", 3)))
         self.pad_buckets = tuple(
             int(b) for b in knob("pad_buckets", DEFAULT_PAD_BUCKETS))
         self.mesh_min_items = int(knob("mesh_min_items", 8))
         # per-batch watchdog budget, instance-level so tests can shrink
         # it without patching every co-located feeder
         self.batch_timeout = float(knob("batch_timeout_s", _BATCH_TIMEOUT))
+        # [tpu] batch_linger_ms: gather-window budget for same-op PUT
+        # lanes (hash/md5/sha256/encode). 0 disables the linger — every
+        # batch ships with whatever the greedy drain found.
+        self.batch_linger = max(
+            0.0, float(knob("batch_linger_ms", 6.0))) / 1000.0
         # device backend: "jax" (real accelerator), "stub"
         # (deterministic latency emulator — CI), or a ready object
         if backend is None:
@@ -634,6 +651,23 @@ class DeviceFeeder:
         return await self.hash(data)
 
 
+    async def sha256_hex(self, data) -> str:
+        """SigV4 chunk-signature SHA-256 (hex). Chunk digests are
+        independent across streams (the signature chain, not the hash,
+        carries continuity), so concurrent PUTs batch into one device
+        launch. A lone stream skips the queue: hashlib in a worker
+        thread beats a 1-row device round trip and keeps the event loop
+        free for the socket."""
+        from ..ops import sha256 as _sha
+
+        if self.active_streams <= 1 or self.mode == "off":
+            t0 = time.perf_counter()
+            out = await asyncio.to_thread(_sha.sha256_hex_py, data)
+            self._record("sha256", "host", _sha.part_len(data),
+                         time.perf_counter() - t0)
+            return out
+        return await self._submit("sha256", data)
+
     async def encode(self, packed: bytes) -> list[bytes]:
         """Erasure parts for one packed block (batched)."""
         if self.codec is None:
@@ -705,19 +739,36 @@ class DeviceFeeder:
         matmul through XLA then packs host-side."""
         if self.codec is None:
             raise RuntimeError("feeder has no codec")
+        lease = data if hasattr(data, "stripe") else None
         if self._host_inline_ok("encode"):
             from .. import native
             from ..ops import rs
 
             self.stats["inline_items"] += 1
             t0 = time.perf_counter()
-            # lint: ignore[GL10] host-inline fast path is gated to small items; the flagged open chain is the one-time native build, cached for the process lifetime
+            pmat = rs.parity_matrix(self.codec.k, self.codec.m)
+            if lease is not None:
+                # zero-copy ingest lease: body is already resident in
+                # the pool buffer; hand the native kernel the view
+                # (scheme byte travels as the prefix, same framing)
+                # lint: ignore[GL10] host-inline fast path is gated to small items; the flagged open chain is the one-time native build, cached for the process lifetime
+                out = native.rs_encode_packed(
+                    lease.view(), self.codec.k, self.codec.m, pmat,
+                    prefix=bytes([lease.buf[0]]))
+                self._record("encode", "host", lease.total_len,
+                             time.perf_counter() - t0)
+                return out
             out = native.rs_encode_packed(
-                data, self.codec.k, self.codec.m,
-                rs.parity_matrix(self.codec.k, self.codec.m), prefix=prefix)
+                data, self.codec.k, self.codec.m, pmat, prefix=prefix)
             self._record("encode", "host", len(prefix) + len(data),
                          time.perf_counter() - t0)
             return out
+        if lease is not None:
+            # the lease itself is the queue item: the device stage
+            # reads its stripe() rows without re-packing, and the host
+            # route slices the view — release stays with the PUT task,
+            # which awaits this call before letting go
+            return await self._submit("encode_put", lease)
         return await self._submit("encode_put", (prefix, data))
 
     async def verify_blocks(self, items: list[tuple[bytes, bytes]]
@@ -874,20 +925,22 @@ class DeviceFeeder:
                 while not self._q.empty() \
                         and len(batch) < self.max_batch:
                     batch.append(self._q.get_nowait())
-                n_md5 = sum(1 for it in batch if it.op == "hash_md5")
+                n_same = sum(1 for it in batch if it.op == first.op)
                 want = min(self.active_streams, 8)
-                if first.op == "hash_md5" and self.active_streams > 1 \
-                        and n_md5 < want:
-                    # several fused PUT streams are mid-block-loop: a
-                    # short async gather window lets their next hash
-                    # submissions line up, multiplying the MD5 lane
-                    # count. The wait burns no CPU — the event loop
-                    # spends it reading the OTHER streams' sockets,
-                    # which is exactly what gets them here. Only
-                    # hash_md5 items count toward the lane target.
+                if first.op in _LINGER_OPS and self.batch_linger > 0 \
+                        and self.active_streams > 1 and n_same < want:
+                    # several PUT streams are mid-block-loop: a short
+                    # async gather window lets their next submissions
+                    # line up, multiplying the batch lane count (MD5
+                    # AVX lanes, SHA-256 device rows, encode stripes).
+                    # The wait burns no CPU — the event loop spends it
+                    # reading the OTHER streams' sockets, which is
+                    # exactly what gets them here. Only items matching
+                    # the head op count toward the lane target; budget
+                    # is [tpu] batch_linger_ms.
                     loop = asyncio.get_running_loop()
-                    deadline = loop.time() + 0.006
-                    while n_md5 < want:
+                    deadline = loop.time() + self.batch_linger
+                    while n_same < want:
                         left = deadline - loop.time()
                         if left <= 0:
                             break
@@ -897,8 +950,8 @@ class DeviceFeeder:
                         except asyncio.TimeoutError:
                             break
                         batch.append(item)
-                        if item.op == "hash_md5":
-                            n_md5 += 1
+                        if item.op == first.op:
+                            n_same += 1
                 self._maybe_start_probe()
                 # bounded in-flight depth: the dispatcher hands the
                 # batch to the staged pipeline and goes straight back
@@ -1245,10 +1298,17 @@ class DeviceFeeder:
                     or cut >= self.trial_items_cap):
                 break
             d = batch[i].data
-            if op in ("verify", "encode_put", "hash_md5"):
+            if op in ("verify", "encode_put", "hash_md5") \
+                    and isinstance(d, tuple):
                 d = d[1]
+            if hasattr(d, "total_len"):
+                size += d.total_len
+                cut += 1
+                continue
             if op == "parity_check":
                 size += sum(len(b) for b in d)
+            elif op == "sha256" and isinstance(d, (list, tuple)):
+                size += sum(len(b) for b in d)  # span-list message
             elif op == "decode":
                 size += sum(len(b) for b in d[1])
             elif op == "repair":
@@ -1311,6 +1371,8 @@ class DeviceFeeder:
                 native.md5_update_many(list(blobs))
                 return out
             return native.b3_md5_many(list(blobs))
+        if op == "sha256":
+            return self._do_sha256(blobs, backend)
         if op == "verify":
             digs = self._do_hash([b for _, b in blobs], backend)
             return _verify_matches(digs, blobs)
@@ -1347,9 +1409,19 @@ class DeviceFeeder:
 
         return [blake3sum(b) for b in blobs]
 
-    def _do_encode_put(self, items: list[tuple[bytes, bytes]], backend: str
+    def _do_sha256(self, blobs: list, backend: str) -> list[str]:
+        """SigV4 chunk digests (hex) — independent across items, so the
+        whole group is one device launch (ops/sha256) or a host loop."""
+        from ..ops import sha256 as _sha
+
+        if backend == "device":
+            return _sha.sha256_hex_many(blobs)
+        return [_sha.sha256_hex_py(b) for b in blobs]
+
+    def _do_encode_put(self, items: list, backend: str
                        ) -> list[list]:
-        """items = [(prefix, data)]; like _do_encode but each part is a
+        """items = [(prefix, data)] or ingest leases (scheme byte + body
+        resident in one pool buffer); like _do_encode but each part is a
         complete shard payload (pack_shard framing, crc32c). Host+native
         is the PUT hot path."""
         from .manager import pack_shard
@@ -1363,15 +1435,26 @@ class DeviceFeeder:
                     from ..ops import rs
 
                     pmat = rs.parity_matrix(codec.k, codec.m)
-                    return [native.rs_encode_packed(d, codec.k, codec.m,
-                                                    pmat, prefix=p)
-                            for p, d in items]
+                    out = []
+                    for it in items:
+                        if hasattr(it, "stripe"):
+                            out.append(native.rs_encode_packed(
+                                it.view(), codec.k, codec.m, pmat,
+                                prefix=bytes([it.buf[0]])))
+                        else:
+                            out.append(native.rs_encode_packed(
+                                it[1], codec.k, codec.m, pmat,
+                                prefix=it[0]))
+                    return out
             except Exception:
                 # lint: ignore[GL05] native backend optional; _do_encode fallback follows
                 pass
         # device, or host without native: delegate the encode itself to
-        # _do_encode (single source of truth) and wrap with pack_shard
-        blocks = [p + d for p, d in items]
+        # _do_encode (single source of truth) and wrap with pack_shard.
+        # Leases materialize here — the non-native fallback is off the
+        # perf path, and _do_encode wants plain byte blocks.
+        blocks = [bytes(it.buf[:it.total_len]) if hasattr(it, "total_len")
+                  else it[0] + it[1] for it in items]
         parts_lists = (codec.encode_batch(blocks) if backend == "device"
                        else self._do_encode(blocks, backend))
         return [[pack_shard(pp, len(b)) for pp in parts]

@@ -36,7 +36,7 @@ from ..rpc.rpc_helper import (
 from ..utils.data import blake2sum
 from ..utils.metrics import registry
 from ..utils.error import CorruptData, MissingBlock, QuorumError, RpcError
-from .block import BLOCK_SUFFIXES, DataBlock, comp_of_path
+from .block import BLOCK_SUFFIXES, COMPRESSION_NONE, DataBlock, comp_of_path
 from .codec import BlockCodec, ErasureCodec, ReplicateCodec, shard_nodes_of
 from .layout import DataLayout
 from .rc import BlockRc
@@ -116,6 +116,15 @@ def unpack_shard(raw: bytes) -> tuple[bytes, int]:
     packed_len = validate_shard(raw)
     hdr = 44 if bytes(raw[:4]) == _SHARD_MAGIC_V1 else 16
     return raw[hdr:], packed_len
+
+
+def _hex_in(x: str, parts: set) -> bool:
+    """Is the 2-hex-char prefix dir `x` one of the wanted partitions?
+    (Foreign dir names in a data root are skipped, not crashed on.)"""
+    try:
+        return int(x, 16) in parts
+    except ValueError:
+        return False
 
 
 class _ByteSemaphore:
@@ -348,25 +357,65 @@ class BlockManager:
         single native pass on the host route; see feeder.hash_with_md5)."""
         return await self.feeder.hash_with_md5(data, md5acc)
 
+    def ingest_pool(self, block_size: int, count: int):
+        """The pinned ingest buffer pool for the PUT fast path
+        (block/hostbuf.py), built lazily once. Erasure-only: the pool's
+        flat layout IS the RS staging stripe; replicate mode returns
+        None and PUTs keep the classic path. `count` comes from
+        `[s3_api] ingest_buffers` (0 disables)."""
+        if not self.erasure or count <= 0:
+            return None
+        pool = getattr(self, "_ingest_pool", None)
+        if pool is None:
+            from .hostbuf import HostBufPool
+
+            pool = HostBufPool(self.codec.k, block_size, count)
+            self._ingest_pool = pool
+        return pool
+
     async def rpc_put_block(self, hash32: bytes, data: bytes,
                             compress: Optional[bool] = None,
                             cacheable: bool = True) -> None:
+        """`data` is the block payload: bytes, or a hostbuf.BlockLease
+        on the zero-copy ingest path (erasure + no SSE; the caller owns
+        the lease and releases it after this returns)."""
         from ..utils.tracing import span
 
+        lease = data if hasattr(data, "stripe") else None
         await self._ram_sem.acquire(len(data))
         try:
             async with span("block.put", size=len(data), hash=hash32):
                 do_compress = (self.compression if compress is None
                                else compress)
-                blk = (await asyncio.to_thread(DataBlock.compress, data)
-                       if do_compress else DataBlock.plain(data))
-                if self.erasure:
+                if lease is not None:
+                    blk = (await asyncio.to_thread(
+                        DataBlock.compress, lease.view())
+                        if do_compress else None)
+                    if blk is None or blk.compression == COMPRESSION_NONE:
+                        # zero-copy leg: scheme byte lands in the
+                        # lease's header slot and the feeder stages the
+                        # prefilled stripe directly — no pack, no pad
+                        lease.set_scheme(COMPRESSION_NONE)
+                        await self._put_erasure(
+                            hash32, bytes([COMPRESSION_NONE]), lease)
+                    else:
+                        # the body shrank: the compressed copy is a NEW
+                        # (smaller) buffer, so the classic path costs
+                        # nothing extra
+                        await self._put_erasure(hash32,
+                                                bytes([blk.compression]),
+                                                blk.bytes)
+                elif self.erasure:
+                    blk = (await asyncio.to_thread(DataBlock.compress, data)
+                           if do_compress else DataBlock.plain(data))
                     # the 1-byte DataBlock header travels as a prefix so
                     # the megabyte payload is never concat-copied
                     await self._put_erasure(hash32,
                                             bytes([blk.compression]),
                                             blk.bytes)
                 else:
+                    blk = (await asyncio.to_thread(DataBlock.compress, data)
+                           if do_compress else DataBlock.plain(data))
                     # scheme byte travels as its own field: the
                     # megabyte payload is never concat-copied into a
                     # packed buffer (same trick as the erasure prefix)
@@ -385,6 +434,14 @@ class BlockManager:
             # instead of filling its own cache.
             if cacheable:
                 tier = getattr(self, "cache_tier", None)
+                if lease is not None and (self.cache.max_bytes > 0
+                                          or tier is not None):
+                    # caches keep references past the request; a lease's
+                    # buffer is recycled at release, so the write-through
+                    # needs its own durable copy (a CACHE fill, not a
+                    # data-plane hop — deliberately outside
+                    # s3_put_copy_bytes)
+                    data = bytes(lease.view())
                 tier_owner = (tier.owner_of(hash32)
                               if tier is not None else None)
                 if tier_owner is not None:
@@ -504,6 +561,17 @@ class BlockManager:
             # guaranteed miss plus a second loopback hop — skip it
             router = (self.cache_router
                       if route and self.cache.max_bytes > 0 else None)
+            tier = getattr(self, "cache_tier", None)
+            if router is not None and tier is not None \
+                    and tier.local_owner(hash32):
+                # tier-aware worker shortcut (ISSUE 17): this NODE is
+                # the block's cluster cache-tier owner, so the cluster
+                # copy (write-through + probe warms) already lives
+                # here — a worker-ring forward would spend a loopback
+                # hop reaching a sibling whose best answer is bytes
+                # this process can serve itself
+                registry().inc("cache_tier_local_owner_shortcut")
+                router = None
             if router is not None:
                 owner = router.owner_of(hash32)
                 if owner is not None:
@@ -523,7 +591,6 @@ class BlockManager:
             # falls through to today's local path, and the decoded
             # result warms the owner below. SSE-C never reaches this
             # probe: cacheable=False skips the enclosing branch.
-            tier = getattr(self, "cache_tier", None)
             if tier is not None:
                 tier_owner = tier.owner_of(hash32)
                 if tier_owner is not None:
@@ -1079,11 +1146,20 @@ class BlockManager:
             except OSError:
                 pass
 
-    def iter_local_blocks(self):
-        """Yield (hash32, path) for every stored block/shard file."""
+    def iter_local_blocks(self, parts: Optional[set] = None):
+        """Yield (hash32, path) for every stored block/shard file.
+        `parts` restricts the walk to those partitions (h[0] values —
+        PARTITION_BITS is 8, so partition == first hash byte): the
+        on-disk layout keys the first directory level by h[0].hex(),
+        so pruning there skips whole subtrees instead of stat()ing
+        every file in the store (the rebalance enumerator's
+        moved-partition scoping)."""
         seen = set()
         for d in self.data_layout.dirs:
-            for root, _, files in os.walk(d.path):
+            for root, dirs, files in os.walk(d.path):
+                if parts is not None and root == d.path:
+                    dirs[:] = [x for x in dirs
+                               if len(x) == 2 and _hex_in(x, parts)]
                 self.sweep_stale_tmp(root, files)
                 for fn in files:
                     if ".tmp" in fn or fn.endswith(".corrupted"):
@@ -1094,6 +1170,8 @@ class BlockManager:
                     except ValueError:
                         continue
                     if len(h) == 32 and h not in seen:
+                        if parts is not None and h[0] not in parts:
+                            continue
                         seen.add(h)
                         yield h, os.path.join(root, fn)
 

@@ -125,9 +125,12 @@ class BlockResyncManager:
         self.enqueue_rebalance(v)
 
     def enqueue_rebalance(self, version: int) -> None:
-        """Queue every block this node references or stores for
-        re-examination against layout `version` (fetch what moved in,
-        offload what moved away)."""
+        """Queue every block this node references or stores in a
+        partition whose placement changed between the last enumerated
+        layout and `version` (fetch what moved in, offload what moved
+        away). Unchanged partitions are skipped — a resize that moves
+        1/N of the ring re-examines ~1/N of the store, not all of it."""
+        prev = self._marker()
         self._set_marker(version)
         self._enumerating += 1
         try:
@@ -137,18 +140,55 @@ class BlockResyncManager:
             # enumerate synchronously — it is a startup cost either way
             loop = asyncio.new_event_loop()
             try:
-                loop.run_until_complete(self._enumerate(version))
+                loop.run_until_complete(self._enumerate(version, prev))
             finally:
                 loop.close()
             return
-        spawn(self._enumerate(version), "resync-rebalance")
+        spawn(self._enumerate(version, prev), "resync-rebalance")
 
-    async def _enumerate(self, version: int) -> None:
+    def _moved_partitions(self, version: int,
+                          prev: Optional[int]) -> Optional[set]:
+        """Partitions whose full placement tuple differs between layout
+        `prev` and `version`, or None when only a full scan is sound
+        (no prior marker, either version already GC'd from history).
+        Placement is a pure function of the partition — replicate reads
+        the ring row, erasure walks successive partitions for width
+        distinct nodes (codec.shard_nodes_of) — so comparing one
+        synthetic hash per partition covers every block in it."""
+        if prev is None or prev == version:
+            return None
+        m = self.manager
+        s = getattr(m, "system", None)
+        history = getattr(getattr(s, "layout_manager", None), "history",
+                          None)
+        if history is None:
+            return None
+        old = history.get_version(prev)
+        new = history.get_version(version)
+        if old is None or new is None:
+            return None
+
+        from ..rpc.layout.version import N_PARTITIONS
+
+        def placement(lv, p: int) -> tuple:
+            if m.erasure:
+                synth = bytes([p]) + bytes(31)
+                return tuple(shard_nodes_of(lv, synth, m.codec.width))
+            return tuple(lv.nodes_of(p))
+
+        return {p for p in range(N_PARTITIONS)
+                if placement(old, p) != placement(new, p)}
+
+    async def _enumerate(self, version: int,
+                         prev: Optional[int] = None) -> None:
+        moved = self._moved_partitions(version, prev)
+
         def scan() -> int:
             seen: set[bytes] = set()
             for h in self.manager.rc.all_hashes():
-                seen.add(bytes(h))
-            for h, _ in self.manager.iter_local_blocks():
+                if moved is None or h[0] in moved:
+                    seen.add(bytes(h))
+            for h, _ in self.manager.iter_local_blocks(parts=moved):
                 seen.add(h)
             for h in seen:
                 self.push_now(h)
@@ -157,8 +197,19 @@ class BlockResyncManager:
         try:
             n = await asyncio.to_thread(scan)
             registry().inc("resync_rebalance_enqueued", n)
-            log.info("layout v%d: %d blocks queued for rebalance",
-                     version, n)
+            if moved is None:
+                registry().inc("resync_rebalance_full_scans")
+            else:
+                from ..rpc.layout.version import N_PARTITIONS
+
+                registry().inc("resync_rebalance_partitions_scanned",
+                               len(moved))
+                registry().inc("resync_rebalance_partitions_skipped",
+                               N_PARTITIONS - len(moved))
+            log.info("layout v%d: %d blocks queued for rebalance (%s)",
+                     version, n,
+                     "full scan" if moved is None
+                     else f"{len(moved)}/256 partitions")
             if self._enumerated_version is None \
                     or version > self._enumerated_version:
                 self._enumerated_version = version

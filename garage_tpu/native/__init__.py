@@ -76,7 +76,7 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     lib.crc32c_update.argtypes = [ctypes.c_uint32, ctypes.c_void_p,
                                   ctypes.c_uint64]
     lib.crc32c_update.restype = ctypes.c_uint32
-    lib.crc64nvme_update.argtypes = [ctypes.c_uint64, ctypes.c_char_p,
+    lib.crc64nvme_update.argtypes = [ctypes.c_uint64, ctypes.c_void_p,
                                      ctypes.c_uint64]
     lib.crc64nvme_update.restype = ctypes.c_uint64
     lib.rs_encode_block_packed.argtypes = [
@@ -125,14 +125,30 @@ def warm_async() -> None:
                      name="native-build").start()
 
 
-def blake3(data: bytes) -> bytes:
+def _as_cdata(data):
+    """Adapt a hash/encode input for a c_char_p parameter WITHOUT
+    copying: bytes pass through; a writable buffer (a leased ingest
+    view on the zero-copy PUT path, ISSUE 17) wraps as a ctypes char
+    array over the same memory (pointer argtypes accept char arrays);
+    a readonly non-bytes buffer falls back to one materialization."""
+    if isinstance(data, bytes):
+        return data
+    mv = memoryview(data)
+    if mv.readonly or mv.nbytes == 0:
+        return mv.tobytes()
+    return (ctypes.c_char * mv.nbytes).from_buffer(mv)
+
+
+def blake3(data) -> bytes:
     """32-byte BLAKE3 digest (native; raises if the library is absent —
-    use utils.data.blake3sum for the auto-fallback entry point)."""
+    use utils.data.blake3sum for the auto-fallback entry point).
+    Accepts bytes or any contiguous buffer (hashing never copies)."""
     lib = _get()
     if lib is None:
         raise RuntimeError("native library unavailable")
     out = ctypes.create_string_buffer(32)
-    lib.b3_hash(data, len(data), out)
+    c = _as_cdata(data)
+    lib.b3_hash(c, len(c), out)
     return out.raw
 
 
@@ -187,14 +203,17 @@ class Md5:
         if self._h is not None:
             self._h.update(data)
         else:
-            _lib.gt_md5_update(self._st, bytes(data) if not
-                               isinstance(data, bytes) else data, len(data))
+            c = _as_cdata(data)
+            _lib.gt_md5_update(self._st, c, len(c))
 
-    def update_with_blake3(self, data: bytes) -> bytes:
+    def update_with_blake3(self, data) -> bytes:
         """MD5-advance by `data` AND return blake3(data), single pass.
-        Only valid when `fused` is True."""
+        Only valid when `fused` is True. Accepts bytes or a buffer
+        view (the zero-copy PUT path hashes the leased buffer in
+        place)."""
         out = ctypes.create_string_buffer(32)
-        _lib.gt_b3_md5_block(data, len(data), self._st, out)
+        c = _as_cdata(data)
+        _lib.gt_b3_md5_block(c, len(c), self._st, out)
         return out.raw
 
     def hexdigest(self) -> str:
@@ -206,12 +225,19 @@ class Md5:
 
 
 def _md5_batch_args(items: list[tuple["Md5", bytes]]):
+    """Items may carry bytes OR buffer views (leased ingest slices).
+    Returns a keepalive list the caller MUST hold through the native
+    call — it owns the char arrays the pointer array aims at."""
     n = len(items)
-    ps = (ctypes.c_char_p * n)(*[d for _, d in items])
-    lens = (ctypes.c_int64 * n)(*[len(d) for _, d in items])
+    keep = [_as_cdata(d) for _, d in items]
+    ps = (ctypes.c_void_p * n)(*[
+        ctypes.cast(ctypes.c_char_p(c) if isinstance(c, bytes) else c,
+                    ctypes.c_void_p)
+        for c in keep])
+    lens = (ctypes.c_int64 * n)(*[len(c) for c in keep])
     sts = (ctypes.c_void_p * n)(
         *[ctypes.addressof(m._st) for m, _ in items])
-    return n, ps, lens, sts
+    return n, ps, lens, sts, keep
 
 
 def md5_update_many(items: list[tuple["Md5", bytes]]) -> None:
@@ -220,8 +246,9 @@ def md5_update_many(items: list[tuple["Md5", bytes]]) -> None:
     per-object ETag chain vectorizes ACROSS concurrent requests)."""
     if not items:
         return
-    n, ps, lens, sts = _md5_batch_args(items)
+    n, ps, lens, sts, keep = _md5_batch_args(items)
     _lib.gt_md5_update_many(n, ps, lens, sts)
+    del keep
 
 
 def b3_md5_many(items: list[tuple["Md5", bytes]]) -> list[bytes]:
@@ -229,9 +256,10 @@ def b3_md5_many(items: list[tuple["Md5", bytes]]) -> list[bytes]:
     AND return each item's blake3 content hash."""
     if not items:
         return []
-    n, ps, lens, sts = _md5_batch_args(items)
+    n, ps, lens, sts, keep = _md5_batch_args(items)
     out = ctypes.create_string_buffer(32 * n)
     _lib.gt_b3_md5_many(n, ps, lens, sts, out)
+    del keep
     return [out.raw[32 * i:32 * (i + 1)] for i in range(n)]
 
 
@@ -278,11 +306,16 @@ def crc32c(data, crc: int = 0) -> int:
                              len(a))
 
 
-def crc64nvme(data: bytes, crc: int = 0) -> int:
+def crc64nvme(data, crc: int = 0) -> int:
+    """Accepts bytes OR any buffer (same contract as crc32c)."""
     lib = _get()
     if lib is None:
         raise RuntimeError("native library unavailable")
-    return lib.crc64nvme_update(crc, data, len(data))
+    if isinstance(data, (bytes, bytearray)):
+        return lib.crc64nvme_update(crc, data, len(data))
+    a = np.frombuffer(data, dtype=np.uint8)
+    return lib.crc64nvme_update(crc, a.ctypes.data if len(a) else None,
+                                len(a))
 
 
 SHARD_HDR_LEN = 16  # [magic 4][block_len u64 BE][crc32c u32 BE]
@@ -299,12 +332,13 @@ def rs_encode_packed(block: bytes, k: int, m: int, pmat: np.ndarray,
     lib = _get()
     if lib is None:
         raise RuntimeError("native library unavailable")
-    total = len(prefix) + len(block)
+    cblock = _as_cdata(block)
+    total = len(prefix) + len(cblock)
     shard_len = (total + k - 1) // k
     stride = SHARD_HDR_LEN + shard_len
     pmat = np.ascontiguousarray(pmat, dtype=np.uint8)
     out = np.empty((k + m) * stride, dtype=np.uint8)
-    lib.rs_encode_block_packed(prefix, len(prefix), block, len(block),
+    lib.rs_encode_block_packed(prefix, len(prefix), cblock, len(cblock),
                                k, m, pmat.ctypes.data, shard_len,
                                out.ctypes.data)
     view = memoryview(out.data).cast("B")

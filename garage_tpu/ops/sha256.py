@@ -1,0 +1,194 @@
+"""Batched SHA-256 for the device feeder (ISSUE 17).
+
+SigV4 streaming uploads (aws-chunked) sign every client chunk with a
+SHA-256 of its payload — on the PUT hot path that is a second full walk
+over every body byte, serial per stream. One stream's chunk hashes form
+a chain only through the *signature*, not the digest: each chunk's
+SHA-256 is independent, so chunk hashes from CONCURRENT streams batch
+into one launch exactly like the content-hash lanes.
+
+Formulation follows ops/treehash.py's lane-major rule: the batch is the
+trailing axis of every array, and the per-round body is a lax.scan so
+the HLO stays ~60 ops regardless of batch size (a fully unrolled 64
+round x 48 schedule u32 chain sends XLA:CPU into multi-minute
+compiles). The block axis is ALSO a scan, with per-row active masks so
+the block count can pad up to a power of two — one compiled program
+per (block bucket, item bucket) instead of one per distinct chunk
+size. SHA padding (0x80 + 64-bit big-endian bit length) is written
+host-side at the TRUE message end; rows past a message's final block
+compress into a state the mask then discards.
+
+The pure-Python hashlib path stays the host route and the test oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+
+import numpy as np
+
+# FIPS 180-4 constants
+K = np.array([
+    0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5,
+    0x3956C25B, 0x59F111F1, 0x923F82A4, 0xAB1C5ED5,
+    0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+    0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174,
+    0xE49B69C1, 0xEFBE4786, 0x0FC19DC6, 0x240CA1CC,
+    0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+    0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7,
+    0xC6E00BF3, 0xD5A79147, 0x06CA6351, 0x14292967,
+    0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+    0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85,
+    0xA2BFE8A1, 0xA81A664B, 0xC24B8B70, 0xC76C51A3,
+    0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+    0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5,
+    0x391C0CB3, 0x4ED8AA4A, 0x5B9CCA4F, 0x682E6FF3,
+    0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+    0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+], dtype=np.uint32)
+
+H0 = np.array([
+    0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+    0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,
+], dtype=np.uint32)
+
+BLOCK = 64  # compression block bytes
+
+
+def n_blocks_for(length: int) -> int:
+    """Blocks the padded message occupies: data + 0x80 + u64 bit
+    length, rounded up to 64."""
+    return (length + 9 + BLOCK - 1) // BLOCK
+
+
+def blocks_bucket(n_blocks: int, minimum: int = 16) -> int:
+    """Next power of two >= n_blocks (min 1 KiB of message): the block
+    axis is masked per row, so rounding it up costs only zero-block
+    compressions the mask discards — and keeps the compile count
+    logarithmic in chunk size instead of linear."""
+    b = minimum
+    while b < n_blocks:
+        b <<= 1
+    return b
+
+
+def part_len(data) -> int:
+    """Length of one message: bytes/buffer, or a list/tuple of spans —
+    the zero-copy aws-chunked path hands a client chunk as the spans it
+    landed in the lease (contiguous there, but framed per socket read),
+    and concatenating them host-side would be exactly the copy the
+    ingest path exists to avoid."""
+    if isinstance(data, (list, tuple)):
+        return sum(len(p) for p in data)
+    return len(data)
+
+
+def pad_row_into(row: np.ndarray, data) -> int:
+    """Write `data` + SHA padding into a ZEROED row of >= n_blocks*64
+    bytes; -> the row's true block count. `data` may be bytes, any
+    contiguous buffer (the zero-copy PUT path hands leased views), or a
+    list/tuple of spans hashed as one message — the row IS the h2d
+    staging buffer, so writing spans sequentially here is the one place
+    scattered wire bytes become a device-shaped message for free."""
+    off = 0
+    for part in (data if isinstance(data, (list, tuple)) else (data,)):
+        arr = np.frombuffer(part, dtype=np.uint8)
+        row[off:off + arr.size] = arr
+        off += arr.size
+    nb = n_blocks_for(off)
+    row[off] = 0x80
+    end = nb * BLOCK
+    row[end - 8:end] = np.frombuffer(
+        (off * 8).to_bytes(8, "big"), dtype=np.uint8)
+    return nb
+
+
+def hash_rows(msgs, nblocks, n_pad_blocks: int):
+    """Traceable batched SHA-256: msgs (B, n_pad_blocks*64) u8 padded
+    rows + (B,) i32 true block counts -> (B, 8) u32 big-endian digest
+    words. Rows must carry their own SHA padding (pad_row_into) and be
+    zero past it."""
+    import jax
+    import jax.numpy as jnp
+
+    u32 = jnp.uint32
+    b = msgs.shape[0]
+    w = msgs.reshape(b, n_pad_blocks, 16, 4).astype(u32)
+    # big-endian words, lane-major: (blocks, 16, B)
+    words = ((w[..., 0] << 24) | (w[..., 1] << 16)
+             | (w[..., 2] << 8) | w[..., 3]).transpose(1, 2, 0)
+    kj = jnp.asarray(K)
+
+    def rotr(x, n):
+        return (x >> u32(n)) | (x << u32(32 - n))
+
+    def round_body(carry, kt):
+        st, w16 = carry  # (8, B) working vars, (16, B) schedule ring
+        wt = w16[0]
+        # W[t+16] from the ring: W[t] + s0(W[t+1]) + W[t+9] + s1(W[t+14])
+        s0 = rotr(w16[1], 7) ^ rotr(w16[1], 18) ^ (w16[1] >> u32(3))
+        s1 = rotr(w16[14], 17) ^ rotr(w16[14], 19) ^ (w16[14] >> u32(10))
+        w16 = jnp.concatenate([w16[1:], (w16[0] + s0 + w16[9] + s1)[None]])
+        a, bb, c, d, e, f, g, h = st
+        t1 = (h + (rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25))
+              + ((e & f) ^ (~e & g)) + kt + wt)
+        t2 = ((rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22))
+              + ((a & bb) ^ (a & c) ^ (bb & c)))
+        st = jnp.stack([t1 + t2, a, bb, c, d + t1, e, f, g])
+        return (st, w16), None
+
+    def block_body(h, xs):
+        wb, act = xs  # (16, B) message words, (B,) active mask
+        (st, _), _ = jax.lax.scan(round_body, (h, wb), kj)
+        return jnp.where(act, h + st, h), None
+
+    active = (jnp.arange(n_pad_blocks, dtype=jnp.int32)[:, None]
+              < nblocks[None, :])  # (blocks, B)
+    h0 = jnp.tile(jnp.asarray(H0)[:, None], (1, b))
+    h, _ = jax.lax.scan(block_body, h0, (words, active))
+    return h.T  # (B, 8)
+
+
+@functools.lru_cache(maxsize=None)
+def hash_fn(n_pad_blocks: int):
+    """Jitted (B, n_pad_blocks*64) u8 + (B,) i32 -> (B, 8) u32; one
+    program per block bucket (hash_rows masks the tail)."""
+    import jax
+
+    return jax.jit(functools.partial(hash_rows,
+                                     n_pad_blocks=n_pad_blocks))
+
+
+def digests_to_hex(cvs) -> list[str]:
+    """(B, 8) u32 digest words -> per-row lowercase hex."""
+    arr = np.ascontiguousarray(np.asarray(cvs).astype(">u4"))
+    rows = arr.view(np.uint8).reshape(arr.shape[0], 32)
+    return [rows[i].tobytes().hex() for i in range(rows.shape[0])]
+
+
+def sha256_hex_many(blobs: list) -> list[str]:
+    """Device-batched hex digests (stage + launch + readback fused —
+    the synchronous test/bench entry; the staged backend calls the
+    pieces so d2h overlaps the next batch's h2d)."""
+    out: list = [None] * len(blobs)
+    groups: dict[int, list[int]] = {}
+    for i, d in enumerate(blobs):
+        groups.setdefault(
+            blocks_bucket(n_blocks_for(part_len(d))), []).append(i)
+    for npad, idxs in groups.items():
+        buf = np.zeros((len(idxs), npad * BLOCK), dtype=np.uint8)
+        nbs = np.empty(len(idxs), dtype=np.int32)
+        for row, i in enumerate(idxs):
+            nbs[row] = pad_row_into(buf[row], blobs[i])
+        for i, hx in zip(idxs, digests_to_hex(hash_fn(npad)(buf, nbs))):
+            out[i] = hx
+    return out
+
+
+def sha256_hex_py(data) -> str:
+    """Host oracle/fallback (accepts span lists like the device path)."""
+    h = hashlib.sha256()
+    for part in (data if isinstance(data, (list, tuple)) else (data,)):
+        h.update(part)
+    return h.hexdigest()

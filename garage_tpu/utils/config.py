@@ -44,8 +44,10 @@ class TpuConfig:
     # platform override for tests ("cpu" forces the jnp fallback path)
     platform: Optional[str] = None
     # staged-pipeline depth: device batches concurrently in flight
-    # through the h2d/compute/d2h stages (2 = double buffering)
-    inflight_batches: int = 2
+    # through the h2d/compute/d2h stages (3 = one per stage; 2 = double
+    # buffering, cheaper on device RAM but leaves the transfer engine
+    # idle while the batch ahead computes + reads back)
+    inflight_batches: int = 3
     # calibration routing floors: batches below BOTH never leave the
     # host (a device round trip costs more than it saves there)
     device_min_bytes: Optional[int] = None  # default 4 MiB
@@ -76,6 +78,13 @@ class TpuConfig:
     device_backend: str = "jax"
     # per-batch watchdog budget, seconds (covers every pipeline stage)
     batch_timeout_s: Optional[float] = None  # default 300
+    # batch-formation linger, milliseconds (ISSUE 17): how long the
+    # dispatcher holds a hash/encode batch open waiting for sibling
+    # PUT streams' submissions to line up. Under light load a trickle
+    # of PUTs used to ride size-1 host fallbacks because the greedy
+    # drain found an empty queue; the linger (still gated on >1 active
+    # stream) lets them coalesce into one device launch. 0 disables.
+    batch_linger_ms: Optional[float] = None  # default 6.0
 
 
 @dataclass
@@ -259,6 +268,12 @@ class Config:
     # read/writable via admin `GET/POST /v1/s3/tuning` for bench sweeps.
     s3_get_readahead_blocks: int = 3
     s3_put_blocks_max_parallel: int = 3
+    # ingest_buffers: pinned host buffers for the zero-copy PUT path
+    # (ISSUE 17, block/hostbuf.py) — each holds one block in stripe
+    # layout, so the pool pins ~N * block_size RAM; exhaustion
+    # backpressures PUTs instead of allocating. 0 disables the
+    # zero-copy path entirely (every PUT takes the classic copy path).
+    s3_ingest_buffers: int = 16
     k2v_api_bind_addr: Optional[str] = None
     admin_api_bind_addr: Optional[str] = None
     admin_token: Optional[str] = None

@@ -101,6 +101,16 @@ JAX_PLATFORMS=cpu GARAGE_TPU_DEVICE=off "$PY" -m pytest \
     -k "drill or degraded_override or partition_zone_fault"
 JAX_PLATFORMS=cpu GARAGE_TPU_DEVICE=off "$PY" bench.py bench_zone
 
+# wire->device PUT-path smoke (ISSUE 17): bench_put_path pins the stub
+# backend with modelled rates internally, so the frontend_efficiency /
+# copy-ratio trajectory it emits is comparable night over night on any
+# runner. JSON archived next to the soak log (the nightly trajectory
+# artifact); the hard >= 0.8 / <= 1.1x gates live in device_smoke.py.
+PUTPATH_JSON="${SOAK_LOG%.log}.putpath.json"
+say "put-path smoke: bench_put_path (archiving $PUTPATH_JSON)"
+JAX_PLATFORMS=cpu "$PY" bench.py bench_put_path \
+    | tee "$PUTPATH_JSON"
+
 # a stall/leak/conservation report anywhere in the soak — including
 # inside a forked worker whose parent test still passed — fails the
 # job; the report text names the pinned frame

@@ -72,6 +72,40 @@ def main() -> int:
           f"({dec['decode_feeder_device_items']} decode items, "
           f"{dec['decode_recompiles']} recompiles across "
           f"{dec['decode_patterns_mixed']} erasure patterns)")
+
+    # wire->device gate (ISSUE 17): bench_put_path pins the STUB
+    # backend with modelled rates internally (the measurement isolates
+    # the FRONTEND, so it runs identically on a deviceless CI runner
+    # and a TPU box). The frontend must keep the modelled pipeline
+    # >= 80% fed and land each body byte in host RAM ~once (<= 1.1x,
+    # alignment slop). The per-stage breakdown prints for the TPU
+    # recapture runbook (DEVICE_PATH.md).
+    pp = bench.bench_put_path()
+    print(json.dumps(pp, indent=2))
+    if pp.get("put_feeder_device_items", 0) <= 0:
+        print("FAIL: put_feeder_device_items == 0 — ingest-path PUTs "
+              "never reached the device path")
+        return 1
+    if pp.get("put_sha256_device_items", 0) <= 0:
+        print("FAIL: put_sha256_device_items == 0 — signed-chunk "
+              "hashing never reached the batched sha256 lane")
+        return 1
+    eff = pp.get("frontend_efficiency", 0.0)
+    if eff < 0.8:
+        print(f"FAIL: frontend_efficiency = {eff:.3f} (< 0.8) — "
+              "the frontend starves the modelled device pipeline "
+              f"(ceiling {pp['put_path_modeled_ceiling_gbps']} GB/s, "
+              f"measured {pp['put_path_gbps']} GB/s)")
+        return 1
+    ratio = pp.get("put_copy_ratio", 99.0)
+    if ratio > 1.1:
+        print(f"FAIL: put_copy_ratio = {ratio:.2f} (> 1.1) — PUT "
+              "bodies are being re-materialized between socket and "
+              f"device: {pp['put_copy_bytes_by_path']}")
+        return 1
+    print(f"OK: wire->device gap closed (efficiency {eff:.3f}, "
+          f"copy ratio {ratio:.2f}, "
+          f"{pp['put_feeder_device_items']} device items)")
     return 0
 
 

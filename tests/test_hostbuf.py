@@ -1,0 +1,493 @@
+"""Zero-copy PUT ingest (ISSUE 17): pinned host-buffer pool semantics,
+stripe-layout byte parity, the batched SHA-256 lanes, and the
+aws-chunked reader's zero-copy (readinto1) decode path.
+
+Unit-level against fakes — the end-to-end copy/efficiency claims live
+in bench_put_path and script/device_smoke.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac
+import os
+import types
+
+import numpy as np
+import pytest
+
+from garage_tpu.block.hostbuf import BlockLease, HostBufPool
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---- pool semantics ------------------------------------------------------
+
+
+def test_pool_exhaustion_parks_and_fifo_handoff():
+    pool = HostBufPool(k=4, block_size=1024, count=2)
+
+    async def main():
+        a = await pool.acquire()
+        b = await pool.acquire()
+        assert pool.outstanding() == 2 and not pool._free
+        order: list[str] = []
+
+        async def waiter(tag: str):
+            lease = await pool.acquire()
+            order.append(tag)
+            return lease
+
+        w1 = asyncio.create_task(waiter("first"))
+        w2 = asyncio.create_task(waiter("second"))
+        await asyncio.sleep(0)  # both park: the pool is dry
+        assert not w1.done() and not w2.done()
+        assert pool.stats()["waiters"] == 2
+        a.release()  # hands a's buffer to w1 directly
+        b.release()
+        l1, l2 = await w1, await w2
+        assert order == ["first", "second"]  # FIFO, no barging
+        # handoff never touched the free list
+        assert pool.outstanding() == 2
+        l1.release()
+        l2.release()
+        assert pool.outstanding() == 0 and len(pool._free) == 2
+
+    run(main())
+
+
+def test_pool_release_is_idempotent_and_conserves():
+    pool = HostBufPool(k=2, block_size=64, count=1)
+
+    async def main():
+        lease = await pool.acquire()
+        lease.release()
+        lease.release()  # abort paths double-release without electing an owner
+        lease.release()
+        assert pool.outstanding() == 0
+        assert len(pool._free) == 1  # buffer returned exactly once
+        again = await pool.acquire()
+        assert pool.outstanding() == 1
+        again.release()
+
+    run(main())
+
+
+def test_pool_cancelled_waiter_skipped_no_leak():
+    pool = HostBufPool(k=2, block_size=64, count=1)
+
+    async def main():
+        held = await pool.acquire()
+        w1 = asyncio.create_task(pool.acquire())
+        w2 = asyncio.create_task(pool.acquire())
+        await asyncio.sleep(0)
+        w1.cancel()
+        await asyncio.gather(w1, return_exceptions=True)
+        held.release()  # must skip the dead waiter, wake w2
+        lease = await asyncio.wait_for(w2, 1.0)
+        assert pool.outstanding() == 1
+        lease.release()
+        assert pool.outstanding() == 0
+
+    run(main())
+
+
+# ---- stripe layout parity ------------------------------------------------
+
+
+def fill_lease(lease: BlockLease, body: bytes, scheme: int) -> None:
+    mv = lease.body_mv()
+    mv[:len(body)] = body
+    lease.length = len(body)
+    lease.set_scheme(scheme)
+
+
+def test_stripe_view_matches_split_stripe():
+    from garage_tpu.ops import rs
+
+    k, block_size = 4, 1000
+    pool = HostBufPool(k=k, block_size=block_size, count=1)
+    lease = pool.try_acquire()
+    body = os.urandom(block_size)
+    fill_lease(lease, body, scheme=0x01)
+    assert lease.full and lease.total_len == 1 + block_size
+    want = np.asarray(rs.split_stripe(b"\x01" + body, k))
+    got = lease.stripe()
+    assert got.shape == want.shape
+    assert bytes(got.tobytes()) == bytes(want.tobytes())
+    # view() is exactly the body, without the scheme byte
+    assert bytes(lease.view()) == body
+    lease.release()
+
+
+def test_stripe_tail_stays_zero_across_reuse():
+    """stripe() relies on the reshape tail (< k bytes past the scheme +
+    cap region) staying zero for the pool's LIFETIME — a short body on
+    reuse must not inherit stale bytes in the padded region it never
+    wrote (view/total_len bound what later stages read)."""
+    k, block_size = 4, 1001
+    pool = HostBufPool(k=k, block_size=block_size, count=1)
+    tail = pool.slen * k - (1 + block_size)
+    lease = pool.try_acquire()
+    fill_lease(lease, b"\xff" * block_size, scheme=0xAA)
+    if tail:
+        assert not lease.buf[1 + block_size:].any()
+    lease.release()
+    again = pool.try_acquire()
+    fill_lease(again, b"\x00" * 10, scheme=0x00)
+    assert again.total_len == 11
+    # the unwritten body region may hold stale 0xff — but the consumers
+    # of a PARTIAL block (view/total_len) never read past length
+    assert bytes(again.view()) == b"\x00" * 10
+    again.release()
+
+
+# ---- batched SHA-256 (ops/sha256) ----------------------------------------
+
+
+def test_sha256_kernel_matches_hashlib_boundaries():
+    from garage_tpu.ops import sha256 as sha
+
+    cases = [b"", b"a", b"x" * 55, b"y" * 56, b"z" * 63, b"w" * 64,
+             os.urandom(65), os.urandom(1000), os.urandom(64 * 1024 + 7)]
+    got = sha.sha256_hex_many(cases)
+    want = [hashlib.sha256(c).hexdigest() for c in cases]
+    assert got == want
+
+
+def test_sha256_span_lists_hash_as_one_message():
+    from garage_tpu.ops import sha256 as sha
+
+    blob = os.urandom(200_000)
+    spans = [memoryview(blob)[0:70_000], memoryview(blob)[70_000:70_001],
+             memoryview(blob)[70_001:200_000]]
+    assert sha.part_len(spans) == len(blob)
+    assert sha.sha256_hex_py(spans) == hashlib.sha256(blob).hexdigest()
+    got = sha.sha256_hex_many([spans, blob, [b"ab", b"", b"cd"]])
+    assert got == [hashlib.sha256(blob).hexdigest(),
+                   hashlib.sha256(blob).hexdigest(),
+                   hashlib.sha256(b"abcd").hexdigest()]
+
+
+# ---- feeder sha256 lane --------------------------------------------------
+
+
+def _stub_feeder(max_batch: int = 8):
+    from garage_tpu.block.device_backend import StubDeviceBackend
+    from garage_tpu.block.feeder import DeviceFeeder
+
+    stub = StubDeviceBackend(None, h2d_gbps=1e6, compute_gbps=1e6,
+                             d2h_gbps=1e6)
+    f = DeviceFeeder(mode="require", max_batch=max_batch, backend=stub)
+    f._device_ok = True
+    return f
+
+
+def test_feeder_sha256_host_floor_when_alone():
+    f = _stub_feeder()
+    blob = os.urandom(100_000)
+
+    async def main():
+        assert f.active_streams == 0  # lone caller: host floor
+        out = await f.sha256_hex(blob)
+        assert out == hashlib.sha256(blob).hexdigest()
+        assert f.stats["device_items"] == 0
+        assert ("sha256", "host") in f._perf
+
+    run(main())
+
+
+def test_feeder_sha256_concurrent_streams_batch_on_device():
+    f = _stub_feeder()
+    blobs = [os.urandom(80_000 + i) for i in range(4)]
+
+    async def main():
+        f.active_streams = 4
+        try:
+            outs = await asyncio.gather(*[f.sha256_hex(b) for b in blobs])
+        finally:
+            await f.stop()
+        assert outs == [hashlib.sha256(b).hexdigest() for b in blobs]
+        assert f.stats["device_items"] == 4
+        # the linger window coalesced the four lanes into one launch
+        assert f.stats["device_batches"] <= 2
+
+    run(main())
+
+
+def test_feeder_sha256_accepts_span_lists():
+    f = _stub_feeder()
+    blob = os.urandom(150_000)
+    spans = [memoryview(blob)[:50_000], memoryview(blob)[50_000:]]
+
+    async def main():
+        f.active_streams = 2
+        try:
+            out = await f.sha256_hex(spans)
+        finally:
+            await f.stop()
+        assert out == hashlib.sha256(blob).hexdigest()
+        assert f.stats["device_items"] == 1
+
+    run(main())
+
+
+def test_batch_linger_knob_plumbed_from_config():
+    from garage_tpu.block.feeder import DeviceFeeder
+
+    assert DeviceFeeder(mode="off").batch_linger == pytest.approx(0.006)
+    cfg = types.SimpleNamespace(batch_linger_ms=25)
+    assert DeviceFeeder(
+        mode="off", tpu_cfg=cfg).batch_linger == pytest.approx(0.025)
+    off = types.SimpleNamespace(batch_linger_ms=0)
+    assert DeviceFeeder(mode="off", tpu_cfg=off).batch_linger == 0.0
+
+
+# ---- aws-chunked zero-copy decode (readinto1) ----------------------------
+
+
+class ListBody:
+    """BodyReader stand-in: read() yields preset chunks; readinto1
+    lands at most `max_span` bytes per call (short socket reads)."""
+
+    def __init__(self, chunks, max_span: int = 1 << 30):
+        self.buf = bytearray(b"".join(chunks))
+        self.max_span = max_span
+
+    async def read(self, n: int = 65536) -> bytes:
+        out = bytes(self.buf[:n])
+        del self.buf[:n]
+        return out
+
+    async def readinto1(self, mv: memoryview) -> int:
+        n = min(len(mv), len(self.buf), self.max_span)
+        mv[:n] = self.buf[:n]
+        del self.buf[:n]
+        return n
+
+    async def drain(self):
+        self.buf = bytearray()
+
+
+def _chunked_wire(chunks, secret="secret", region="garage",
+                  amz_date="20260806T000000Z", scope_date="20260806",
+                  corrupt_at=None):
+    from garage_tpu.api.signature import VerifiedRequest, signing_key
+
+    sk = signing_key(secret, scope_date, region)
+    seed = "0" * 64
+    scope = f"{scope_date}/{region}/s3/aws4_request"
+    prev = seed
+    wire = b""
+    empty = hashlib.sha256(b"").hexdigest()
+    for i, c in enumerate(list(chunks) + [b""]):
+        sts = "\n".join(["AWS4-HMAC-SHA256-PAYLOAD", amz_date, scope, prev,
+                         empty, hashlib.sha256(c).hexdigest()])
+        sig = hmac.new(sk, sts.encode(), hashlib.sha256).hexdigest()
+        prev = sig
+        if corrupt_at is not None and i == corrupt_at:
+            sig = "f" * 64
+        wire += b"%x;chunk-signature=%s\r\n" % (len(c), sig.encode())
+        wire += c + b"\r\n" if c else b"\r\n"
+    v = VerifiedRequest("key", "STREAMING-AWS4-HMAC-SHA256-PAYLOAD",
+                        seed, scope_date, sk, False)
+    return wire, v, amz_date
+
+
+async def _drain_readinto1(reader, window: int) -> bytes:
+    """Pull the whole decoded body through readinto1 using successive
+    `window`-sized destination buffers — the Chunker's access pattern
+    (each buffer a leased block)."""
+    out = bytearray()
+    buf = bytearray(window)
+    off = 0
+    while True:
+        n = await reader.readinto1(memoryview(buf)[off:])
+        if n == 0:
+            out.extend(buf[:off])
+            return bytes(out)
+        off += n
+        if off == window:
+            out.extend(buf)  # "hand off the lease"
+            buf = bytearray(window)
+            off = 0
+
+
+def test_readinto1_parity_with_read_path():
+    from garage_tpu.api.signature import AwsChunkedReader
+
+    chunks = [os.urandom(150_000), os.urandom(80_000), b"tail"]
+    body = b"".join(chunks)
+
+    async def main():
+        for window in (256 * 1024, 100_000, 7_777):
+            wire, v, amz = _chunked_wire(chunks)
+            r = AwsChunkedReader(ListBody([wire], max_span=61_440), v,
+                                 "garage", amz, signed=True)
+            assert await _drain_readinto1(r, window) == body
+
+    run(main())
+
+
+def test_readinto1_chunk_crossing_lease_boundary_folds_and_verifies():
+    """A chunk that outlives its destination buffer folds its spans
+    into a host hasher at the handoff — the signature still verifies
+    even though the landed bytes are recycled before chunk end."""
+    from garage_tpu.api.signature import AwsChunkedReader
+
+    chunks = [os.urandom(190_000)]  # crosses a 128 KiB window
+
+    async def main():
+        wire, v, amz = _chunked_wire(chunks)
+        r = AwsChunkedReader(ListBody([wire], max_span=50_000), v,
+                             "garage", amz, signed=True)
+        got = await _drain_readinto1(r, 128 * 1024)
+        assert got == chunks[0]
+        assert r._chunk_hasher is None and not r._chunk_spans
+
+    run(main())
+
+
+def test_readinto1_forged_chunk_403s_before_body_completes():
+    from garage_tpu.api.http import HttpError
+    from garage_tpu.api.signature import AwsChunkedReader
+
+    async def main():
+        for corrupt_at in (0, 1):
+            chunks = [os.urandom(90_000), os.urandom(40_000)]
+            wire, v, amz = _chunked_wire(chunks, corrupt_at=corrupt_at)
+            r = AwsChunkedReader(ListBody([wire], max_span=30_000), v,
+                                 "garage", amz, signed=True)
+            with pytest.raises(HttpError) as ei:
+                await _drain_readinto1(r, 256 * 1024)
+            assert ei.value.status == 403
+
+    run(main())
+
+
+def test_readinto1_whole_chunk_rides_feeder_sha_lane():
+    """A chunk wholly resident in the live lease hands its span list to
+    the feeder (batched device sha256); a boundary-crossing chunk does
+    not (its bytes are folded host-side at the handoff)."""
+    from garage_tpu.api.signature import AwsChunkedReader
+
+    calls: list[int] = []
+
+    class FakeFeeder:
+        async def sha256_hex(self, data):
+            from garage_tpu.ops import sha256 as sha
+
+            calls.append(sha.part_len(data))
+            return sha.sha256_hex_py(data)
+
+    async def main():
+        chunks = [os.urandom(100_000), os.urandom(100_000)]
+        wire, v, amz = _chunked_wire(chunks)
+        r = AwsChunkedReader(ListBody([wire], max_span=61_440), v,
+                             "garage", amz, signed=True,
+                             feeder=FakeFeeder())
+        # window holds each whole chunk: both hashes ride the feeder
+        got = await _drain_readinto1(r, 100_000)
+        assert got == b"".join(chunks)
+        assert calls == [100_000, 100_000]
+        calls.clear()
+        wire, v, amz = _chunked_wire(chunks)
+        r = AwsChunkedReader(ListBody([wire], max_span=61_440), v,
+                             "garage", amz, signed=True,
+                             feeder=FakeFeeder())
+        # 150 KiB windows split chunk 2 across leases: only chunk 1
+        # rides the feeder, chunk 2 folds host-side — still verifies
+        got = await _drain_readinto1(r, 150_000)
+        assert got == b"".join(chunks)
+        assert calls == [100_000]
+
+    run(main())
+
+
+# ---- cache tier local-owner shortcut -------------------------------------
+
+
+def _tier(me: bytes, members: list[bytes], max_bytes: int = 1 << 20):
+    from garage_tpu.block.cache_tier import ClusterCacheTier
+
+    manager = types.SimpleNamespace(
+        cache=types.SimpleNamespace(max_bytes=max_bytes),
+        system=types.SimpleNamespace(id=me))
+    tier = ClusterCacheTier.__new__(ClusterCacheTier)
+    tier.manager = manager
+    tier.enabled = True
+    tier.members = lambda: members
+    return tier
+
+
+def test_local_owner_true_only_on_real_multinode_ownership():
+    from garage_tpu.gateway.ring import rendezvous_owner
+
+    nodes = [bytes([i]) * 32 for i in range(4)]
+    h_mine = None
+    h_other = None
+    for i in range(256):
+        h = hashlib.sha256(bytes([i])).digest()
+        if rendezvous_owner(nodes, h) == nodes[0]:
+            h_mine = h_mine or h
+        else:
+            h_other = h_other or h
+    tier = _tier(nodes[0], nodes)
+    assert tier.local_owner(h_mine) is True
+    assert tier.local_owner(h_other) is False
+    # moot routing is False here (distinct from owns())
+    assert _tier(nodes[0], [nodes[0]]).local_owner(h_mine) is False
+    assert _tier(nodes[0], nodes, max_bytes=0).local_owner(h_mine) is False
+    off = _tier(nodes[0], nodes)
+    off.enabled = False
+    assert off.local_owner(h_mine) is False
+
+
+# ---- resync rebalance scoping (satellite: moved-partition diff) ----------
+
+
+@pytest.mark.slow
+def test_moved_partitions_scopes_rebalance_to_the_diff(tmp_path):
+    """A +1-node resize moves a strict subset of the 256 partitions;
+    _moved_partitions returns exactly the rows whose placement tuples
+    changed, and falls back to None (full scan) whenever the diff
+    cannot be computed soundly."""
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+    from test_model import make_garage_cluster, stop_all, wait_until
+
+    async def main():
+        net, garages, tasks = await make_garage_cluster(
+            tmp_path, n=4, rf=3, storage=[0, 1, 2])
+        try:
+            from garage_tpu.rpc.layout import NodeRole
+
+            lm = garages[0].system.layout_manager
+            lm.history.stage_role(garages[3].system.id,
+                                  NodeRole(zone="z1", capacity=1 << 30))
+            lm.apply_staged(None)
+            assert await wait_until(
+                lambda: lm.history.current().version == 2)
+
+            rsync = garages[0].block_manager.resync
+            moved = rsync._moved_partitions(2, 1)
+            assert moved is not None
+            assert 0 < len(moved) < 256  # a resize, not a rebuild
+            old = lm.history.get_version(1)
+            new = lm.history.get_version(2)
+            for p in range(256):
+                changed = tuple(old.nodes_of(p)) != tuple(new.nodes_of(p))
+                assert (p in moved) == changed
+
+            # unsound diffs degrade to full scans, never to skipping
+            assert rsync._moved_partitions(2, None) is None
+            assert rsync._moved_partitions(2, 2) is None
+            assert rsync._moved_partitions(2, 99) is None  # GC'd/unknown
+        finally:
+            await stop_all(garages, tasks)
+
+    asyncio.run(asyncio.wait_for(main(), 120))
