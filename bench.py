@@ -382,6 +382,12 @@ async def _put_cluster_bench(tmp: str, platform: str, nblocks: int,
         "put_wire_mib_per_block": round(wire, 2),
         "scrub_blocks_per_s": round(scrub_bps, 1),
         "scrub_corrupt": bad,
+        # repairs that localized from the packed cache tier (ISSUE 18)
+        # instead of gathering the stripe; 0.0 on this single-node
+        # whole-block lane — bench_cache_tier prices the cluster case
+        "scrub_cache_hit_rate": round(
+            scrubber.scrub_cache_hits
+            / max(scrubber.scrub_cache_lookups, 1), 3),
         "feeder_device_items": feeder_stats["device_items"],
         "feeder_max_batch": feeder_stats["max_batch"],
         "feeder_mbps": feeder_perf,
@@ -1392,6 +1398,16 @@ def bench_cache_tier(nblocks: int = 12, block_kib: int = 512,
       cache_tier_cold_decode_ms      the cold gather+decode it replaces
       cache_tier_hint_convergence_s  hot-hash hint gossip: heat node0,
                                      time until every peer knows
+      cache_tier_flash_decode_amp    (ISSUE 18) cold Zipf flash crowd:
+                                     cluster decodes per distinct hot
+                                     block with probe leases on, vs
+      ..._flash_decode_amp_nolease   the same herd with the lease
+                                     wait-mode off (wait_ms=0)
+      cache_tier_flash_p99_ms        herd GET p99, leases on/off —
+                                     prices the park-and-wake tradeoff
+      cache_tier_scrub_cache_hit_rate  stripe repairs localizing from
+                                     the packed tier instead of a
+                                     cluster gather
       shm_forward_*_us               shm publish+map vs loopback-socket
                                      copy per forward, by payload size
     """
@@ -1510,6 +1526,91 @@ def bench_cache_tier(nblocks: int = 12, block_kib: int = 512,
                     break
                 await asyncio.sleep(0.02)
 
+            # ---- flash crowd: cold-herd decode amplification ----------
+            # (ISSUE 18) every node hammers a Zipf-weighted sequence
+            # over a fully COLD set, probe leases on vs off. The
+            # prefetch lane is parked for the drill: the sweeps above
+            # left 120 s-TTL hints everywhere, and owners acting on
+            # them mid-herd would decode behind the count.
+            from garage_tpu.block.cache_tier import (
+                LEASE_WAIT_MS_DEFAULT, PREFETCH_INFLIGHT_DEFAULT)
+
+            zipf_w = 1.0 / np.arange(1, nblocks + 1)
+            zipf_w = zipf_w / zipf_w.sum()
+            flash_rng = np.random.default_rng(18)
+            seqs = [flash_rng.choice(nblocks, size=nblocks * 2,
+                                     p=zipf_w) for _ in managers]
+            distinct = len({int(i) for seq in seqs for i in seq})
+
+            async def flash(lease_on: bool) -> tuple[float, float]:
+                for m in managers:
+                    m.cache.clear()
+                    m.packed_cache.clear()
+                    m.cache_tier.lease_wait_ms = (
+                        LEASE_WAIT_MS_DEFAULT if lease_on else 0.0)
+                    m.cache_tier.prefetch_inflight = 0
+                d0 = decodes()
+                lats: list = []
+
+                async def hammer(m, seq):
+                    for i in seq:
+                        t0 = time.perf_counter()
+                        await m.rpc_get_block(hashes[int(i)])
+                        lats.append(time.perf_counter() - t0)
+
+                await asyncio.gather(*[hammer(m, seq)
+                                       for m, seq in zip(managers,
+                                                         seqs)])
+                amp = (decodes() - d0) / max(distinct, 1)
+                lats.sort()
+                p99 = lats[min(len(lats) - 1,
+                               int(0.99 * len(lats)))] * 1e3
+                return round(amp, 2), round(p99, 3)
+
+            amp_off, p99_off = await flash(lease_on=False)
+            amp_on, p99_on = await flash(lease_on=True)
+            for m in managers:  # restore the knobs for the next lanes
+                m.cache_tier.lease_wait_ms = LEASE_WAIT_MS_DEFAULT
+                m.cache_tier.prefetch_inflight = \
+                    PREFETCH_INFLIGHT_DEFAULT
+
+            # ---- scrub repair rides the packed tier -------------------
+            # forge one shard on a handful of stripes whose scrub
+            # leader holds the packed bytes warm: repair localizes from
+            # the cache instead of gathering the stripe
+            from garage_tpu.block import ScrubWorker
+            from garage_tpu.block.codec import shard_nodes_of
+            from garage_tpu.block.manager import (pack_shard,
+                                                  unpack_shard)
+
+            layout = systems[0].layout_helper.current()
+            by_node = {s.id: m for s, m in zip(systems, managers)}
+            width = managers[0].codec.width
+            sc_hits = sc_lookups = repaired = 0
+            for h in hashes[:6]:
+                placement = shard_nodes_of(layout, h, width)
+                leader = by_node[placement[0]]
+                if leader.packed_cache.get(h) is None:
+                    # decode once ON the leader (tier lane parked so
+                    # the probe can't shortcut it): warms its packed
+                    # segment the way a foreground herd would
+                    leader.cache.discard(h)
+                    tier_was = leader.cache_tier.enabled
+                    leader.cache_tier.enabled = False
+                    await leader.rpc_get_block(h)
+                    leader.cache_tier.enabled = tier_was
+                victim = by_node[placement[1]]
+                raw = victim.read_local_shard(h, 1)
+                payload, packed_len = unpack_shard(raw)
+                forged = (bytes(b ^ 0xFF for b in payload[:64])
+                          + payload[64:])
+                victim.write_local_shard(h, 1,
+                                         pack_shard(forged, packed_len))
+                sw = ScrubWorker(leader)
+                repaired += await sw.scrub_batch([h])
+                sc_hits += sw.scrub_cache_hits
+                sc_lookups += sw.scrub_cache_lookups
+
             total = nodes * rounds * nblocks * block_len
             out = {
                 "cache_tier_hot_get_gbps": round(total / tier_dt / 1e9,
@@ -1528,6 +1629,14 @@ def bench_cache_tier(nblocks: int = 12, block_kib: int = 512,
                     round(conv, 3) if conv is not None else None),
                 "cache_tier_probe_hits": sum(
                     m.cache_tier.probe_hits for m in managers),
+                # ISSUE 18: cold-herd economics + packed-tier scrub
+                "cache_tier_flash_decode_amp": amp_on,
+                "cache_tier_flash_decode_amp_nolease": amp_off,
+                "cache_tier_flash_p99_ms": p99_on,
+                "cache_tier_flash_p99_ms_nolease": p99_off,
+                "cache_tier_scrub_repaired": repaired,
+                "cache_tier_scrub_cache_hit_rate": round(
+                    sc_hits / max(sc_lookups, 1), 3),
             }
             return out
         finally:

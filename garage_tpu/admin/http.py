@@ -463,6 +463,28 @@ class AdminHttpServer:
                 "rebalance_errors": res.errors_len(),
             })
 
+        if path == "/v1/cache" and m == "GET":
+            # cache observability (ISSUE 18): per-segment bytes/entries,
+            # the cluster tier's lease table + prefetch queue depth, and
+            # the node-local singleflight collapse counts — one stop for
+            # "is the cold-herd machinery actually engaging?"
+            bm = getattr(self.garage, "block_manager", None)
+            if bm is None:
+                return _json({"enabled": False})
+            out = {"enabled": True,
+                   "plain": bm.cache.stats(),
+                   "singleflight": {
+                       "leaders": bm.sf_leaders,
+                       "collapsed": bm.sf_collapsed,
+                       "in_flight": len(bm._sf),
+                   }}
+            pc = getattr(bm, "packed_cache", None)
+            if pc is not None:
+                out["packed"] = pc.stats()
+            tier = getattr(bm, "cache_tier", None)
+            out["tier"] = tier.stats() if tier is not None else None
+            return _json(out)
+
         if path == "/v1/qos" and m == "GET":
             return _json(self._qos_state())
         if path == "/v1/qos" and m == "POST":
@@ -881,6 +903,44 @@ class AdminHttpServer:
             gauge("cache_tier_inserts_pushed", ts["inserts_pushed"])
             gauge("cache_tier_hints_known", ts["hints_known"])
             gauge("cache_tier_hints_seen", ts["hints_seen"])
+            # probe singleflight leases + hint prefetch (ISSUE 18):
+            # the cold-herd plane — lease table depth and queue length
+            # are the live-pressure gauges, the counters are the
+            # collapse economics the flash-crowd drill asserts on
+            gauge("cache_lease_wait_ms_configured", ts["lease_wait_ms"])
+            gauge("cache_lease_table_depth", ts["lease_depth"],
+                  "Live probe leases at this owner")
+            gauge("cache_lease_minted_total", ts["lease_minted"])
+            gauge("cache_lease_resolved_total", ts["lease_resolved"])
+            gauge("cache_lease_expired_total", ts["lease_expired"])
+            gauge("cache_lease_waits_total", ts["lease_waits"])
+            gauge("cache_lease_grants_total", ts["lease_grants"])
+            gauge("cache_lease_wait_hits_total", ts["lease_wait_hits"])
+            gauge("cache_lease_wait_timeouts_total",
+                  ts["lease_wait_timeouts"])
+            gauge("cache_prefetch_queue_depth", ts["prefetch_queue"],
+                  "Hinted hashes awaiting background prefetch")
+            gauge("cache_prefetch_done_total", ts["prefetched"])
+            gauge("cache_prefetch_skips_total", ts["prefetch_skips"])
+            gauge("cache_prefetch_drops_total", ts["prefetch_drops"])
+            gauge("cache_prefetch_errors_total", ts["prefetch_errors"])
+        # packed-bytes tier segment + node-local read singleflight
+        # (ISSUE 18)
+        pc = getattr(g.block_manager, "packed_cache", None)
+        if pc is not None:
+            gauge("cache_packed_bytes", pc.bytes_used,
+                  "Packed-bytes tier segment resident bytes")
+            gauge("cache_packed_entries", pc.entries)
+            gauge("cache_packed_max_bytes", pc.max_bytes)
+            gauge("cache_packed_inserts_total", pc.inserts)
+            gauge("cache_packed_hits_total", pc.hits)
+        gauge("cache_sf_leaders_total",
+              getattr(g.block_manager, "sf_leaders", 0),
+              "Node-local read singleflight: store reads led")
+        gauge("cache_sf_collapsed_total",
+              getattr(g.block_manager, "sf_collapsed", 0),
+              "Node-local read singleflight: reads collapsed onto "
+              "a leader")
         sw = g.block_manager.scrub_worker
         if sw is not None:
             out.append("# HELP block_scrub_corruptions "
@@ -893,6 +953,10 @@ class AdminHttpServer:
             gauge("block_scrub_deep_stripes_repaired", sw.deep_repaired)
             out.append("# TYPE block_scrub_header_repaired counter")
             gauge("block_scrub_header_repaired", sw.header_repaired)
+            out.append("# TYPE block_scrub_cache_lookups counter")
+            gauge("block_scrub_cache_lookups", sw.scrub_cache_lookups)
+            out.append("# TYPE block_scrub_cache_hits counter")
+            gauge("block_scrub_cache_hits", sw.scrub_cache_hits)
 
         for t in g.all_tables():
             s = t.data.stats()

@@ -225,8 +225,13 @@ CACHE_SEAM = {"rpc_get_block", "rpc_put_block"}
 # block/cache_tier.py): `probe` on a tier/cache receiver must carry the
 # same explicit cacheable= audit flag as the rpc_get/put_block seam —
 # an SSE-C hash must never even be ASKED about across nodes — and
-# `insert_at` is a cache-insert sink like `.insert`
-TIER_PROBE_NAMES = {"probe", "cache_tier_probe"}
+# `insert_at` is a cache-insert sink like `.insert`. ISSUE 18 widened
+# the seam: `probe_full` is the lease-carrying GET form and
+# `probe_packed` hits the packed-bytes segment — same audit flag, same
+# rule (an SSE-C hash must not mint a lease or pull packed bytes
+# either).
+TIER_PROBE_NAMES = {"probe", "probe_full", "probe_packed",
+                    "cache_tier_probe"}
 CACHE_INSERT_NAMES = {"insert", "insert_at", "cache_tier_insert"}
 _SSEISH = ("<sse>", "<decrypt>")
 

@@ -122,6 +122,15 @@ class BlockCache:
             self.misses += 1
             return None
 
+    def contains(self, hash32: bytes) -> bool:
+        """Presence peek that moves NO stats and NO LRU order — the
+        prefetch planner's "already warm?" check must not promote an
+        entry or inflate the hit counters the hint gossip reads."""
+        if self.max_bytes <= 0:
+            return False
+        with self._lock:
+            return hash32 in self._prot or hash32 in self._prob
+
     def top_keys(self, n: int) -> list[bytes]:
         """The n hottest cached hashes by hit count (hint gossip
         payload). Only actually-hot entries qualify — a key with no

@@ -50,14 +50,45 @@ copies. This module is the cross-NODE lane:
     lease/hint-based hot-set placement shape of Nishtala et al.,
     NSDI'13).
 
+ISSUE 18 adds the COLD-herd machinery on top of the hot-path routing:
+
+  * **Probe singleflight leases** — a probe that misses at the owner
+    MINTS a lease (ProbeLeaseTable): the first prober becomes the
+    holder, decodes via its store path and write-through-inserts;
+    every concurrent prober — remote via the `cache_probe` wait-mode,
+    local via the owner's own lease check in rpc_get_block — parks on
+    the owner for a bounded wait (`[block] cache_lease_wait_ms`) and
+    is woken by the insert's arrival. The wait is budgeted INSIDE the
+    probe's flat PROBE_TIMEOUT_S, never stacked on top, so a dead or
+    blackholed lease holder can never push a GET past the pre-lease
+    worst case: waiters time out, fall back to the store path, and
+    the expired lease is reaped. This is the memcache lease shape of
+    Nishtala et al. (NSDI'13) on the rendezvous ring — a cold flash
+    crowd pays O(blocks) decodes cluster-wide, not O(blocks x nodes).
+  * **Hint-driven prefetch** — the owner ACTS on an inbound hint for a
+    block it doesn't hold: a bounded background queue decodes it ahead
+    of the herd (<= `[block] cache_prefetch_inflight` concurrent, one
+    governor-paced sleep per fetch), converting the first herd into a
+    warm probe hit. Hints are zone-filtered BEFORE the prefetch
+    trigger sees them, and the fetch itself is the owner's local store
+    path — prefetch can neither be triggered by nor fetch across a
+    zone boundary.
+  * **Packed-bytes segment** — a second byte-budgeted cache
+    (`manager.packed_cache`, keyed (hash, kind=packed)) holding the
+    EXACT on-disk packed bytes an erasure decode reassembles. That
+    dissolves the old byte-deterministic-recompression restriction:
+    shard rebuilds (`resync._rebuild_shard`) and scrub stripe repairs
+    re-encode straight from cached packed bytes — zero shard-gather
+    RPCs on a warm rebuild — and degraded GETs serve from it before
+    gathering. Probes carry a `kinds` list so one RPC checks both
+    segments; the packed segment rides the same zone ring.
+
 What deliberately does NOT route through the tier: SSE-C payloads
-(`cacheable=False` skips lookup, probe and insert end to end — the
-GL03 taint rule audits the `cache_tier_probe`/`cache_tier_insert`
-seam); erasure SHARD rebuilds (the tier holds decoded plaintext, and
-re-deriving exact stripe bytes would require byte-deterministic
-recompression — a rebuilt shard must match its stripe-mates exactly);
-and scrub (its whole job is to touch the disks the cache exists to
-avoid).
+(`cacheable=False` skips lookup, probe, lease and insert end to end —
+the GL03 taint rule audits the `cache_tier_probe`/`cache_tier_insert`
+seam, `probe_full` included); and scrub's VERIFY passes (their whole
+job is to touch the disks the cache exists to avoid — only the repair
+leg, which needs ground-truth packed bytes, rides the tier).
 """
 
 from __future__ import annotations
@@ -95,24 +126,200 @@ PROBE_TIMEOUT_S = 2.0
 # skipped (the next reader warms the owner instead) — a decode burst
 # must not turn into an unbounded RPC fan-out of MiB-scale payloads
 INSERT_INFLIGHT_MAX = 8
+# lease wait default (`[block] cache_lease_wait_ms`): ≈ the observed
+# p95 of a 1 MiB erasure gather+decode on the loopback bench — long
+# enough that the holder's insert usually lands, short enough that a
+# dead holder costs less than the decode the wait tried to save
+LEASE_WAIT_MS_DEFAULT = 250.0
+# a lease the holder never resolves expires after this multiple of the
+# wait bound: waiters have all timed out by then, and the NEXT prober
+# must be able to mint a fresh lease instead of parking forever behind
+# a corpse
+LEASE_TTL_FACTOR = 4.0
+# leases outstanding per owner; beyond this a miss answers plainly (no
+# lease, no wait) — an attacker-spun key space must not grow the table
+LEASE_MAX = 512
+# the lease wait must fit INSIDE the probe's flat timeout with room
+# for the transfer of the woken payload — a wait that consumed the
+# whole RPC budget would turn every wake into a caller-side timeout,
+# stacking the wait on top of the budget instead of inside it
+PROBE_WAIT_MARGIN_S = 0.5
+# hint-driven prefetch: queue bound and per-fetch governor pacing cap
+PREFETCH_QUEUE_MAX = 64
+PREFETCH_INFLIGHT_DEFAULT = 2
+
+
+class _Lease:
+    __slots__ = ("holder", "deadline", "event")
+
+    def __init__(self, holder: bytes, deadline: float):
+        self.holder = holder
+        self.deadline = deadline
+        self.event = asyncio.Event()
+
+
+class ProbeLeaseTable:
+    """Owner-side singleflight ledger: one live lease per missing hash.
+
+    The first prober to miss mints (becoming the holder); concurrent
+    probers park on the lease's event with a bounded wait and re-check
+    the cache on wake. The holder's write-through insert resolves the
+    lease; a holder that dies (SIGKILL, cancel, partition) simply never
+    resolves, waiters time out within their own budget, and the lease
+    is reaped at its TTL so the next prober re-mints.
+
+    Conservation invariant (GARAGE_SANITIZE=1, checked at every loop
+    teardown): no waiter stays parked once the handlers that parked it
+    completed, and every minted lease is accounted resolved, expired,
+    or still live — a leak here means probers parking forever behind a
+    lease nobody can resolve."""
+
+    def __init__(self, wait_ms: float = LEASE_WAIT_MS_DEFAULT):
+        self.wait_ms = float(wait_ms)
+        self._leases: dict[bytes, _Lease] = {}
+        self._waiters = 0
+        self.minted = 0
+        self.resolved = 0
+        self.expired = 0
+        self.waits = 0
+        self.wait_hits = 0
+        self.wait_timeouts = 0
+        from ..utils import sanitizer
+
+        sanitizer.track_conservation(self)  # no-op unless armed
+
+    @property
+    def depth(self) -> int:
+        return len(self._leases)
+
+    @property
+    def ttl_s(self) -> float:
+        return max(0.05, self.wait_ms / 1000.0 * LEASE_TTL_FACTOR)
+
+    def _reap(self, now: float) -> None:
+        for h in [h for h, ls in self._leases.items()
+                  if ls.deadline <= now]:
+            ls = self._leases.pop(h)
+            ls.event.set()  # wake anyone parked behind the corpse
+            self.expired += 1
+            registry().inc("cache_lease_expired")
+
+    def live(self, hash32: bytes) -> bool:
+        self._reap(time.monotonic())
+        return hash32 in self._leases
+
+    def mint(self, hash32: bytes, holder: bytes) -> bool:
+        """True when the caller became the lease holder (no live lease
+        existed and the table had room). Synchronous — no await between
+        the live check and the insert, so concurrent probe handlers on
+        one loop elect exactly one holder."""
+        now = time.monotonic()
+        self._reap(now)
+        if hash32 in self._leases or len(self._leases) >= LEASE_MAX \
+                or self.wait_ms <= 0:
+            return False
+        self._leases[hash32] = _Lease(holder, now + self.ttl_s)
+        self.minted += 1
+        registry().inc("cache_lease_minted")
+        return True
+
+    def resolve(self, hash32: bytes) -> None:
+        """The awaited bytes arrived (owner-side insert): wake every
+        parked prober. No-op without a live lease."""
+        ls = self._leases.pop(hash32, None)
+        if ls is not None:
+            ls.event.set()
+            self.resolved += 1
+            registry().inc("cache_lease_resolved")
+
+    async def wait(self, hash32: bytes, wait_s: float) -> bool:
+        """Park behind the live lease for at most wait_s; -> True when
+        woken by a resolve (the caller re-checks the cache), False on
+        timeout or when no lease is live (mint raced away / already
+        resolved — re-check either way, the cache is the truth)."""
+        ls = self._leases.get(hash32)
+        if ls is None or wait_s <= 0:
+            return False
+        self.waits += 1
+        registry().inc("cache_lease_wait")
+        self._waiters += 1
+        try:
+            await asyncio.wait_for(ls.event.wait(), wait_s)
+            self.wait_hits += 1
+            registry().inc("cache_lease_wait_hit")
+            return True
+        except asyncio.TimeoutError:
+            self.wait_timeouts += 1
+            registry().inc("cache_lease_wait_timeout")
+            self._reap(time.monotonic())
+            return False
+        finally:
+            self._waiters -= 1
+
+    @property
+    def conservation_ok(self) -> bool:
+        self._reap(time.monotonic())
+        return (self._waiters == 0
+                and self.minted == self.resolved + self.expired
+                + len(self._leases))
+
+    def __repr__(self) -> str:
+        return (f"<ProbeLeaseTable depth={len(self._leases)} "
+                f"waiters={self._waiters} minted={self.minted} "
+                f"resolved={self.resolved} expired={self.expired}>")
+
+
+class ProbeResult:
+    """One probe's answer across both segments + the lease verdict."""
+
+    __slots__ = ("plain", "packed", "lease", "timed_out")
+
+    def __init__(self, plain=None, packed=None, lease=False,
+                 timed_out=False):
+        self.plain = plain        # decoded payload (verified) or None
+        self.packed = packed      # exact on-disk packed bytes or None
+        self.lease = lease        # this prober holds the decode lease
+        self.timed_out = timed_out  # parked behind a lease, then lost
 
 
 class ClusterCacheTier:
     """Router + hint book installed on BlockManager (`manager.cache_tier`)
     when `[block] cache_tier` is on and the node has a cluster system."""
 
-    def __init__(self, manager, hint_top_n: int = HINT_TOP_N):
+    def __init__(self, manager, hint_top_n: int = HINT_TOP_N,
+                 lease_wait_ms: float = LEASE_WAIT_MS_DEFAULT,
+                 prefetch_inflight: int = PREFETCH_INFLIGHT_DEFAULT):
         self.manager = manager
         self.enabled = True
         self.hint_top_n = int(hint_top_n)
         # hash -> last-seen time, LRU-ordered (move_to_end on refresh)
         self._hints: "OrderedDict[bytes, float]" = OrderedDict()
         self._insert_inflight = 0
+        # owner-side singleflight leases (`[block] cache_lease_wait_ms`;
+        # 0 disables the wait-mode entirely — probes answer flat misses)
+        self.leases = ProbeLeaseTable(lease_wait_ms)
+        # hint-driven prefetch: bounded FIFO of owned-but-cold hinted
+        # hashes, drained by <= prefetch_inflight background tasks, one
+        # governor-paced sleep per fetch (qos/governor.py writes
+        # prefetch_tranquility the same way it writes resync/scrub
+        # tranquility)
+        self.prefetch_inflight = max(0, int(prefetch_inflight))
+        self.prefetch_tranquility = 0.0
+        self._prefetch_q: "OrderedDict[bytes, None]" = OrderedDict()
+        self._prefetch_running = 0
+        self.prefetched = 0
+        self.prefetch_skips = 0
+        self.prefetch_drops = 0
+        self.prefetch_errors = 0
         self.probes = 0
         self.probe_hits = 0
         self.probe_misses = 0
         self.probe_fails = 0
         self.probe_corrupt = 0
+        self.probe_packed_hits = 0
+        self.lease_grants = 0
+        self.lease_wait_hits = 0
+        self.lease_wait_timeouts = 0
         self.remote_hit_bytes = 0
         self.inserts_pushed = 0
         self.insert_skips = 0
@@ -120,6 +327,23 @@ class ClusterCacheTier:
         self.hints_seen = 0
         self.cross_zone_probes = 0
         self.hints_dropped_cross_zone = 0
+
+    @property
+    def lease_wait_ms(self) -> float:
+        return self.leases.wait_ms
+
+    @lease_wait_ms.setter
+    def lease_wait_ms(self, v: float) -> None:
+        self.leases.wait_ms = float(v)
+
+    def probe_wait_ms(self) -> float:
+        """The wait a prober may ask the owner for: the configured
+        lease wait, clamped INSIDE the flat probe timeout minus the
+        transfer margin — the satellite contract that a blackholed
+        lease holder can never push a GET past the pre-lease worst
+        case (probe timeout + one store read)."""
+        budget = (PROBE_TIMEOUT_S - PROBE_WAIT_MARGIN_S) * 1000.0
+        return max(0.0, min(self.leases.wait_ms, budget))
 
     # ---- ring -----------------------------------------------------------
 
@@ -207,15 +431,53 @@ class ClusterCacheTier:
 
     async def probe(self, owner: bytes, hash32: bytes,
                     cacheable: bool = True) -> Optional[bytes]:
-        """Single-hop read-only probe of the owner's cache; -> decoded
-        payload (content-verified) or None (miss / owner unreachable /
-        failed verification). Never raises: a tier failure must degrade
-        to the local path, not fail the read. `cacheable` is the same
-        GL03 audit flag as the rpc_get_block seam — SSE-C state must
-        pass cacheable=False, which makes the probe a no-op (an SSE-C
-        hash is never even ASKED about across nodes)."""
+        """Single-hop read-only probe of the owner's DECODED cache; ->
+        decoded payload (content-verified) or None (miss / owner
+        unreachable / failed verification). Never raises: a tier
+        failure must degrade to the local path, not fail the read.
+        `cacheable` is the same GL03 audit flag as the rpc_get_block
+        seam — SSE-C state must pass cacheable=False, which makes the
+        probe a no-op (an SSE-C hash is never even ASKED about across
+        nodes). Lease-free and plain-only: the background callers
+        (hint-gated resync) must not park behind a foreground herd's
+        lease; the GET path's full form is probe_full."""
+        res = await self.probe_full(owner, hash32, cacheable=cacheable,
+                                    kinds=("plain",), wait=False)
+        return res.plain
+
+    async def probe_packed(self, owner: bytes,
+                           hash32: bytes) -> Optional[bytes]:
+        """Exact on-disk packed bytes from the owner's packed segment,
+        or None — the rebuild/repair lane (verified by unpack+content
+        check before returning). Lease-free: rebuilds are background
+        work and fall straight back to the shard gather."""
+        res = await self.probe_full(owner, hash32, cacheable=True,
+                                    kinds=("packed",), wait=False)
+        return res.packed
+
+    async def probe_full(self, owner: bytes, hash32: bytes,
+                         cacheable: bool = True,
+                         kinds=("plain",), wait: bool = True
+                         ) -> ProbeResult:
+        """One probe RPC across the owner's requested segments, with
+        the lease protocol engaged when `wait` is True:
+
+          * a hit answers (plain or packed — packed is unpacked and
+            verified here, so .plain is served either way);
+          * a miss with no live lease MINTS one for this prober
+            (.lease=True: the caller MUST decode and write-through,
+            that insert is what wakes the parked herd);
+          * a miss behind a live lease PARKS at the owner for at most
+            probe_wait_ms() — budgeted inside the flat RPC timeout,
+            never stacked — then re-checks; a timeout answers
+            .timed_out=True and the caller falls back to the store
+            WITHOUT pushing (the holder's insert is presumed in
+            flight; N more MiB pushes are the waste leases kill).
+
+        Never raises. `cacheable` is the GL03 audit flag; SSE-C state
+        passes cacheable=False and nothing crosses the wire."""
         if not cacheable:
-            return None
+            return ProbeResult()
         self.probes += 1
         m = self.manager
         my_zone = self._zone_of(m.system.id)
@@ -226,66 +488,106 @@ class ClusterCacheTier:
             # into a WAN round-trip)
             self.cross_zone_probes += 1
             registry().inc("cache_tier_cross_zone_probe")
+        wait_ms = self.probe_wait_ms() if wait else 0.0
         try:
             resp = await m.rpc.call(
                 m.endpoint, owner,
-                {"op": "cache_probe", "hash": hash32},
+                {"op": "cache_probe", "hash": hash32,
+                 "kinds": list(kinds), "wait_ms": wait_ms,
+                 "lease": bool(wait and wait_ms > 0)},
                 PRIO_NORMAL, timeout=PROBE_TIMEOUT_S)
-            data = resp.get("data") if isinstance(resp, dict) else None
+            if not isinstance(resp, dict):
+                resp = {}
+            data = resp.get("data")
         except Exception as e:
             self.probe_fails += 1
             registry().inc("cache_tier_probe_fail")
             log.debug("cache probe of %s at %s failed: %s",
                       hash32[:4].hex(), owner[:4].hex(), e)
-            return None
+            return ProbeResult()
         if data is None:
             self.probe_misses += 1
             registry().inc("cache_tier_probe_miss")
-            return None
-        # end-to-end integrity: a remote payload is served only after
-        # it re-derives the content address (the store read paths all
-        # verify remote bytes; the tier must not be the one lane that
-        # trusts the wire). content_hash_matches tolerates the legacy
-        # algo exactly like DataBlock.verify; off-loop — MiB-scale
-        # hashing must not stall sibling requests.
-        from ..utils.data import content_hash_matches
-
-        if not await asyncio.to_thread(content_hash_matches, data,
-                                       hash32):
+            if resp.get("lease"):
+                self.lease_grants += 1
+                registry().inc("cache_lease_granted")
+                return ProbeResult(lease=True)
+            if resp.get("waited"):
+                self.lease_wait_timeouts += 1
+                return ProbeResult(timed_out=True)
+            return ProbeResult()
+        kind = resp.get("kind", "plain")
+        verified = await asyncio.to_thread(self._verify_probe, data,
+                                           hash32, kind)
+        if verified is None:
             self.probe_corrupt += 1
             registry().inc("cache_tier_probe_corrupt")
             log.warning("cache probe of %s at %s returned corrupt "
-                        "payload; falling back to the store",
-                        hash32[:4].hex(), owner[:4].hex())
-            return None
+                        "%s payload; falling back to the store",
+                        hash32[:4].hex(), owner[:4].hex(), kind)
+            return ProbeResult()
+        if resp.get("waited"):
+            self.lease_wait_hits += 1
         self.probe_hits += 1
+        if kind == "packed":
+            self.probe_packed_hits += 1
+            registry().inc("cache_tier_probe_packed_hit")
         self.remote_hit_bytes += len(data)
         registry().inc("cache_tier_probe_hit")
         registry().inc("cache_tier_remote_hit_bytes", len(data))
-        return data
+        if kind == "packed":
+            return ProbeResult(plain=verified, packed=data)
+        return ProbeResult(plain=data)
 
-    def insert_at(self, owner: bytes, hash32: bytes, data) -> None:
+    @staticmethod
+    def _verify_probe(data, hash32: bytes, kind: str):
+        """End-to-end integrity off-loop: a remote payload is served
+        only after it re-derives the content address (the store read
+        paths all verify remote bytes; the tier must not be the one
+        lane that trusts the wire). -> the decoded plain payload, or
+        None on verification failure. Packed bytes verify through
+        unpack + DataBlock.verify — the content address covers the
+        plain bytes, so the unpack is the verification."""
+        try:
+            if kind == "packed":
+                from .block import DataBlock
+
+                blk = DataBlock.unpack(data)
+                blk.verify(hash32)
+                return blk.plain_bytes()
+            from ..utils.data import content_hash_matches
+
+            return data if content_hash_matches(data, hash32) else None
+        except Exception as e:
+            log.debug("probe payload failed %s verification for %s: %s",
+                      kind, hash32[:4].hex(), e)
+            return None
+
+    def insert_at(self, owner: bytes, hash32: bytes, data,
+                  kind: str = "plain") -> None:
         """Write-through at the owner after a local miss-decode: fire a
         bounded background push so the NEXT reader — on any node —
-        probe-hits instead of re-decoding. Never blocks the caller."""
+        probe-hits instead of re-decoding. Never blocks the caller.
+        kind="packed" targets the owner's packed-bytes segment (exact
+        on-disk bytes; the rebuild/repair lane's currency)."""
         if self._insert_inflight >= INSERT_INFLIGHT_MAX:
             self.insert_skips += 1
             return
         self._insert_inflight += 1
         from ..utils.background import spawn
 
-        spawn(self._push_insert(owner, hash32, data),
+        spawn(self._push_insert(owner, hash32, data, kind),
               "cache-tier-insert")
 
     async def _push_insert(self, owner: bytes, hash32: bytes,
-                           data) -> None:
+                           data, kind: str = "plain") -> None:
         # background lane: a MiB-scale push over a slow link may
         # legitimately outlive the tight foreground probe budget
         m = self.manager
         try:
             await m.endpoint.call(
                 owner, {"op": "cache_insert", "hash": hash32,
-                        "data": data},
+                        "data": data, "kind": kind},
                 PRIO_BACKGROUND, timeout=15.0)
             self.inserts_pushed += 1
             registry().inc("cache_tier_insert_push")
@@ -323,8 +625,82 @@ class ClusterCacheTier:
             self._hints[h] = now
             self._hints.move_to_end(h)
             self.hints_seen += 1
+            # prefetch trigger sits AFTER the zone filter above, so a
+            # cross-zone hint can never reach it — and the fetch itself
+            # is this node's own store path, so nothing is fetched
+            # across a zone boundary either (satellite conformance)
+            self._maybe_prefetch(h)
         while len(self._hints) > HINT_MAX:
             self._hints.popitem(last=False)
+
+    # ---- hint-driven prefetch (ISSUE 18) --------------------------------
+
+    def _maybe_prefetch(self, hash32: bytes) -> None:
+        """A peer says hash32 is hot; if WE own it and don't hold it,
+        queue a background decode so the first herd probe-hits instead
+        of minting a lease. Queue is bounded (drops counted), drained
+        by <= prefetch_inflight tasks, each fetch governor-paced."""
+        if self.prefetch_inflight <= 0 or not self.enabled:
+            return
+        if not self.local_owner(hash32):
+            # local_owner (not owns): a moot ring (lone member, tier
+            # off) "owns" everything but has no herd to pre-warm for —
+            # prefetch only when a real ring routed the hash HERE
+            return
+        if self.manager.cache.contains(hash32) \
+                or hash32 in self._prefetch_q:
+            self.prefetch_skips += 1
+            return
+        if len(self._prefetch_q) >= PREFETCH_QUEUE_MAX:
+            self.prefetch_drops += 1
+            registry().inc("cache_prefetch_drop")
+            return
+        self._prefetch_q[hash32] = None
+        registry().inc("cache_prefetch_queued")
+        self._kick_prefetch()
+
+    def _kick_prefetch(self) -> None:
+        from ..utils.background import spawn
+
+        while self._prefetch_running < self.prefetch_inflight \
+                and self._prefetch_q:
+            # count BEFORE spawn: a second hint arriving before the
+            # drainer's first tick must not over-spawn past the bound
+            self._prefetch_running += 1
+            spawn(self._prefetch_drain(), "cache-tier-prefetch")
+
+    async def _prefetch_drain(self) -> None:
+        m = self.manager
+        try:
+            while self._prefetch_q:
+                h, _ = self._prefetch_q.popitem(last=False)
+                if not self.owns(h) or m.cache.contains(h):
+                    self.prefetch_skips += 1
+                    continue
+                if self.prefetch_tranquility > 0:
+                    # governor pacing: same tranquility discipline as
+                    # resync/scrub — client pressure stretches the
+                    # inter-fetch gap instead of competing for disk
+                    await asyncio.sleep(self.prefetch_tranquility)
+                try:
+                    # route=False: the owner decodes via its OWN store
+                    # path (intra-zone by placement) and the read fill
+                    # lands in this cache because owns(h) is True;
+                    # charge=False: prefetch is the node's own bet, not
+                    # a client read, so it must not count against any
+                    # api quota
+                    data = await m.rpc_get_block(h, route=False,
+                                                 charge=False)
+                    if data is not None:
+                        self.prefetched += 1
+                        registry().inc("cache_prefetch_done")
+                except Exception as e:
+                    self.prefetch_errors += 1
+                    registry().inc("cache_prefetch_error")
+                    log.debug("prefetch of %s failed: %s",
+                              h[:4].hex(), e)
+        finally:
+            self._prefetch_running -= 1
 
     def is_hot(self, hash32: bytes) -> bool:
         """Whether any peer recently advertised hash32 as hot — the
@@ -354,8 +730,29 @@ class ClusterCacheTier:
             "probe_misses": self.probe_misses,
             "probe_fails": self.probe_fails,
             "probe_corrupt": self.probe_corrupt,
+            "probe_packed_hits": self.probe_packed_hits,
             "remote_hit_bytes": self.remote_hit_bytes,
             "inserts_pushed": self.inserts_pushed,
             "insert_skips": self.insert_skips,
             "hints_seen": self.hints_seen,
+            # lease singleflight (ISSUE 18)
+            "lease_wait_ms": self.leases.wait_ms,
+            "lease_depth": self.leases.depth,
+            "lease_minted": self.leases.minted,
+            "lease_resolved": self.leases.resolved,
+            "lease_expired": self.leases.expired,
+            "lease_waits": self.leases.waits,
+            "lease_wait_hits_local": self.leases.wait_hits,
+            "lease_wait_timeouts_local": self.leases.wait_timeouts,
+            "lease_grants": self.lease_grants,
+            "lease_wait_hits": self.lease_wait_hits,
+            "lease_wait_timeouts": self.lease_wait_timeouts,
+            # hint-driven prefetch (ISSUE 18)
+            "prefetch_inflight_max": self.prefetch_inflight,
+            "prefetch_queue": len(self._prefetch_q),
+            "prefetch_running": self._prefetch_running,
+            "prefetched": self.prefetched,
+            "prefetch_skips": self.prefetch_skips,
+            "prefetch_drops": self.prefetch_drops,
+            "prefetch_errors": self.prefetch_errors,
         }

@@ -189,7 +189,10 @@ class BlockManager:
                  read_cache_max_bytes: Optional[int] = None,
                  resync_breaker_aware: bool = True,
                  cache_tier: bool = True,
-                 cache_tier_hint_top_n: int = 16):
+                 cache_tier_hint_top_n: int = 16,
+                 cache_lease_wait_ms: float = 250.0,
+                 cache_prefetch_inflight: int = 2,
+                 cache_packed_max_bytes: Optional[int] = None):
         self.system = system
         self.db = db
         self.data_layout = data_layout
@@ -227,6 +230,25 @@ class BlockManager:
         if read_cache_max_bytes is None:
             read_cache_max_bytes = ram_buffer_max // 4
         self.cache = BlockCache(read_cache_max_bytes)
+        # packed-bytes tier segment (ISSUE 18): the EXACT on-disk packed
+        # bytes an erasure decode reassembles, keyed by the same content
+        # hash. Shard rebuilds and scrub stripe repairs re-encode from
+        # it (deterministic RS encode -> byte-identical shards, the
+        # _repair_stripe precedent), skipping the k-shard gather; a
+        # degraded GET serves from it before gathering. Erasure-only: in
+        # replicate mode the packed form is just scheme byte + payload
+        # and the plain cache already covers it. `[block]
+        # cache_packed_max_bytes` (0 = off), default ram_buffer_max/8.
+        if cache_packed_max_bytes is None:
+            cache_packed_max_bytes = ram_buffer_max // 8
+        self.packed_cache = BlockCache(
+            cache_packed_max_bytes if self.erasure else 0)
+        # node-local read singleflight (ISSUE 18): one store gather+
+        # decode per hash per process, concurrent readers collapse onto
+        # the leader's future (the node-local leg of the lease story)
+        self._sf: dict[bytes, asyncio.Future] = {}
+        self.sf_leaders = 0
+        self.sf_collapsed = 0
         # optional async hook (Garage wires qos.shape_bytes): every
         # foreground block read — hit or miss — charges the qos bytes
         # budget, so GET/copy traffic is paced evenly whether it is
@@ -254,7 +276,9 @@ class BlockManager:
             from .cache_tier import ClusterCacheTier
 
             self.cache_tier = ClusterCacheTier(
-                self, hint_top_n=cache_tier_hint_top_n)
+                self, hint_top_n=cache_tier_hint_top_n,
+                lease_wait_ms=cache_lease_wait_ms,
+                prefetch_inflight=cache_prefetch_inflight)
             # hot-hash hints ride the existing peering pings: the
             # peering layer stays block-agnostic (plain callables), the
             # tier decides what is hot and what a hint means
@@ -550,6 +574,7 @@ class BlockManager:
         fill = cacheable
         tier = None
         tier_owner = None
+        push_owner = True
         if cacheable:
             data = self.cache.get(hash32)
             if data is not None:
@@ -589,17 +614,32 @@ class BlockManager:
             # — a hit is the whole point of the tier (zero gathers,
             # zero decodes anywhere); a miss or open-breaker owner
             # falls through to today's local path, and the decoded
-            # result warms the owner below. SSE-C never reaches this
-            # probe: cacheable=False skips the enclosing branch.
+            # result warms the owner below. The probe carries the
+            # lease protocol (ISSUE 18): a cold herd's first prober is
+            # granted the decode lease, the rest park at the owner
+            # INSIDE the probe's flat timeout and are woken by the
+            # holder's insert — a flash crowd pays ~1 decode per
+            # block cluster-wide, not 1 per node. SSE-C never reaches
+            # this probe: cacheable=False skips the enclosing branch.
             if tier is not None:
                 tier_owner = tier.owner_of(hash32)
                 if tier_owner is not None:
-                    data = await tier.probe(tier_owner, hash32,
-                                            cacheable=cacheable)
-                    if data is not None:
+                    kinds = ("plain", "packed") if self.erasure \
+                        else ("plain",)
+                    res = await tier.probe_full(tier_owner, hash32,
+                                                cacheable=cacheable,
+                                                kinds=kinds)
+                    if res.plain is not None:
                         if charge_fn is not None:
-                            await charge_fn(len(data))
-                        return data
+                            await charge_fn(len(res.plain))
+                        return res.plain
+                    if res.timed_out:
+                        # parked behind the lease and lost: the
+                        # holder's MiB-scale insert push is presumed in
+                        # flight — do NOT pile this node's own push on
+                        # top (N redundant pushes are exactly the
+                        # amplification leases exist to kill)
+                        push_owner = False
                     if self.cache_router is None:
                         # storage node: one decoded copy per CLUSTER —
                         # the owner gets the write-through, this node
@@ -609,15 +649,61 @@ class BlockManager:
                         # every hot forward would re-probe the storage
                         # owner over loopback.
                         fill = False
-        data = await self._get_uncached(hash32)
+                elif tier.leases.live(hash32):
+                    # THIS node is the hash's cache owner and a remote
+                    # prober currently holds the decode lease: park
+                    # behind it like a remote waiter would, then
+                    # re-check — the holder's insert usually lands
+                    # first and this read never touches the store
+                    await tier.leases.wait(
+                        hash32, tier.probe_wait_ms() / 1000.0)
+                    data = self.cache.get(hash32)
+                    if data is not None:
+                        if charge_fn is not None:
+                            await charge_fn(len(data))
+                        return data
+                if tier_owner is None and tier.enabled \
+                        and self.cache.max_bytes > 0:
+                    # owner-side SELF-lease: this node is about to pay
+                    # the herd's decode, so a remote prober arriving
+                    # while it is in flight must PARK behind this lease
+                    # instead of being granted a second one — without
+                    # it a herd that includes the owner pays two
+                    # decodes per block, not one. No-op when a lease
+                    # is already live or the wait-mode is off; the fill
+                    # below resolves it (the error path resolves too).
+                    tier.leases.mint(hash32, self.system.id)
+        if cacheable:
+            # node-local singleflight: concurrent readers of one hash
+            # collapse onto a single gather+decode (SSE-C stays on the
+            # direct path — its payloads must not transit a shared
+            # future other requests can await)
+            try:
+                data = await self._read_store(hash32)
+            except BaseException:
+                if tier is not None and tier_owner is None:
+                    # a failed owner read must not leave probers parked
+                    # out their full wait behind a lease nobody will
+                    # resolve — wake them now; they re-check the cache
+                    # (the truth) and fall back to their own stores
+                    tier.leases.resolve(hash32)
+                raise
+        else:
+            data = await self._get_uncached(hash32)
         if fill:
-            # lint: ignore[GL03] guarded by the cacheable= audit flag: fill is only ever True inside the cacheable branch, and SSE-C callers pass cacheable=False (pinned by conformance tests)
+            # fill is only ever True inside the cacheable branch; SSE-C
+            # callers pass cacheable=False (pinned by conformance tests)
             self.cache.insert(hash32, data)
-        if tier_owner is not None:
+        if cacheable and tier is not None and tier_owner is None:
+            # owner-side fill: wake every prober parked on this hash
+            # (no-op without a live lease)
+            tier.leases.resolve(hash32)
+        if tier_owner is not None and push_owner:
             # write-through at the owner (bounded background push): the
             # next reader of this block — on any node — probe-hits
-            # instead of paying another gather+decode
-            # lint: ignore[GL03] guarded by the cacheable= audit flag: tier_owner is only resolved inside the cacheable branch, so SSE-C payloads never reach the tier push
+            # instead of paying another gather+decode. tier_owner is
+            # only resolved inside the cacheable branch, so SSE-C
+            # payloads never reach the tier push
             tier.insert_at(tier_owner, hash32, data)
         if charge_fn is not None:
             # charged symmetrically with the hit path above: a byte
@@ -626,12 +712,62 @@ class BlockManager:
             await charge_fn(len(data))
         return data
 
-    async def _get_uncached(self, hash32: bytes) -> bytes:
+    async def _read_store(self, hash32: bytes) -> bytes:
+        """Node-local read singleflight (ISSUE 18): the first caller of
+        a hash becomes the LEADER and pays the store gather+decode;
+        every concurrent caller awaits the leader's future instead of
+        decoding the same bytes again. A leader that fails or is
+        cancelled releases the hash — one surviving waiter retries (and
+        becomes the new leader), so collapse can never lose a read that
+        would have succeeded solo. Cacheable reads only: SSE-C stays on
+        the direct _get_uncached path."""
+        fut = self._sf.get(hash32)
+        if fut is not None:
+            self.sf_collapsed += 1
+            registry().inc("cache_sf_collapsed")
+            try:
+                # shield: one waiter's client disconnecting must not
+                # cancel the leader's decode out from under the rest
+                return await asyncio.shield(fut)
+            except asyncio.CancelledError:
+                if not fut.cancelled():
+                    raise  # THIS caller was cancelled, not the leader
+            except Exception as e:
+                # leader failed; retry below, possibly as the new leader
+                log.debug("read singleflight leader for %s failed: %s",
+                          hash32[:4].hex(), e)
+            return await self._read_store(hash32)
+        fut = asyncio.get_running_loop().create_future()
+        self._sf[hash32] = fut
+        self.sf_leaders += 1
+        registry().inc("cache_sf_leader")
+        try:
+            data = await self._get_uncached(hash32, fill_packed=True)
+        except BaseException as e:
+            if isinstance(e, asyncio.CancelledError):
+                fut.cancel()
+            else:
+                fut.set_exception(e)
+                fut.exception()  # consumed: no orphan-future warning
+            raise
+        else:
+            fut.set_result(data)
+            return data
+        finally:
+            self._sf.pop(hash32, None)
+
+    async def _get_uncached(self, hash32: bytes,
+                            fill_packed: bool = False) -> bytes:
         self.metrics["store_reads"] += 1
         if self.erasure:
             # verification happens inside: a decode is retried against
-            # every distinct packed_len candidate before giving up
-            return await self._get_erasure(hash32)
+            # every distinct packed_len candidate before giving up.
+            # fill_packed (cacheable reads only — _read_store sets it,
+            # the direct SSE-C path never does) admits the reassembled
+            # packed bytes into the packed tier segment for the
+            # rebuild/repair lane.
+            return await self._get_erasure(hash32,
+                                           fill_packed=fill_packed)
         packed, verified = await self._get_replicate(hash32)
 
         def unpack_verify() -> bytes:
@@ -734,7 +870,8 @@ class BlockManager:
         if mine.zone != theirs.zone:
             registry().inc("block_cross_zone_read_bytes", nbytes)
 
-    async def _get_erasure(self, hash32: bytes) -> bytes:
+    async def _get_erasure(self, hash32: bytes,
+                           fill_packed: bool = False) -> bytes:
         """Gather k shards, decode, verify against the content address.
 
         The shard header's packed_len field sits outside the shard
@@ -742,7 +879,30 @@ class BlockManager:
         TIE (e.g. k=2 with one rotted header). On verify failure every
         other distinct candidate is decoded and checked before moving
         on: a recoverable block must never be reported corrupt because
-        the wrong tiebreak was picked (ADVICE r5)."""
+        the wrong tiebreak was picked (ADVICE r5).
+
+        A local packed-tier hit (ISSUE 18) short-circuits the whole
+        gather: the cached bytes ARE the reassembled packed block
+        (content-verified at admission), so only the unpack+verify
+        remains."""
+        if fill_packed:
+            cached = self.packed_cache.get(hash32)
+            if cached is not None:
+                registry().inc("cache_packed_local_hit")
+
+                def unpack_cached() -> bytes:
+                    blk = DataBlock.unpack(cached)
+                    blk.verify(hash32)
+                    return blk.plain_bytes()
+
+                try:
+                    if len(cached) >= 64 * 1024:
+                        return await asyncio.to_thread(unpack_cached)
+                    return unpack_cached()
+                except CorruptData:
+                    # can't happen for an admission-verified entry, but
+                    # a cache must never be the lane that serves rot
+                    self.packed_cache.discard(hash32)
         helper = self.system.layout_helper
         versions = list(reversed(
             helper.history.versions + helper.history.old_versions
@@ -773,8 +933,16 @@ class BlockManager:
                     # MiB-scale decompress+verify off the event loop,
                     # same rule as the replicate read path
                     if len(packed) >= 64 * 1024:
-                        return await asyncio.to_thread(unpack_verify)
-                    return unpack_verify()
+                        plain = await asyncio.to_thread(unpack_verify)
+                    else:
+                        plain = unpack_verify()
+                    if fill_packed:
+                        # the decode just proved these ARE the packed
+                        # bytes behind the content address: admit them
+                        # into the packed tier segment so the next
+                        # rebuild/degraded read skips the gather
+                        self._packed_fill(hash32, packed)
+                    return plain
                 except (CorruptData, ValueError, IndexError):
                     # a forged/rotted length can make the decode itself
                     # blow up, not just the content check — either way
@@ -785,6 +953,48 @@ class BlockManager:
         if gathered_any:
             raise CorruptData(hash32)
         raise MissingBlock(hash32)
+
+    def _packed_fill(self, hash32: bytes, packed) -> None:
+        """Admit freshly decoded+verified packed bytes into the packed
+        tier segment: locally when this node is the hash's ring owner
+        (or routing is moot), else a bounded background push to the
+        owner — same one-copy-per-ring discipline as the plain segment.
+        Only reachable from fill_packed=True paths, which only cacheable
+        reads set (the SSE-C audit boundary)."""
+        pc = getattr(self, "packed_cache", None)
+        if pc is None:
+            return
+        tier = getattr(self, "cache_tier", None)
+        owner = tier.owner_of(hash32) if tier is not None else None
+        if owner is not None:
+            # fill_packed is only set by _read_store, which SSE-C reads
+            # (cacheable=False) never enter
+            tier.insert_at(owner, hash32, bytes(packed), kind="packed")
+        elif pc.max_bytes > 0:
+            pc.insert(hash32, bytes(packed))
+            registry().inc("cache_packed_insert_local")
+
+    async def packed_from_tier(self, hash32: bytes) -> Optional[bytes]:
+        """Exact on-disk packed block bytes from the packed tier
+        segment, or None — the rebuild/repair lane (resync's
+        _rebuild_shard, repair's _repair_stripe). Local segment first;
+        a REMOTE probe is hint-gated like resync's plain-tier fetches,
+        so a rebalance wave over a million cold blocks never sprays a
+        million wasted probes. Returned bytes were content-verified at
+        admission (and re-verified by probe_packed for the remote
+        case)."""
+        pc = getattr(self, "packed_cache", None)
+        packed = pc.get(hash32) if pc is not None else None
+        if packed is not None:
+            registry().inc("cache_packed_local_hit")
+            return packed
+        tier = getattr(self, "cache_tier", None)
+        if tier is None or not tier.is_hot(hash32):
+            return None
+        owner = tier.owner_of(hash32)
+        if owner is None:
+            return None
+        return await tier.probe_packed(owner, hash32)
 
     async def _decode_parts(self, parts: dict[int, bytes],
                             packed_len: int) -> bytes:
@@ -920,6 +1130,9 @@ class BlockManager:
                 cache = getattr(self, "cache", None)
                 if cache is not None:
                     cache.discard(hash32)
+                pc = getattr(self, "packed_cache", None)
+                if pc is not None:
+                    pc.discard(hash32)
                 self.resync.push_at(hash32, time.time() + self.rc.gc_delay)
 
             tx.on_commit(on_unreferenced)
@@ -1100,6 +1313,9 @@ class BlockManager:
         cache = getattr(self, "cache", None)
         if cache is not None:
             cache.discard(hash32)
+        pc = getattr(self, "packed_cache", None)
+        if pc is not None:
+            pc.discard(hash32)
         for d in self.data_layout.candidate_dirs(hash32):
             if not os.path.isdir(d):
                 continue
@@ -1274,23 +1490,69 @@ class BlockManager:
             # RAM-only — a miss answers None and NEVER falls through to
             # the store (the prober's local path is the fallback, so a
             # probe can't chain or amplify). Hedge-safe by construction:
-            # re-asking an idempotent RAM lookup is free.
-            cache = getattr(self, "cache", None)
-            data = cache.get(h) if cache is not None else None
+            # re-asking an idempotent RAM lookup is free (a re-asked
+            # lease grant re-mints or re-parks, both idempotent too).
+            # ISSUE 18: `kinds` selects the segments (plain/packed);
+            # `wait_ms`+`lease` engage the singleflight protocol — a
+            # miss behind a live lease PARKS here (inside the caller's
+            # flat probe timeout, clamped again server-side), a bare
+            # miss with lease=True mints one for the caller.
+            kinds = payload.get("kinds") or ("plain",)
+            data, kind = self._tier_lookup(h, kinds)
+            tier = getattr(self, "cache_tier", None)
+            if data is None and tier is not None and "plain" in kinds:
+                wait_ms = min(float(payload.get("wait_ms") or 0.0),
+                              tier.probe_wait_ms())
+                if wait_ms > 0 and tier.leases.live(h):
+                    await tier.leases.wait(h, wait_ms / 1000.0)
+                    data, kind = self._tier_lookup(h, kinds)
+                    if data is None:
+                        registry().inc("cache_tier_serve_miss")
+                        return {"data": None, "waited": True}
+                    registry().inc("cache_tier_serve_hit")
+                    return {"data": data, "kind": kind,
+                            "waited": True}
+                if wait_ms > 0 and payload.get("lease") \
+                        and self.cache.max_bytes > 0 \
+                        and tier.leases.mint(h, from_node):
+                    registry().inc("cache_tier_serve_miss")
+                    return {"data": None, "lease": True}
             if data is not None:
                 registry().inc("cache_tier_serve_hit")
             else:
                 registry().inc("cache_tier_serve_miss")
-            return {"data": data}
+            return {"data": data, "kind": kind}
         if op == "cache_insert":
             # write-through from a non-owner's miss-decode. Content-
             # verified before admission: a content-addressed cache must
             # never hold bytes that don't hash to their key, or every
             # future probe hit serves corruption with a straight face.
+            data = payload["data"]
+            if payload.get("kind", "plain") == "packed":
+                # packed segment (ISSUE 18): verification = unpack +
+                # content verify — the address covers the plain bytes,
+                # so a successful unpack-verify proves the packed image
+                pc = getattr(self, "packed_cache", None)
+                if pc is None or pc.max_bytes <= 0:
+                    return {"ok": False}
+
+                def check_packed() -> None:
+                    DataBlock.unpack(data).verify(h)
+
+                try:
+                    await asyncio.to_thread(check_packed)
+                except Exception:
+                    registry().inc("cache_tier_insert_corrupt")
+                    log.warning("packed tier insert of %s from %s "
+                                "failed verification; dropped",
+                                h[:4].hex(), from_node[:4].hex())
+                    return {"ok": False}
+                pc.insert(h, data)
+                registry().inc("cache_tier_insert_served")
+                return {"ok": True}
             cache = getattr(self, "cache", None)
             if cache is None or cache.max_bytes <= 0:
                 return {"ok": False}
-            data = payload["data"]
             from ..utils.data import content_hash_matches
 
             if not await asyncio.to_thread(content_hash_matches,
@@ -1301,6 +1563,27 @@ class BlockManager:
                             from_node[:4].hex())
                 return {"ok": False}
             cache.insert(h, data)
+            tier = getattr(self, "cache_tier", None)
+            if tier is not None:
+                # the lease holder's bytes just landed: wake every
+                # prober parked on this hash (no-op without a lease)
+                tier.leases.resolve(h)
             registry().inc("cache_tier_insert_served")
             return {"ok": True}
         raise RpcError(f"unknown block op {op!r}")
+
+    def _tier_lookup(self, h: bytes, kinds):
+        """RAM-only lookup across the requested tier segments, plain
+        preferred (a GET wants the decoded payload; packed costs the
+        prober an unpack). -> (data, kind) or (None, None)."""
+        if "plain" in kinds:
+            cache = getattr(self, "cache", None)
+            data = cache.get(h) if cache is not None else None
+            if data is not None:
+                return data, "plain"
+        if "packed" in kinds:
+            pc = getattr(self, "packed_cache", None)
+            data = pc.get(h) if pc is not None else None
+            if data is not None:
+                return data, "packed"
+        return None, None

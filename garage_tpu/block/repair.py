@@ -23,6 +23,7 @@ import time
 from ..utils import migrate
 from .block import BLOCK_SUFFIXES, comp_of_path
 from ..utils.background import Throttled, Worker, WorkerInfo, WState
+from ..utils.metrics import registry
 from ..utils.persister import Persister
 
 log = logging.getLogger("garage_tpu.block.repair")
@@ -93,6 +94,11 @@ class ScrubWorker(Worker):
         self.deep_checked = 0  # stripes parity-checked as leader
         self.deep_repaired = 0  # flagged stripes fully repaired
         self.header_repaired = 0  # shards rewritten for header rot
+        # packed-tier ride (ISSUE 18): repair-leg lookups into the
+        # packed segment and the hits that skipped stripe localization
+        # (bench derives scrub_cache_hit_rate = hits / lookups)
+        self.scrub_cache_lookups = 0
+        self.scrub_cache_hits = 0
 
     def _due(self) -> bool:
         return (time.time() - self.state.last_completed
@@ -373,12 +379,15 @@ class ScrubWorker(Worker):
         """Find + fix the corrupt shard(s) of a parity-inconsistent
         stripe. Ground truth is the block's content address: a decode
         from a candidate k-subset is right iff the unpacked block
-        hashes to hash32. Tries the all-systematic subset, then each
-        single-data-shard exclusion (covers any single corrupt shard,
-        the overwhelmingly likely case); the corrected stripe is
-        re-encoded and every differing shard pushed to its holder
-        through the normal shard-put path (validate + tmp/rename
-        replace)."""
+        hashes to hash32. Tries the packed-bytes tier first (ISSUE 18
+        — the cached image IS the stripe's source, re-verified here
+        because scrub trusts nothing), then the all-systematic subset,
+        then each single-data-shard exclusion (covers any single
+        corrupt shard, the overwhelmingly likely case); the corrected
+        stripe is re-encoded and every differing shard pushed to its
+        holder through the normal shard-put path (validate +
+        tmp/rename replace). Only this REPAIR leg rides the cache —
+        the detect pass keeps touching the disks it exists to check."""
         from ..net.message import PRIO_BACKGROUND
         from .block import DataBlock
         from .manager import unpack_shard
@@ -429,11 +438,30 @@ class ScrubWorker(Worker):
             for drop in range(k):
                 candidates.append(tuple(i for i in range(k) if i != drop)
                                   + (p,))
+        def verify_cached(packed) -> bytes | None:
+            try:
+                blk = DataBlock.unpack(packed)
+                blk.verify(hash32)
+                return bytes(packed)
+            except Exception as e:
+                log.debug("scrub cached packed bytes for %s failed "
+                          "re-verification: %s", hash32[:4].hex(), e)
+                return None
+
         good_packed = None
+        self.scrub_cache_lookups += 1
+        cached = await m.packed_from_tier(hash32)
+        if cached is not None:
+            # scrub paranoia: re-verify even admission-checked bytes —
+            # the repair leg is about to OVERWRITE shards with them
+            good_packed = await asyncio.to_thread(verify_cached, cached)
+            if good_packed is not None:
+                self.scrub_cache_hits += 1
+                registry().inc("cache_packed_scrub_hit")
         for idx in candidates:
-            good_packed = await asyncio.to_thread(try_subset, idx)
             if good_packed is not None:
                 break
+            good_packed = await asyncio.to_thread(try_subset, idx)
         if good_packed is None:
             # >1 corrupt shard (or corrupt beyond what single-exclusion
             # finds): leave the files for operator repair; the count is

@@ -54,6 +54,9 @@ class BlockResyncManager:
         # `[block] resync_breaker_aware`: skip open-breaker peers when
         # placing rebalance pushes/fetches
         self.breaker_aware = breaker_aware
+        # shard rebuilds served from the packed-bytes tier segment
+        # (ISSUE 18): each one skipped a whole k-shard gather
+        self.rebuild_tier_hits = 0
         # error backoff base — tests/benches shrink it so chaos-induced
         # failures retry within the harness window instead of in a
         # minute
@@ -522,9 +525,11 @@ class BlockResyncManager:
         bytes is a valid replica — the content address covers the
         plain bytes). Cold blocks never probe: a rebalance enumeration
         of the whole store must not spray one wasted RPC per block.
-        Erasure SHARD fetches never ride the tier — a decoded payload
-        cannot reproduce the exact stripe bytes its shard-mates were
-        cut from without byte-deterministic recompression."""
+        Erasure SHARD fetches ride the PACKED tier (ISSUE 18) via
+        _rebuild_shard: the cached bytes are the exact packed block the
+        stripe was cut from, so the deterministic re-encode reproduces
+        byte-identical shards — the old recompression restriction only
+        applied to the DECODED segment."""
         m = self.manager
         if not m.erasure:
             if await self._fetch_via_tier(hash32):
@@ -647,8 +652,27 @@ class BlockResyncManager:
         the feeder's batched `repair` op — concurrent resync workers'
         rebuilds (a repair/rebalance wave) coalesce into one
         pattern-as-data device launch instead of one host matmul per
-        stripe on the event loop."""
+        stripe on the event loop.
+
+        Packed-tier fast path (ISSUE 18): when the packed block bytes
+        are in the tier (local segment, or a hint-gated owner probe),
+        the deterministic RS encode regenerates ALL shards byte-
+        identically from them — zero gather RPCs, zero repair matmul.
+        encode_put's framing is pack_shard(crc32c) like the original
+        PUT, so the rebuilt file is the file that was lost."""
         m = self.manager
+        packed = await m.packed_from_tier(hash32)
+        if packed is not None:
+            try:
+                framed = await m.feeder.encode_put(bytes(packed))
+                if idx < len(framed):
+                    self.rebuild_tier_hits += 1
+                    registry().inc("cache_packed_rebuild_hit")
+                    return bytes(framed[idx])
+            except Exception as e:
+                log.debug("packed-tier rebuild of %s.s%d failed: %s "
+                          "(falling back to gather)",
+                          hash32[:4].hex(), idx, e)
         placement = shard_nodes_of(m.system.layout_helper.current(),
                                    hash32, m.codec.width)
         got = await m._gather_parts(hash32, placement, m.codec.read_need)

@@ -141,6 +141,9 @@ class Garage:
             resync_breaker_aware=config.block_resync_breaker_aware,
             cache_tier=config.block_cache_tier,
             cache_tier_hint_top_n=config.block_cache_tier_hint_top_n,
+            cache_lease_wait_ms=config.block_cache_lease_wait_ms,
+            cache_prefetch_inflight=config.block_cache_prefetch_inflight,
+            cache_packed_max_bytes=config.block_cache_packed_max_bytes,
         )
 
         # ---- tables (ref: garage.rs:178-248) ---------------------------

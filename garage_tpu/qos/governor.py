@@ -81,6 +81,11 @@ class GovernorWorker(Worker):
     # pressure 1.0 (db/lsm.py LsmMaintenanceWorker). A merge step is a
     # burst of disk+CPU, so it yields harder than per-item work does.
     LSM_COMPACT_TRANQ_MAX = 5.0
+    # cache-tier hint prefetch pacing (block/cache_tier.py, ISSUE 18):
+    # seconds of sleep before each background prefetch decode at
+    # pressure 1.0 — a prefetch is a speculative gather+decode, so it
+    # yields to foreground latency like resync does
+    PREFETCH_TRANQ_MAX = 2.0
 
     def __init__(self, garage, interval: float = 2.0,
                  target_latency: float = 0.05,
@@ -206,6 +211,12 @@ class GovernorWorker(Worker):
         lm = getattr(self.garage, "lsm_maintenance", None)
         if lm is not None:
             lm.tranquility = u * self.LSM_COMPACT_TRANQ_MAX
+        # cache-tier hint prefetch yields like resync: speculative
+        # decodes must never compete with the foreground reads they
+        # exist to speed up
+        tier = getattr(bm, "cache_tier", None)
+        if tier is not None:
+            tier.prefetch_tranquility = u * self.PREFETCH_TRANQ_MAX
         self.adjustments += 1
         registry().inc("qos_governor_pressure", self.pressure)
 

@@ -212,6 +212,24 @@ class Config:
     # peering ping (hot-hash hints; background resync reads probe the
     # tier only for hinted-hot blocks)
     block_cache_tier_hint_top_n: int = 16
+    # [block] cache_lease_wait_ms: probe singleflight lease wait
+    # (ISSUE 18, README "Cluster cache tier"). A probe that misses at
+    # the owner behind a live lease parks up to this long — budgeted
+    # INSIDE the flat probe timeout — for the lease holder's decode to
+    # land; default ≈ the observed p95 of a 1 MiB erasure gather+decode.
+    # 0 disables leases entirely (probes answer flat misses, the
+    # pre-lease race returns).
+    block_cache_lease_wait_ms: float = 250.0
+    # [block] cache_prefetch_inflight: concurrent hint-driven prefetch
+    # decodes at a cache owner (bounded queue, qos-governor-paced);
+    # 0 disables prefetch
+    block_cache_prefetch_inflight: int = 2
+    # [block] cache_packed_max_bytes: byte budget of the packed-bytes
+    # tier segment (exact on-disk packed block images; shard rebuilds
+    # and scrub stripe repairs re-encode from it with zero gather
+    # RPCs). None = block_ram_buffer_max // 8; 0 disables. Erasure
+    # mode only — replicate stores hold no stripes to rebuild.
+    block_cache_packed_max_bytes: Optional[int] = None
     compression_level: Optional[int] = 1  # zstd level; None disables
     replication_factor: int = 1
     consistency_mode: str = "consistent"  # consistent|degraded|dangerous
@@ -486,13 +504,15 @@ def config_from_dict(raw: dict) -> Config:
                         break
                 if attr:
                     if attr in ("block_size", "block_ram_buffer_max",
-                                "block_read_cache_max_bytes") \
+                                "block_read_cache_max_bytes",
+                                "block_cache_packed_max_bytes") \
                             and isinstance(v2, str):
                         v2 = parse_capacity(v2)
                     setattr(cfg, attr, v2)
         elif key in simple_fields:
             if key in ("block_size", "block_ram_buffer_max",
-                       "block_read_cache_max_bytes") \
+                       "block_read_cache_max_bytes",
+                       "block_cache_packed_max_bytes") \
                     and isinstance(val, str):
                 val = parse_capacity(val)
             setattr(cfg, key, val)

@@ -75,16 +75,22 @@ say "gateway smoke: 2-worker kill/respawn drill + bench_gateway --workers $GATEW
     -k "end_to_end or kill_respawn"
 "$PY" bench.py bench_gateway --workers "$GATEWAY_WORKERS" --nobj 8
 
-# cluster cache tier smoke (ISSUE 15): the kill-the-owner drill (zero
-# failed GETs, ring remap, bounded decodes) plus bench_cache_tier —
-# cluster hot-GET GB/s, cluster-wide decode dedup vs the node-local
-# baseline, hint-gossip convergence and shm-vs-socket forward latency
-# land in the nightly trajectory. TIER_BLOCKS overridable.
+# cluster cache tier smoke (ISSUE 15 + 18): the kill-the-owner drill
+# (zero failed GETs, ring remap, bounded decodes) and the flash-crowd
+# drills — the fast Zipf amplification bound plus the slow
+# kill-the-lease-holder soak under randomized absorbable chaos (seeded
+# for replay like the soak iterations above) — plus bench_cache_tier:
+# cluster hot-GET GB/s, decode dedup vs the node-local baseline,
+# flash-crowd decode amplification with leases on/off, the packed-tier
+# scrub_cache_hit_rate, hint-gossip convergence and shm-vs-socket
+# forward latency land in the nightly trajectory. TIER_BLOCKS
+# overridable.
 TIER_BLOCKS="${TIER_BLOCKS:-16}"
-say "cache tier smoke: kill-owner drill + bench_cache_tier --nblocks $TIER_BLOCKS"
-JAX_PLATFORMS=cpu GARAGE_TPU_DEVICE=off "$PY" -m pytest \
+SEED=$(( (RANDOM << 15) ^ RANDOM ^ $$ + 2000 ))
+say "cache tier smoke: kill-owner + flash-crowd drills seed=$SEED (replay: CHAOS_SOAK_SEED=$SEED pytest tests/test_cache_tier.py -k flash_crowd -s) + bench_cache_tier --nblocks $TIER_BLOCKS"
+CHAOS_SOAK_SEED=$SEED JAX_PLATFORMS=cpu GARAGE_TPU_DEVICE=off "$PY" -m pytest \
     tests/test_cache_tier.py -q -p no:cacheprovider \
-    -k "kill_owner or probe_hit or hints_gossip"
+    -k "kill_owner or probe_hit or hints_gossip or flash_crowd"
 JAX_PLATFORMS=cpu GARAGE_TPU_DEVICE=off "$PY" bench.py bench_cache_tier \
     --nblocks "$TIER_BLOCKS"
 

@@ -1,13 +1,24 @@
-"""ISSUE 15: cluster-wide read cache tier.
+"""ISSUE 15 + ISSUE 18: cluster-wide read cache tier.
 
-Covers the tentpole end to end: rendezvous owner routing with breaker
-filtering (a degraded owner drops OUT of the ring), the single-hop
-`rpc_cache_probe` (hit = zero decodes anywhere; miss = local fallback
-+ write-through at the owner), SSE-C never probed or pushed cross-node,
-hot-hash hint gossip over peering pings, hint-gated resync fetches, the
-clusterbox kill-the-owner drill (zero failed GETs, ring remaps, decode
-count bounded), the shm forward ring's safety protocol, and the GL03
-fixtures for the new cross-node seam.
+Covers the ISSUE 15 tentpole end to end: rendezvous owner routing with
+breaker filtering (a degraded owner drops OUT of the ring), the
+single-hop `rpc_cache_probe` (hit = zero decodes anywhere; miss = local
+fallback + write-through at the owner), SSE-C never probed or pushed
+cross-node, hot-hash hint gossip over peering pings, hint-gated resync
+fetches, the clusterbox kill-the-owner drill (zero failed GETs, ring
+remaps, decode count bounded), the shm forward ring's safety protocol,
+and the GL03 fixtures for the cross-node seam.
+
+ISSUE 18 (cold-herd engineering) adds: the owner-side probe
+singleflight lease ledger (conservation under holder death and waiter
+cancellation), the wait-inside-the-flat-probe-budget contract (unit
+clamp + a chaos rpc_hang pin + a dead-holder fallback), the cold-herd
+and flash-crowd decode-amplification bounds (O(blocks), not
+O(blocks x nodes) — including a slow kill-the-lease-holder soak under
+randomized chaos), the node-local `_read_store` singleflight, the
+packed-bytes segment (byte-identity vs the on-disk shard files,
+zero-gather warm rebuilds, scrub repair riding the cache), and
+hint-driven owner prefetch.
 """
 
 import asyncio
@@ -17,6 +28,7 @@ import time
 
 import pytest
 
+from garage_tpu.block.manager import pack_shard, unpack_shard
 from garage_tpu.utils.data import blake3sum
 from test_block import make_block_cluster, stop_all
 
@@ -245,11 +257,21 @@ def test_ssec_never_probed_or_pushed_cross_node(tmp_path):
                 assert m.cache.get(h) is None
                 assert m.cache_tier.probes == 0
                 assert m.cache_tier.inserts_pushed == 0
+                # ISSUE 18: nor does SSE-C enter the packed segment or
+                # the lease ledger on any node
+                assert m.packed_cache.get(h) is None
+                assert m.cache_tier.leases.minted == 0
             # and the tier-level guard itself: probe(cacheable=False)
             # is a no-op even when called directly
             tier = managers[0].cache_tier
             owner = tier.owner_of(h) or systems[1].id
             assert await tier.probe(owner, h, cacheable=False) is None
+            assert tier.probes == 0
+            # probe_full honors the same guard: no probe, no lease
+            res = await tier.probe_full(owner, h, cacheable=False,
+                                        kinds=("plain", "packed"))
+            assert res.plain is None and res.packed is None
+            assert not res.lease and not res.timed_out
             assert tier.probes == 0
         finally:
             await stop_all(systems, tasks)
@@ -398,6 +420,520 @@ async def _kill_owner_drill(tmp_path):
         await box.stop()
 
 
+@pytest.mark.slow
+def test_flash_crowd_kill_lease_holder_soak(tmp_path):
+    """ISSUE 18 acceptance soak: a 6-node Zipf flash crowd over a COLD
+    working set, with randomized absorbable chaos armed (net_delay
+    everywhere, rpc_error scoped to the victim) and the lease-holding
+    ring owner of the hottest block SIGKILLed mid-drill. Survivor GETs
+    must all succeed, the lease machinery must have engaged, and the
+    cluster decode count must stay far below one-per-GET. Seed comes
+    from CHAOS_SOAK_SEED so a nightly failure replays exactly."""
+    run(_flash_crowd_soak(tmp_path), timeout=300.0)
+
+
+async def _flash_crowd_soak(tmp_path):
+    import random
+
+    from clusterbox import ClusterBox
+    from garage_tpu.chaos import FaultSpec, arm, disarm
+
+    seed = int(os.environ.get("CHAOS_SOAK_SEED", "1807"))
+    box = ClusterBox(tmp_path, n=6, rf=3, erasure=(2, 1))
+    await box.start()
+    try:
+        blocks = [os.urandom(100_000) for _ in range(8)]
+        hashes = [blake3sum(b) for b in blocks]
+        payload = dict(zip(hashes, blocks))
+        m0 = box.nodes[0].manager
+        for h, b in payload.items():
+            await m0.rpc_put_block(h, b, compress=False,
+                                   cacheable=False)  # fully cold
+        for nd in box.nodes:
+            nd.manager.cache_tier.lease_wait_ms = 1000.0
+
+        failures = []
+
+        async def hammer(nd, rounds=40):
+            # Zipf-weighted: rank r drawn with weight 1/r, per-node
+            # deterministic stream so a seeded run replays exactly
+            rng = random.Random(seed ^ (nd.index * 7919))
+            weights = [1.0 / (i + 1) for i in range(len(hashes))]
+            seq = rng.choices(hashes, weights=weights, k=rounds)
+            for h in seq:
+                try:
+                    got = await nd.manager.rpc_get_block(h)
+                    if got != payload[h]:
+                        failures.append(f"node {nd.index}: corrupt read")
+                except Exception as e:  # noqa: BLE001 - ledger test
+                    failures.append(f"node {nd.index}: {e!r}")
+                await asyncio.sleep(0.005)
+
+        c = arm(seed=seed)
+        # absorbable background chaos: jitter every block RPC a little
+        c.add(FaultSpec(kind="net_delay", prob=0.1, delay_s=0.008,
+                        endpoint="garage_tpu/block"))
+        tasks = [asyncio.ensure_future(hammer(nd)) for nd in box.nodes]
+        await asyncio.sleep(0.08)
+        # the victim: the ring owner of the hottest block — under the
+        # cold herd it is holding (or just resolved) the decode lease
+        owner_id = None
+        for nd in box.nodes:
+            o = nd.manager.cache_tier.owner_of(hashes[0])
+            if o is not None:
+                owner_id = o
+                break
+        victim = next((nd for nd in box.nodes if nd.id == owner_id),
+                      box.nodes[-1])
+        # its remaining RPCs error out non-deterministically too
+        c.add(FaultSpec(kind="rpc_error", prob=0.3,
+                        peer=victim.id.hex()[:8],
+                        endpoint="garage_tpu/block"))
+        vt = tasks[box.nodes.index(victim)]
+        vt.cancel()
+        await asyncio.gather(vt, return_exceptions=True)
+        await box.stop_node(victim)
+        survivors = [nd for nd in box.nodes if nd is not victim]
+        await asyncio.gather(*[tasks[box.nodes.index(nd)]
+                               for nd in survivors])
+        disarm()
+        # the victim's own in-flight GETs may legitimately have died
+        # with it — only survivor reads are the ledger
+        vtag = f"node {victim.index}:"
+        survivor_failures = [f for f in failures
+                             if not f.startswith(vtag)]
+        assert survivor_failures == [], survivor_failures[:5]
+        live = [nd.manager for nd in survivors]
+        minted = sum(m.cache_tier.leases.minted for m in live)
+        assert minted >= 1, "lease machinery never engaged"
+        hammered = len(survivors) * 40
+        decodes = sum(m.metrics["store_reads"] for m in live)
+        assert decodes < hammered / 2, (decodes, hammered)
+    finally:
+        disarm()
+        await box.stop()
+
+
+# ---- ISSUE 18: probe singleflight leases ---------------------------------
+
+
+def test_lease_table_conservation_holder_death_and_cancel():
+    """Unit contract of the owner-side ledger: single-holder election,
+    waiter accounting survives a cancellation mid-park, a holder that
+    dies unresolved is reaped at TTL so the next prober can re-mint,
+    and the conservation invariant (minted == resolved + expired +
+    live; zero parked waiters) holds through all of it — the same
+    predicate GARAGE_SANITIZE checks at loop teardown."""
+    from garage_tpu.block.cache_tier import ProbeLeaseTable
+
+    async def main():
+        lt = ProbeLeaseTable(wait_ms=80.0)
+        h = b"\x01" * 32
+        assert lt.mint(h, b"a" * 32)
+        assert not lt.mint(h, b"b" * 32)  # one holder per hash
+        w_timeout = asyncio.create_task(lt.wait(h, 0.08))
+        w_cancel = asyncio.create_task(lt.wait(h, 5.0))
+        await asyncio.sleep(0.01)
+        w_cancel.cancel()  # a waiter's client disconnects mid-park
+        with pytest.raises(asyncio.CancelledError):
+            await w_cancel
+        assert lt._waiters == 1  # the cancel was accounted immediately
+        assert await w_timeout is False  # holder died: timeout, no wake
+        assert lt.wait_timeouts == 1
+        # the corpse expires at TTL; the NEXT prober mints afresh
+        await asyncio.sleep(lt.ttl_s + 0.05)
+        assert not lt.live(h)
+        assert lt.expired == 1
+        assert lt.mint(h, b"c" * 32)
+        waiter = asyncio.create_task(lt.wait(h, 5.0))
+        await asyncio.sleep(0.01)
+        lt.resolve(h)  # the insert lands: parked probers wake
+        assert await waiter is True
+        assert lt.wait_hits == 1
+        assert lt.minted == 2 and lt.resolved == 1 and lt.expired == 1
+        assert lt.conservation_ok and lt._waiters == 0
+
+    run(main())
+
+
+def test_probe_wait_clamped_inside_flat_probe_timeout():
+    """Satellite contract: the lease wait a prober may request (and an
+    owner may grant — the handler re-clamps with the same function)
+    always fits INSIDE the flat 2 s probe budget with the transfer
+    margin spared, no matter how the knob is configured — the wait can
+    never stack on top of the RPC timeout. wait_ms=0 is the leases-off
+    switch: no wait, and mint refuses."""
+    from garage_tpu.block.cache_tier import (PROBE_TIMEOUT_S,
+                                             PROBE_WAIT_MARGIN_S,
+                                             ClusterCacheTier)
+
+    tier = ClusterCacheTier(manager=None)
+    budget_ms = (PROBE_TIMEOUT_S - PROBE_WAIT_MARGIN_S) * 1000.0
+    tier.lease_wait_ms = 10_000.0  # operator asks for more than the budget
+    assert tier.probe_wait_ms() == budget_ms
+    tier.lease_wait_ms = 100.0
+    assert tier.probe_wait_ms() == 100.0
+    tier.lease_wait_ms = 0.0
+    assert tier.probe_wait_ms() == 0.0
+    assert not tier.leases.mint(b"\x01" * 32, b"a" * 32)
+
+
+def test_cold_herd_collapses_to_one_decode(tmp_path):
+    """The tentpole property at its sharpest: a fully cold 4-node herd
+    on ONE block — every node GETs concurrently, the ring owner
+    included — performs exactly one gather+decode cluster-wide.
+    Whoever reaches the owner's lease table first (the owner's own
+    self-lease, or the first remote prober's grant) pays it; everyone
+    else parks and is woken by the write-through insert."""
+    async def main():
+        net, systems, managers, tasks = await tier_cluster(tmp_path)
+        try:
+            for m in managers:
+                m.cache_tier.lease_wait_ms = 1000.0
+            data = os.urandom(150_000)
+            h = blake3sum(data)
+            await managers[0].rpc_put_block(h, data, compress=False,
+                                            cacheable=False)  # cold
+            owner_id = next(o for o in (m.cache_tier.owner_of(h)
+                                        for m in managers)
+                            if o is not None)
+            owner = by_id(systems, managers)[owner_id]
+            d0 = sum(m.metrics["store_reads"] for m in managers)
+            got = await asyncio.gather(*[m.rpc_get_block(h)
+                                         for m in managers])
+            assert all(g == data for g in got)
+            assert sum(m.metrics["store_reads"]
+                       for m in managers) - d0 == 1
+            lt = owner.cache_tier.leases
+            assert lt.minted >= 1
+            # the rest of the herd parked and was woken, not re-decoded
+            waits = lt.wait_hits + sum(m.cache_tier.lease_wait_hits
+                                       for m in managers)
+            assert waits >= 1
+            await wait_for(lambda: lt.conservation_ok,
+                           what="lease conservation")
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
+def test_flash_crowd_decode_amplification_bounded(tmp_path):
+    """The acceptance bound on a herd over a SET of cold blocks: 6
+    nodes x 6 blocks x 3 synchronized rounds of GETs must stay within
+    1.5 decodes per distinct block cluster-wide — O(blocks), not
+    O(blocks x nodes)."""
+    async def main():
+        net, systems, managers, tasks = await tier_cluster(tmp_path, n=6)
+        try:
+            for m in managers:
+                m.cache_tier.lease_wait_ms = 1000.0
+            blocks = [os.urandom(120_000) for _ in range(6)]
+            hashes = [blake3sum(b) for b in blocks]
+            for h, b in zip(hashes, blocks):
+                await managers[0].rpc_put_block(h, b, compress=False,
+                                                cacheable=False)
+            d0 = sum(m.metrics["store_reads"] for m in managers)
+
+            async def herd(m):
+                for _ in range(3):
+                    for h, b in zip(hashes, blocks):
+                        assert await m.rpc_get_block(h) == b
+
+            await asyncio.gather(*[herd(m) for m in managers])
+            decodes = sum(m.metrics["store_reads"]
+                          for m in managers) - d0
+            assert decodes <= 1.5 * len(blocks), decodes
+            assert sum(m.cache_tier.leases.minted for m in managers) >= 1
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
+def test_node_local_read_singleflight_collapse(tmp_path):
+    """With the cross-node tier off, concurrent same-hash readers ON
+    ONE NODE still collapse onto a single leader's decode via the
+    `_read_store` singleflight map; the hash is released on completion
+    and SSE-C reads never transit the shared future."""
+    async def main():
+        net, systems, managers, tasks = await make_block_cluster(
+            tmp_path, n=3, rf=3, cache_tier=True)
+        try:
+            data = os.urandom(100_000)
+            h = blake3sum(data)
+            await managers[0].rpc_put_block(h, data, compress=False,
+                                            cacheable=False)
+            m = managers[0]
+            m.cache_tier.enabled = False  # isolate the node-local lane
+            d0 = m.metrics["store_reads"]
+            got = await asyncio.gather(*[m.rpc_get_block(h)
+                                         for _ in range(8)])
+            assert all(g == data for g in got)
+            assert m.metrics["store_reads"] - d0 == 1
+            assert m.sf_leaders == 1 and m.sf_collapsed == 7
+            assert len(m._sf) == 0  # released on completion
+            # SSE-C reads go straight to the store, never the future
+            d1 = m.metrics["store_reads"]
+            await asyncio.gather(*[m.rpc_get_block(h, cacheable=False)
+                                   for _ in range(3)])
+            assert m.metrics["store_reads"] - d1 == 3
+            assert m.sf_leaders == 1 and m.sf_collapsed == 7
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
+def test_hung_owner_wait_rides_inside_flat_probe_timeout(tmp_path):
+    """Chaos pin of the wait-bound contract: the owner is blackholed
+    (rpc_hang sleeps out the caller's whole budget), the lease wait is
+    configured absurdly high — and the GET still completes in about
+    PROBE_TIMEOUT_S plus one local decode, because the wait is
+    budgeted INSIDE the flat probe timeout, never stacked on top."""
+    async def main():
+        from garage_tpu.block.cache_tier import PROBE_TIMEOUT_S
+        from garage_tpu.chaos import FaultSpec, arm, disarm
+
+        net, systems, managers, tasks = await tier_cluster(tmp_path)
+        try:
+            data = os.urandom(100_000)
+            h = blake3sum(data)
+            await managers[0].rpc_put_block(h, data, compress=False,
+                                            cacheable=False)
+            reader = next(m for m in managers
+                          if m.cache_tier.owner_of(h) is not None)
+            owner_id = reader.cache_tier.owner_of(h)
+            reader.cache_tier.lease_wait_ms = 10_000.0
+            c = arm(seed=18)
+            c.add(FaultSpec(kind="rpc_hang", peer=owner_id.hex()[:8],
+                            endpoint="garage_tpu/block", count=1))
+            t0 = time.monotonic()
+            assert await reader.rpc_get_block(h) == data
+            dt = time.monotonic() - t0
+            assert c.total_fired == 1, "hang was never injected"
+            assert dt < PROBE_TIMEOUT_S + 1.5, (
+                f"wait stacked on top of the probe budget: {dt:.1f}s")
+            assert reader.cache_tier.probe_fails == 1
+        finally:
+            disarm()
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
+def test_dead_lease_holder_waiters_fall_back_within_budget(tmp_path):
+    """A lease whose holder dies silently costs its waiters only the
+    OWNER's configured wait (the server-side clamp outranks the
+    prober's request): the parked probe answers a waited miss, the GET
+    falls back to the local store path, the fallback does NOT push
+    write-through (the holder's insert is presumed in flight), and the
+    corpse is reaped at TTL with conservation intact."""
+    async def main():
+        net, systems, managers, tasks = await tier_cluster(tmp_path)
+        try:
+            data = os.urandom(90_000)
+            h = blake3sum(data)
+            await managers[0].rpc_put_block(h, data, compress=False,
+                                            cacheable=False)
+            reader = next(m for m in managers
+                          if m.cache_tier.owner_of(h) is not None)
+            owner_id = reader.cache_tier.owner_of(h)
+            owner = by_id(systems, managers)[owner_id]
+            reader.cache_tier.lease_wait_ms = 1400.0  # prober asks big
+            owner.cache_tier.lease_wait_ms = 120.0    # owner grants less
+            # a holder that will never resolve (SIGKILLed mid-decode)
+            assert owner.cache_tier.leases.mint(h, b"\xdd" * 32)
+            t0 = time.monotonic()
+            assert await reader.rpc_get_block(h) == data
+            dt = time.monotonic() - t0
+            # parked ~the OWNER's 120 ms clamp (not the 1400 asked),
+            # then one store read — nowhere near the 2 s probe budget
+            assert 0.1 <= dt < 1.0, f"owner did not clamp the wait: {dt:.2f}s"
+            assert reader.cache_tier.lease_wait_timeouts == 1
+            assert owner.cache_tier.leases.wait_timeouts == 1
+            # the fallback suppressed its write-through push
+            assert reader.cache_tier.inserts_pushed == 0
+            await asyncio.sleep(0.2)
+            assert owner.cache.get(h) is None
+            await wait_for(lambda: not owner.cache_tier.leases.live(h),
+                           what="lease corpse reaped at TTL")
+            assert owner.cache_tier.leases.expired == 1
+            assert owner.cache_tier.leases.conservation_ok
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
+# ---- ISSUE 18: packed-bytes tier -----------------------------------------
+
+
+def _find_owned_placed(systems, managers, need_leader=False):
+    """(data, h, manager, placement) where the ring owner of h also
+    holds one of its erasure shards (and is the stripe's scrub leader
+    when need_leader) — the geometry the packed-tier tests need."""
+    from garage_tpu.block.codec import shard_nodes_of
+
+    layout = systems[0].layout_helper.current()
+    width = managers[0].codec.width
+    while True:
+        data = os.urandom(150_000)
+        h = blake3sum(data)
+        placement = shard_nodes_of(layout, h, width)
+        for m in managers:
+            if not m.cache_tier.local_owner(h):
+                continue
+            if m.system.id not in placement:
+                continue
+            if need_leader and placement[0] != m.system.id:
+                continue
+            return data, h, m, placement
+
+
+async def _wait_shards_placed(systems, managers, h, placement):
+    ms = by_id(systems, managers)
+    await wait_for(
+        lambda: all(idx in ms[node].local_parts(h)
+                    for idx, node in enumerate(placement)),
+        what="shards placed")
+
+
+def test_packed_tier_byte_identity_and_warm_rebuild_zero_gather(tmp_path):
+    """The packed segment holds the EXACT bytes the erasure decode
+    reassembled: re-encoding them through feeder.encode_put reproduces
+    every on-disk shard file byte-for-byte, and a warm _rebuild_shard
+    serves from the tier with the gather path forbidden — the
+    acceptance's 'warm rebuild RPC fetch count == 0'."""
+    async def main():
+        from garage_tpu.block import DataBlock
+
+        net, systems, managers, tasks = await tier_cluster(tmp_path)
+        try:
+            data, h, m, placement = _find_owned_placed(systems, managers)
+            await managers[0].rpc_put_block(h, data, compress=False,
+                                            cacheable=False)
+            await _wait_shards_placed(systems, managers, h, placement)
+            # cold cacheable read on the ring owner: the decode admits
+            # the reassembled packed bytes into the LOCAL packed segment
+            assert await m.rpc_get_block(h) == data
+            packed = m.packed_cache.get(h)
+            assert packed is not None
+            assert DataBlock.unpack(bytes(packed)).plain_bytes() == data
+            # byte identity: the deterministic re-encode == disk files
+            ms = by_id(systems, managers)
+            framed = await m.feeder.encode_put(bytes(packed))
+            for idx, node in enumerate(placement):
+                assert bytes(framed[idx]) == \
+                    ms[node].read_local_shard(h, idx)
+            # warm rebuild: zero gather RPCs, byte-identical shard
+            idx = placement.index(m.system.id)
+            orig = m.read_local_shard(h, idx)
+            real_gather = m._gather_parts
+
+            async def no_gather(*a, **kw):
+                raise AssertionError("gather used on a warm rebuild")
+
+            m._gather_parts = no_gather
+            try:
+                rebuilt = await m.resync._rebuild_shard(h, idx)
+            finally:
+                m._gather_parts = real_gather
+            assert rebuilt == orig
+            assert m.resync.rebuild_tier_hits == 1
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
+def test_scrub_repair_rides_packed_tier(tmp_path):
+    """A stripe repair whose packed bytes sit in the tier localizes
+    from the CACHE — scrub_cache_hits == 1 — re-verifies them, and
+    still pushes a byte-correct shard back to the forged holder; a
+    re-scrub is clean."""
+    async def main():
+        from garage_tpu.block import ScrubWorker
+
+        net, systems, managers, tasks = await tier_cluster(tmp_path)
+        try:
+            data, h, leader, placement = _find_owned_placed(
+                systems, managers, need_leader=True)
+            await managers[0].rpc_put_block(h, data, compress=False,
+                                            cacheable=False)
+            await _wait_shards_placed(systems, managers, h, placement)
+            assert await leader.rpc_get_block(h) == data  # packed warm
+            assert leader.packed_cache.get(h) is not None
+            # forge data shard 1: valid framing, wrong bytes
+            victim = by_id(systems, managers)[placement[1]]
+            raw = victim.read_local_shard(h, 1)
+            payload, packed_len = unpack_shard(raw)
+            forged = bytes(b ^ 0xFF for b in payload[:64]) + payload[64:]
+            victim.write_local_shard(h, 1, pack_shard(forged, packed_len))
+            sw = ScrubWorker(leader)
+            assert await sw.scrub_batch([h]) == 1
+            assert sw.scrub_cache_lookups == 1
+            assert sw.scrub_cache_hits == 1
+            fixed, _ = unpack_shard(victim.read_local_shard(h, 1))
+            assert fixed == payload
+            assert await sw.scrub_batch([h]) == 0
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
+# ---- ISSUE 18: hint-driven prefetch --------------------------------------
+
+
+def test_owner_prefetches_on_hint(tmp_path):
+    """An inbound same-zone hint for an OWNED, uncached block queues a
+    governor-paced background decode at the owner, so the first herd
+    probe-hits instead of minting a lease. A re-hint of a held block
+    is a cheap skip, non-owners never act, and prefetch_inflight=0
+    turns the lane off entirely."""
+    async def main():
+        net, systems, managers, tasks = await tier_cluster(tmp_path)
+        try:
+            data, h, owner_m, placement = _find_owned_placed(
+                systems, managers)
+            await managers[0].rpc_put_block(h, data, compress=False,
+                                            cacheable=False)
+            await _wait_shards_placed(systems, managers, h, placement)
+            owner_m.cache_tier.prefetch_tranquility = 0.02  # paced lane
+            peer = next(s.id for s in systems
+                        if s.id != owner_m.system.id)
+            assert owner_m.cache.get(h) is None
+            owner_m.cache_tier.note_hints(peer, [h])
+            await wait_for(lambda: owner_m.cache.get(h) is not None,
+                           what="hint-driven prefetch fill")
+            assert owner_m.cache_tier.prefetched == 1
+            # the herd now probe-hits: zero additional decodes anywhere
+            reader = next(m for m in managers
+                          if m.cache_tier.owner_of(h) is not None)
+            d0 = sum(m.metrics["store_reads"] for m in managers)
+            assert await reader.rpc_get_block(h) == data
+            assert sum(m.metrics["store_reads"] for m in managers) == d0
+            # a re-hint of a held block is a skip, not a decode
+            owner_m.cache_tier.note_hints(peer, [h])
+            assert owner_m.cache_tier.prefetch_skips >= 1
+            # non-owners never act on the same hint
+            other = next(m for m in managers
+                         if not m.cache_tier.local_owner(h))
+            other.cache_tier.note_hints(peer, [h])
+            assert len(other.cache_tier._prefetch_q) == 0
+            # and the knob turns the lane off entirely
+            owner_m.cache_tier.prefetch_inflight = 0
+            owner_m.cache.discard(h)
+            owner_m.cache_tier.note_hints(peer, [h])
+            await asyncio.sleep(0.1)
+            assert owner_m.cache.get(h) is None
+        finally:
+            await stop_all(systems, tasks)
+
+    run(main())
+
+
 # ---- shm forward ring ----------------------------------------------------
 
 
@@ -542,3 +1078,30 @@ def test_gl03_quiet_on_untainted_tier_insert():
         def warm(mgr, owner, h, payload):
             mgr.cache_tier.insert_at(owner, h, payload)
     """, "garage_tpu/block/fake_tier.py") == []
+
+
+def test_gl03_fires_on_probe_full_and_probe_packed_in_ssec_scope():
+    """ISSUE 18 extends the seam: the dual-segment probe and the
+    packed-segment probe are sinks in SSE-tainted scope too."""
+    assert _lint("""
+        async def stream(mgr, h, sse_key):
+            tier = mgr.cache_tier
+            return await tier.probe_full(owner_of(h), h)
+    """, "garage_tpu/api/s3/fake_tier.py") == ["GL03"]
+    # probe_packed has NO cacheable escape hatch on purpose: the
+    # packed segment must be structurally unreachable from SSE scope
+    assert _lint("""
+        async def rebuild(mgr, h, sse_key):
+            tier = mgr.cache_tier
+            return await tier.probe_packed(owner_of(h), h)
+    """, "garage_tpu/block/fake_tier.py") == ["GL03"]
+
+
+def test_gl03_quiet_with_cacheable_on_probe_full():
+    assert _lint("""
+        async def stream(mgr, h, sse_key):
+            tier = mgr.cache_tier
+            res = await tier.probe_full(owner_of(h), h,
+                                        cacheable=sse_key is None)
+            return res.plain
+    """, "garage_tpu/api/s3/fake_tier.py") == []

@@ -349,10 +349,15 @@ class _FakeResync:
     tranquility = 0.0
 
 
+class _FakeCacheTier:
+    prefetch_tranquility = 0.0
+
+
 class _FakeBlockManager:
     def __init__(self):
         self.resync = _FakeResync()
         self.scrub_worker = _FakeScrubWorker()
+        self.cache_tier = _FakeCacheTier()
 
 
 class _FakeGarage:
@@ -377,6 +382,9 @@ def test_governor_throttles_scrub_under_latency():
     sw = g.block_manager.scrub_worker
     assert sw.state.tranquility == pytest.approx(30.0)  # scrub yields
     assert g.block_manager.resync.tranquility == pytest.approx(2.0)
+    # cache-tier hint prefetch yields too (ISSUE 18)
+    assert g.block_manager.cache_tier.prefetch_tranquility == \
+        pytest.approx(GovernorWorker.PREFETCH_TRANQ_MAX)
     high_ewma = gov.ewma
     assert high_ewma > 0.05
 
@@ -388,6 +396,8 @@ def test_governor_throttles_scrub_under_latency():
     assert gov.pressure == pytest.approx(0.0)
     assert sw.state.tranquility == pytest.approx(1.0)
     assert g.block_manager.resync.tranquility == pytest.approx(0.0)
+    assert g.block_manager.cache_tier.prefetch_tranquility == \
+        pytest.approx(0.0)
 
     # foreground-idle: pressure decays instead of freezing
     gov.pressure = 0.6
@@ -642,6 +652,52 @@ def test_admin_qos_roundtrip(tmp_path):
                               {"bogus_limit": 1})
             assert ei.value.code == 400
             assert g.qos.limits.global_rps == 123.0
+        finally:
+            await srv.stop()
+            await stop_all(garages, tasks)
+
+    run(main())
+
+
+def test_admin_cache_readout(tmp_path):
+    """GET /v1/cache (ISSUE 18): one stop for the cold-herd machinery —
+    both cache segments' stats, the node-local singleflight counters,
+    and the cluster tier's lease/prefetch ledger."""
+    async def main():
+        from garage_tpu.admin.http import AdminHttpServer
+
+        net, garages, tasks = await make_garage_cluster(tmp_path, n=1,
+                                                        rf=1)
+        g = garages[0]
+        g.config.admin_token = "cache-admin-token"
+        srv = AdminHttpServer(g)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        await srv.start("127.0.0.1", port)
+
+        def req(path):
+            r = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                headers={"authorization": "Bearer cache-admin-token"})
+            with urllib.request.urlopen(r, timeout=10) as resp:
+                return json.loads(resp.read().decode())
+
+        try:
+            got = await in_pool(req, "/v1/cache")
+            assert got["enabled"] is True
+            for seg in ("plain", "packed"):
+                for key in ("entries", "bytes", "hits", "misses",
+                            "inserts", "max_bytes"):
+                    assert key in got[seg], (seg, key)
+            assert got["singleflight"] == {"leaders": 0, "collapsed": 0,
+                                           "in_flight": 0}
+            tier = got["tier"]
+            assert tier is not None  # [block] cache_tier defaults on
+            for key in ("lease_wait_ms", "lease_depth", "lease_minted",
+                        "lease_grants", "prefetch_queue", "prefetched",
+                        "prefetch_inflight_max"):
+                assert key in tier, key
         finally:
             await srv.stop()
             await stop_all(garages, tasks)

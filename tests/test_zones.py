@@ -323,6 +323,9 @@ class _StubCache:
     def top_keys(self, n):
         return []
 
+    def contains(self, h):
+        return True  # ISSUE 18: hints for held blocks never prefetch
+
 
 class _StubRpc:
     def health(self):
@@ -366,6 +369,17 @@ def test_cache_tier_ring_is_per_zone(tmp_path):
             tier.note_hints(ids[1], [h])
             assert tier.is_hot(h)
             assert tier.stats()["zone"] == "z1"
+
+            # ISSUE 18 conformance: the prefetch trigger sits BEHIND
+            # the same zone gate — a cross-zone hint must never queue
+            # a speculative decode either
+            triggered = []
+            tier._maybe_prefetch = triggered.append
+            h2 = b"\x08" * 32
+            tier.note_hints(ids[2], [h2])  # cross-zone: dropped
+            assert triggered == []
+            tier.note_hints(ids[1], [h2])  # same-zone: considered
+            assert triggered == [h2]
 
             # zoneless observer (a node with no layout role, e.g. a
             # gateway worker): the pre-zone global roster survives
