@@ -46,13 +46,31 @@ Rules (each encodes an invariant an earlier PR established by hand):
                                 await (check-then-act race; ISSUE 14)
   GL13 lock-order-inversion     lock-acquisition cycles across the
                                 global graph — the ABBA deadlock, both
-                                chains reported (ISSUE 14)
+                                chains reported (ISSUE 14); lock
+                                identity is allocation-site-aware
+                                since ISSUE 20
+  GL14 jit-cache-key-leak       a jit/compile cache keyed on data-
+                                dependent pattern values (PR 11's
+                                per-pattern program leak); device path
+                                only (block/ ops/ parallel/)
+  GL15 unpadded-device-launch   device_put / batched-kernel operand
+                                sized from raw len()/max() instead of
+                                the pad_buckets ladder; device path
+                                only
+  GL16 loop-touch-from-stage-thread
+                                stage-executor-executed code reaching
+                                loop-affine asyncio primitives without
+                                the *_threadsafe crossings; device
+                                path only
   GL00 (framework)              stale waivers, stale baseline entries,
                                 unparseable files — cannot be waived
 
-GL02/GL03/GL10-GL13 run on the two-pass interprocedural engine
-(dataflow.py summaries + callgraph.py resolution — see README "How
-dataflow resolution works"). The runtime half is
+GL02/GL03/GL10-GL13 and GL16 run on the two-pass interprocedural
+engine (dataflow.py summaries + callgraph.py resolution — see README
+"How dataflow resolution works"). Since ISSUE 20 the summaries carry
+an explicit per-function CFG (path-sensitive GL11, loop-carried GL12),
+allocation-site lock identity (per-instance GL13), and receiver type
+facts that rank above unique-method CHA in call resolution. The runtime half is
 utils/sanitizer.py (GARAGE_SANITIZE=1): loop-stall detection +
 teardown leak/conservation checks wired into tests/conftest.py.
 
@@ -75,6 +93,8 @@ from .rules_concurrency import (AwaitInterleavingAtomicity,
                                 LockOrderInversion)
 from .rules_dataflow import (BlockingReachableFromAsync,
                              LeakedBudgetOnException)
+from .rules_device import (JitCacheKeyLeak, LoopTouchFromStageThread,
+                           UnpaddedDeviceLaunch)
 from .rules_project import (ConfigKnobDrift, CrossWorkerState,
                             UnregisteredMetric)
 from .rules_rpc import HedgeOnMutation, SsecCacheLeak
@@ -94,6 +114,9 @@ RULE_CLASSES = [
     LeakedBudgetOnException,    # GL11
     AwaitInterleavingAtomicity,  # GL12
     LockOrderInversion,         # GL13
+    JitCacheKeyLeak,            # GL14
+    UnpaddedDeviceLaunch,       # GL15
+    LoopTouchFromStageThread,   # GL16
 ]
 
 

@@ -1,15 +1,18 @@
-"""CLI: python -m garage_tpu.analysis [--format json|text] [paths]
+"""CLI: python -m garage_tpu.analysis [--format json|text|sarif] [paths]
 
 Exit codes: 0 clean (waived/baselined findings allowed), 1 active
 violations, 2 bad invocation. CI's lint job is exactly
 `python -m garage_tpu.analysis` (text output feeds the GitHub problem
-matcher; `--format json` is the machine surface).
+matcher; `--format json` is the machine surface, `--format sarif`
+emits a minimal SARIF 2.1.0 log for code-scanning upload).
 
 Extras (ISSUE 9):
   --explain RULE        rule rationale + a firing and a suppressed
                         example, straight from the rule class
   --fix-waivers         delete stale `# lint: ignore[...]` comments
-                        GL00 flags (dry-run by default; --write applies)
+                        GL00 flags (dry-run by default; --write
+                        applies); a multi-rule waiver where only SOME
+                        rules are stale keeps the surviving rules
   --summary-cache PATH  reuse pass-1 dataflow summaries for files whose
                         sha256 is unchanged (CI keys the cache on the
                         tree hash; a miss just re-summarizes)
@@ -20,6 +23,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 import time
 
@@ -85,17 +89,27 @@ def _explain(rule_id: str) -> int:
     return 0
 
 
+# GL00's per-rule staleness message names exactly the stale ids:
+# "stale waiver for GL05,GL07: suppresses nothing ..."
+_STALE_MSG_RE = re.compile(r"stale waiver for ([A-Z0-9,]+):")
+
+
 def _fix_waivers(paths: list[str], root: str, write: bool) -> int:
     """Delete waiver comments GL00 reports as stale. Dry-run prints
-    the edits; --write applies them. Only the comment is removed — a
-    line that becomes empty is dropped entirely."""
+    the edits; --write applies them. A multi-rule waiver where only
+    some rules are stale is REWRITTEN to keep the surviving rules and
+    the reason; the whole comment is removed only when every rule it
+    names is stale, and a line that becomes empty is dropped."""
     rules = default_rules()
     violations, project = analyze_paths(paths, rules, root=root,
                                         data=_readme_data(root))
-    stale: dict[str, list[int]] = {}
+    stale: dict[str, dict[int, set[str]]] = {}
     for v in violations:
         if v.rule == META_RULE and "stale waiver" in v.message:
-            stale.setdefault(v.path, []).append(v.line)
+            m = _STALE_MSG_RE.search(v.message)
+            ids = set(m.group(1).split(",")) if m else set()
+            stale.setdefault(v.path, {}).setdefault(v.line,
+                                                    set()).update(ids)
     if not stale:
         print("no stale waivers")
         return 0
@@ -108,27 +122,74 @@ def _fix_waivers(paths: list[str], root: str, write: bool) -> int:
         except OSError as e:
             print(f"{rel}: unreadable ({e})", file=sys.stderr)
             continue
-        for ln in sorted(set(lines), reverse=True):
+        for ln in sorted(lines, reverse=True):
             if ln - 1 >= len(src_lines):
                 continue
             line = src_lines[ln - 1]
-            stripped = WAIVER_RE.sub("", line).rstrip()
-            action = ("drop line" if not stripped.strip()
-                      else "strip comment")
-            print(f"{rel}:{ln}: {action}: {line.rstrip()}")
-            if write:
-                if stripped.strip():
-                    nl = "\n" if line.endswith("\n") else ""
-                    src_lines[ln - 1] = stripped + nl
-                else:
-                    del src_lines[ln - 1]
+            nl = "\n" if line.endswith("\n") else ""
+            wm = WAIVER_RE.search(line)
+            keep: list[str] = []
+            if wm and lines[ln]:
+                named = [t.strip().upper()
+                         for t in wm.group(1).split(",")]
+                keep = [r for r in named if r and r not in lines[ln]]
+            if keep:
+                reason = wm.group(2).strip()
+                comment = f"# lint: ignore[{','.join(keep)}]"
+                if reason:
+                    comment += f" {reason}"
+                new_line = line[:wm.start()] + comment
+                print(f"{rel}:{ln}: keep {','.join(keep)}: "
+                      f"{line.rstrip()}")
+                if write:
+                    src_lines[ln - 1] = new_line + nl
+            else:
+                stripped = WAIVER_RE.sub("", line).rstrip()
+                action = ("drop line" if not stripped.strip()
+                          else "strip comment")
+                print(f"{rel}:{ln}: {action}: {line.rstrip()}")
+                if write:
+                    if stripped.strip():
+                        src_lines[ln - 1] = stripped + nl
+                    else:
+                        del src_lines[ln - 1]
             edits += 1
         if write:
             with open(ap, "w", encoding="utf-8") as f:
                 f.write("".join(src_lines))
-    verb = "removed" if write else "would remove (dry-run; pass --write)"
+    verb = ("rewritten/removed" if write
+            else "would rewrite/remove (dry-run; pass --write)")
     print(f"{edits} stale waiver(s) {verb}")
     return 0
+
+
+def _to_sarif(active, rules) -> dict:
+    """Minimal SARIF 2.1.0 log: one run, the rule table in
+    tool.driver.rules, one result per active violation."""
+    rule_meta = [{"id": r.id, "name": r.name,
+                  "shortDescription": {"text": r.summary}}
+                 for r in rules]
+    rule_meta.append({"id": META_RULE, "name": "framework",
+                      "shortDescription":
+                          {"text": "waiver/baseline hygiene"}})
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {"name": "garage-lint",
+                                "rules": rule_meta}},
+            "results": [{
+                "ruleId": v.rule,
+                "level": "error",
+                "message": {"text": v.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": v.path},
+                    "region": {"startLine": v.line,
+                               "startColumn": max(v.col, 0) + 1},
+                }}],
+            } for v in active],
+        }],
+    }
 
 
 def _readme_data(root: str) -> dict:
@@ -158,7 +219,7 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("paths", nargs="*",
                         help="files/dirs to scan (default: the "
                              "garage_tpu package + harness files)")
-    parser.add_argument("--format", choices=("text", "json"),
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
                         default="text")
     parser.add_argument("--baseline", default=None,
                         help="baseline JSON path, or 'none' "
@@ -234,7 +295,10 @@ def main(argv: list[str] | None = None) -> int:
         violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
 
     active = [v for v in violations if v.active]
-    if args.format == "json":
+    if args.format == "sarif":
+        print(json.dumps(_to_sarif(active, rules), indent=2,
+                         sort_keys=True))
+    elif args.format == "json":
         df = project.data.get("_dataflow")
         print(json.dumps({
             "violations": [v.to_dict() for v in active],

@@ -9,7 +9,13 @@ target is nameable with high confidence:
   * `self.m` / `cls.m`: the enclosing class's methods, then base
     classes resolvable in-project (depth-limited, cycle-tolerant);
   * `mod.func` dotted chains rooted at an imported module;
-  * `obj.m` on an arbitrary receiver: class-hierarchy-analysis ONLY
+  * `obj.m` on an arbitrary receiver: receiver TYPE facts from pass 1
+    (parameter annotations, `x = Cls(...)` constructor assignments,
+    `isinstance` guards — import-aware, ISSUE 20) rank first: a typed
+    receiver resolves through its class, and a receiver typed to an
+    out-of-project import contributes NO edge even when CHA would have
+    guessed one (the `ET.Element.iter` vs `db.Tree.iter` fix); only
+    untyped receivers fall back to class-hierarchy analysis, and ONLY
     when exactly one project class defines `m` (unique-method CHA) —
     common names like `get` disqualify themselves by ubiquity;
   * `asyncio.to_thread(f, ...)`, `loop.run_in_executor(_, f, ...)` and
@@ -28,6 +34,10 @@ from typing import Iterator, Optional
 
 # self.m resolution climbs at most this many base-class links
 _BASE_DEPTH = 4
+
+# tri-state marker for typed-receiver resolution: "no type fact" (fall
+# back to CHA), distinct from None ("typed: definitely no project edge")
+_UNKNOWN = object()
 
 
 class CallGraph:
@@ -48,6 +58,10 @@ class CallGraph:
         # the exact replacement for GL10's db-receiver-name heuristic
         # wherever the call resolves in-project (ISSUE 14 satellite)
         self._annotated: set[str] = set()
+        # top-level package names of the project itself — an import
+        # whose target leaves this set types its receiver as external
+        self._project_roots: set[str] = {
+            fs["module"].split(".")[0] for fs in file_summaries.values()}
 
         for fs in file_summaries.values():
             self.modules[fs["module"]] = fs
@@ -102,11 +116,67 @@ class CallGraph:
             target = target.rsplit(".", 1)[-1]
             kind = "attr"
         if kind == "attr":
+            typed = self._resolve_typed(caller_id, fs, rec, target)
+            if typed is not _UNKNOWN:
+                return typed
             hits = self._methods.get(target, [])
             if len(hits) == 1:
                 return hits[0]
             return None
         return None
+
+    def _resolve_typed(self, caller_id: str, fs: dict, rec: dict,
+                       method: str):
+        """Import-aware receiver typing (ISSUE 20). When pass 1 learned
+        the single-name receiver's type (parameter annotation,
+        constructor assignment, isinstance guard), that fact outranks
+        unique-method CHA: an in-project class resolves through
+        `_resolve_method` (None when the method is absent there), and a
+        receiver typed by an import that leaves the project is external
+        — no project edge, no CHA guess. Returns a function id, None
+        (authoritative negative), or _UNKNOWN (no usable type fact)."""
+        recv = rec.get("recv") or []
+        if len(recv) != 1 or recv[0] in ("self", "cls"):
+            return _UNKNOWN
+        fn = self.functions.get(caller_id)
+        if fn is None:
+            return _UNKNOWN
+        vt = (fn.get("var_types") or {}).get(recv[0])
+        if not vt:
+            return _UNKNOWN
+        chain = vt["t"].split(".")
+        cls_fs, cls_name = self._class_of_chain(fs, chain)
+        if cls_name is not None:
+            return self._resolve_method(cls_fs, cls_name, method, 0,
+                                        set())
+        imp = fs["imports"].get(chain[0])
+        if imp is not None \
+                and imp.split(".")[0] not in self._project_roots:
+            return None
+        return _UNKNOWN
+
+    def _class_of_chain(self, fs: dict, chain: list):
+        """(file_summary, class_name) when a type chain names an
+        in-project class — same-module by bare name, or through this
+        module's imports ("mod.Cls", an aliased class, a re-export) —
+        else (None, None)."""
+        if len(chain) == 1 and chain[0] in fs["classes"]:
+            return fs, chain[0]
+        imp = fs["imports"].get(chain[0])
+        if imp is None:
+            return None, None
+        dotted = imp + ("." + ".".join(chain[1:])
+                        if len(chain) > 1 else "")
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:i])
+            tfs = self.modules.get(mod)
+            if tfs is not None:
+                cls = ".".join(parts[i:])
+                if cls in tfs["classes"]:
+                    return tfs, cls
+                return None, None
+        return None, None
 
     def _resolve_name(self, fs: dict, caller_qn: str,
                       name: str) -> Optional[str]:

@@ -78,6 +78,10 @@ class Waiver:
     rules: tuple[str, ...]
     reason: str
     used: bool = False
+    # which of `rules` actually suppressed something — staleness is
+    # per-rule since ISSUE 20, so a combined GL05+GL07 waiver with
+    # only GL05 firing reports GL07 stale instead of staying silent
+    used_rules: set = field(default_factory=set)
 
 
 def extract_waivers(source: str) -> list[Waiver]:
@@ -197,6 +201,7 @@ class FileContext:
                            if not getattr(v, "_waiver_hygiene", False)]
         for w in self.waivers:
             w.used = False
+            w.used_rules = set()
         spans: dict[int, list[Violation]] = {}
         for v in self.violations:
             spans.setdefault(v.line, []).append(v)
@@ -222,15 +227,22 @@ class FileContext:
                 if v.rule in w.rules and self._covers(w, v):
                     v.waived = True
                     w.used = True
+                    w.used_rules.add(v.rule)
         for w in self.waivers:
-            if w.used or not w.reason or META_RULE in w.rules:
+            if not w.reason or META_RULE in w.rules:
                 continue
-            if active_rules is not None \
-                    and not (set(w.rules) & active_rules):
-                continue  # its rule didn't run this invocation
+            # staleness is PER-RULE: a multi-rule waiver with one dead
+            # rule names exactly the dead one (the others keep working)
+            stale = [r for r in w.rules if r not in w.used_rules]
+            if active_rules is not None:
+                # a rule that didn't run this invocation could not
+                # possibly have suppressed anything — exempt it
+                stale = [r for r in stale if r in active_rules]
+            if not stale:
+                continue
             v = Violation(
                 rule=META_RULE, path=self.rel_path, line=w.line, col=0,
-                message=f"stale waiver for {','.join(w.rules)}: "
+                message=f"stale waiver for {','.join(stale)}: "
                         "suppresses nothing on this statement")
             v._waiver_hygiene = True  # type: ignore[attr-defined]
             self.violations.append(v)

@@ -15,7 +15,11 @@ extracts, for every function, the facts the flow rules need —
     `functools.partial` / `run_in_executor` unwrapping, awaited-ness,
     kwarg names, RequestStrategy argument classification;
   * resource discipline: qos/lease/semaphore acquires and whether their
-    refund/release is structurally on every exit path (GL11's fact).
+    refund/release is structurally on every exit path (GL11's fact);
+  * since ISSUE 20: an explicit per-function CFG (build_cfg — branch/
+    loop/try-except/return edges, back-edges marked) for path-sensitive
+    pass-2 queries, allocation-site lock identity, and receiver typing
+    facts (var_types) that rank above unique-method CHA in pass 2.
 
 Summaries are plain dicts of sorted primitives: `json.dumps(...,
 sort_keys=True)` over the same tree is byte-identical, which is what
@@ -97,7 +101,10 @@ _DECRYPT = _re.compile(DECRYPT_RE, _re.IGNORECASE)
 # (v3: ISSUE 14 — exit-path contexts on call/acquire/release records,
 # shared-state access events, lock-acquisition facts, generator-
 # iteration flags, blocking_api annotations)
-SUMMARY_VERSION = 3
+# (v4: ISSUE 20 — explicit per-function CFG with back-edges, loop
+# back-edge unrolling in the concurrency event stream, allocation-site
+# lock identity, receiver type facts for import-aware call resolution)
+SUMMARY_VERSION = 4
 
 
 def module_name_of(rel_path: str) -> str:
@@ -133,6 +140,207 @@ def _call_ref(func_expr: ast.AST) -> Optional[list]:
 
 def _payload_ops(node: ast.Call) -> list[str]:
     return sorted(set(payload_ops(node)))
+
+
+def _contains_await(node: ast.AST) -> bool:
+    """An Await in THIS frame (nested defs excluded; lambdas cannot
+    contain await)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and n is not node:
+            continue
+        if isinstance(n, ast.Await):
+            return True
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+# ---- control-flow graph (ISSUE 20) -------------------------------------
+
+def build_cfg(fn_node: ast.AST) -> dict:
+    """Explicit statement-level control-flow graph for one function:
+    blocks of consecutive simple statements, edges for branch / loop /
+    try-except / return flow, loop back-edges marked. Block 0 is the
+    entry; ``-1`` is the virtual exit. Each block records the source
+    lines of its statements plus the lines of call expressions inside
+    them (nested defs/lambdas excluded), which lets pass-2 rules ask
+    "is this call on some CFG path between these two lines" instead of
+    "is it textually between them" (GL11's risky-call check).
+
+    Approximations, each sound for lint (they only ADD paths, never
+    hide one): exception edges enter a handler only from body blocks
+    that can raise (a call, an explicit raise, an await/yield, or an
+    assert) — a handler guarding a raise-free body is unreachable; a
+    nested raise links to every enclosing handler level, not just the
+    innermost; a return inside try/finally jumps straight to the exit
+    without threading the finally body."""
+    blocks: list[dict] = []
+
+    def new_block() -> dict:
+        b = {"id": len(blocks), "lines": [], "calls": [],
+             "succ": [], "back": [], "_raises": False}
+        blocks.append(b)
+        return b
+
+    def link(a: dict, b: dict, back: bool = False) -> None:
+        if b["id"] not in a["succ"]:
+            a["succ"].append(b["id"])
+        if back and b["id"] not in a["back"]:
+            a["back"].append(b["id"])
+
+    def to_exit(a: dict) -> None:
+        if -1 not in a["succ"]:
+            a["succ"].append(-1)
+
+    def note_calls(b: dict, node: ast.AST) -> None:
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)) and n is not node:
+                continue  # nested scope: its calls are not this frame's
+            if isinstance(n, ast.Call):
+                b["calls"].append(n.lineno)
+                b["_raises"] = True
+            elif isinstance(n, (ast.Raise, ast.Await, ast.Yield,
+                                ast.YieldFrom, ast.Assert)):
+                b["_raises"] = True
+            stack.extend(ast.iter_child_nodes(n))
+
+    def note(b: dict, st: ast.AST) -> None:
+        b["lines"].append(st.lineno)
+        note_calls(b, st)
+
+    def flow(stmts: list, cur, loops: list, handlers: list):
+        """Thread `stmts` through the graph starting in block `cur`;
+        returns the open fall-through block, or None when control
+        cannot reach past the last statement."""
+        for st in stmts:
+            if cur is None:
+                cur = new_block()  # unreachable tail, still modeled
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                cur["lines"].append(st.lineno)
+            elif isinstance(st, ast.Return):
+                note(cur, st)
+                to_exit(cur)
+                cur = None
+            elif isinstance(st, ast.Raise):
+                note(cur, st)
+                if handlers:
+                    for h in handlers[-1]:
+                        link(cur, h)
+                else:
+                    to_exit(cur)
+                cur = None
+            elif isinstance(st, ast.Break):
+                cur["lines"].append(st.lineno)
+                if loops:
+                    link(cur, loops[-1][1])
+                cur = None
+            elif isinstance(st, ast.Continue):
+                cur["lines"].append(st.lineno)
+                if loops:
+                    link(cur, loops[-1][0], back=True)
+                cur = None
+            elif isinstance(st, ast.If):
+                cur["lines"].append(st.lineno)
+                note_calls(cur, st.test)
+                then_b = new_block()
+                link(cur, then_b)
+                out_t = flow(st.body, then_b, loops, handlers)
+                if st.orelse:
+                    else_b = new_block()
+                    link(cur, else_b)
+                    out_e = flow(st.orelse, else_b, loops, handlers)
+                else:
+                    out_e = cur
+                outs = [o for o in (out_t, out_e) if o is not None]
+                if outs:
+                    join = new_block()
+                    for o in outs:
+                        link(o, join)
+                    cur = join
+                else:
+                    cur = None
+            elif isinstance(st, (ast.While, ast.For, ast.AsyncFor)):
+                header = new_block()
+                link(cur, header)
+                header["lines"].append(st.lineno)
+                note_calls(header, st.test if isinstance(st, ast.While)
+                           else st.iter)
+                after = new_block()
+                body_b = new_block()
+                link(header, body_b)
+                out_b = flow(st.body, body_b,
+                             loops + [(header, after)], handlers)
+                if out_b is not None:
+                    link(out_b, header, back=True)
+                if st.orelse:
+                    oe = new_block()
+                    link(header, oe)
+                    out_oe = flow(st.orelse, oe, loops, handlers)
+                    if out_oe is not None:
+                        link(out_oe, after)
+                else:
+                    link(header, after)
+                cur = after
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                cur["lines"].append(st.lineno)
+                for item in st.items:
+                    note_calls(cur, item.context_expr)
+                cur = flow(st.body, cur, loops, handlers)
+            elif isinstance(st, ast.Try):
+                h_blks = [new_block() for _ in st.handlers]
+                body_b = new_block()
+                link(cur, body_b)
+                lo = body_b["id"]
+                out_body = flow(st.body, body_b, loops,
+                                handlers + ([h_blks] if h_blks else []))
+                hi = len(blocks)
+                if h_blks:
+                    h_ids = {h["id"] for h in h_blks}
+                    for b in blocks[lo:hi]:
+                        if b["_raises"] and b["id"] not in h_ids:
+                            for h in h_blks:
+                                link(b, h)
+                if st.orelse and out_body is not None:
+                    out_body = flow(st.orelse, out_body, loops, handlers)
+                outs = [out_body] if out_body is not None else []
+                for h, hb in zip(st.handlers, h_blks):
+                    hb["lines"].append(h.lineno)
+                    if h.type is not None:
+                        note_calls(hb, h.type)
+                    out_h = flow(h.body, hb, loops, handlers)
+                    if out_h is not None:
+                        outs.append(out_h)
+                if st.finalbody:
+                    fin = new_block()
+                    for o in outs:
+                        link(o, fin)
+                    cur = flow(st.finalbody, fin, loops, handlers)
+                else:
+                    if outs:
+                        join = new_block()
+                        for o in outs:
+                            link(o, join)
+                        cur = join
+                    else:
+                        cur = None
+            else:
+                note(cur, st)
+        return cur
+
+    entry = new_block()
+    out = flow(list(getattr(fn_node, "body", [])), entry, [], [])
+    if out is not None:
+        to_exit(out)
+    for b in blocks:
+        del b["_raises"]
+        b["calls"] = sorted(set(b["calls"]))
+    return {"blocks": blocks}
 
 
 class _FunctionCollector:
@@ -172,6 +380,15 @@ class _FunctionCollector:
         self.lock_acqs: list[dict] = []
         self._cw_locks: list[str] = []
         self._cw_terminal = 0  # inside a return/raise expression
+        # allocation-site points-to (ISSUE 20): local name -> "Cls@line"
+        # for `x = Cls(...)` bindings (aliases copy the site), so lock
+        # identity can distinguish two instances of one class
+        self._cw_alloc: dict[str, str] = {}
+        # receiver typing facts (ISSUE 20): local/param name ->
+        # {"k": "ann"|"call"|"isinstance", "t": "dotted.chain"} — pass 2
+        # ranks these above unique-method CHA when resolving bare
+        # attribute calls
+        self.var_types: dict[str, dict] = {}
 
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             a = node.args
@@ -228,6 +445,7 @@ class _FunctionCollector:
             for child in body:
                 self._visit(child, awaited=False)
         self._mark_return_calls()
+        self._collect_var_types()
         self._collect_concurrency()
 
     def _visit(self, node: ast.AST, awaited: bool) -> None:
@@ -476,6 +694,77 @@ class _FunctionCollector:
             if key in bound:
                 rec["bound"] = bound[key]
 
+    # -- receiver typing facts (ISSUE 20) --------------------------------
+
+    def _ann_chain(self, ann: Optional[ast.AST]) -> Optional[str]:
+        """Dotted chain of a simple annotation: Name / Attribute, a
+        string literal forward reference, or Optional[X] unwrapped one
+        level. Anything fancier returns None (no fact beats a wrong
+        fact)."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            txt = ann.value.strip()
+            return txt if txt.replace(".", "").isidentifier() else None
+        if isinstance(ann, ast.Subscript):
+            segs = chain_segments(ann.value)
+            if segs and segs[-1] == "Optional":
+                return self._ann_chain(ann.slice)
+            return None
+        if isinstance(ann, (ast.Name, ast.Attribute)):
+            segs = chain_segments(ann)
+            return ".".join(segs) if segs else None
+        return None
+
+    def _collect_var_types(self) -> None:
+        """Local receiver types, best-evidence-last: parameter
+        annotations seed the map, `x = Cls(...)` / `x = y` assignments
+        overwrite (direct evidence), `isinstance(x, Cls)` guards fill
+        gaps only. Pass 2 consults these before unique-method CHA."""
+        if isinstance(self.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = self.node.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+                t = self._ann_chain(arg.annotation)
+                if t and arg.arg not in ("self", "cls"):
+                    self.var_types[arg.arg] = {"k": "ann", "t": t}
+        stack = list(ast.iter_child_nodes(self.node))[::-1]
+        guards: list[tuple[str, str]] = []
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                tgt = n.targets[0].id
+                if isinstance(n.value, ast.Call):
+                    segs = chain_segments(n.value.func)
+                    if segs:
+                        self.var_types[tgt] = {"k": "call",
+                                               "t": ".".join(segs)}
+                elif isinstance(n.value, ast.Name):
+                    src = self.var_types.get(n.value.id)
+                    if src is not None:
+                        self.var_types[tgt] = dict(src)
+                    else:
+                        self.var_types.pop(tgt, None)
+                else:
+                    # rebound to something we can't type: forget
+                    self.var_types.pop(tgt, None)
+            elif isinstance(n, ast.Call):
+                segs = chain_segments(n.func)
+                if segs and segs[-1] == "isinstance" \
+                        and len(n.args) == 2 \
+                        and isinstance(n.args[0], ast.Name) \
+                        and isinstance(n.args[1], (ast.Name,
+                                                   ast.Attribute)):
+                    t = ".".join(chain_segments(n.args[1]))
+                    if t:
+                        guards.append((n.args[0].id, t))
+            stack.extend(list(ast.iter_child_nodes(n))[::-1])
+        for var, t in guards:
+            self.var_types.setdefault(var, {"k": "isinstance", "t": t})
+
     # -- concurrency facts (GL12 / GL13) ---------------------------------
 
     def _lvalue_of(self, expr: ast.AST) -> Optional[list]:
@@ -506,10 +795,15 @@ class _FunctionCollector:
             with the locks already held at that point.
 
         The walk linearizes control flow by source order — good enough
-        for lint — with one refinement: a `while` loop's test is
+        for lint — with two refinements: a `while` loop's test is
         re-emitted after its body, so the guard-loop idiom (await
         inside the loop, condition re-checked before falling through)
-        does not read as a stale check."""
+        does not read as a stale check; and a loop body containing an
+        await is emitted TWICE (the CFG back-edge unrolled once, ISSUE
+        20), so a loop-carried race — read late in iteration i, write
+        after the await early in iteration i+1 — produces the r/a/w
+        sequence GL12 fires on. Duplicate lock_acqs/call events from
+        the unroll are harmless: GL12 and GL13 both dedup downstream."""
         for child in ast.iter_child_nodes(self.node):
             if not isinstance(child, (ast.FunctionDef,
                                       ast.AsyncFunctionDef, ast.Lambda)):
@@ -521,6 +815,19 @@ class _FunctionCollector:
             ev["lv"] = lv
         ev.update(extra)
         self.accesses.append(ev)
+
+    def _cw_lock_token(self, segs: list) -> str:
+        """Lock identity token (ISSUE 20): the attribute path, with a
+        local receiver rewritten to its allocation site when known
+        (`g = Guard(...); g.lock` -> "<Guard@12>.lock"), so two
+        instances of one class stay distinct while two aliases of one
+        instance collapse to the same identity."""
+        segs = [s for s in segs if s != "acquire"]
+        if segs and segs[0] not in ("self", "cls"):
+            site = self._cw_alloc.get(segs[0])
+            if site is not None:
+                return ".".join([f"<{site}>"] + segs[1:])
+        return ".".join(segs)
 
     def _cw_visit(self, node: ast.AST) -> None:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
@@ -535,8 +842,7 @@ class _FunctionCollector:
                 self._cw_visit(item.context_expr)
                 segs = chain_segments(item.context_expr)
                 if any(LOCK_SEG in s.lower() for s in segs):
-                    lock = ".".join(s for s in segs
-                                    if s not in ("acquire",))
+                    lock = self._cw_lock_token(segs)
                     self.lock_acqs.append({
                         "lock": lock, "line": node.lineno,
                         "held": list(self._cw_locks),
@@ -550,14 +856,49 @@ class _FunctionCollector:
             return
         if isinstance(node, ast.While):
             self._cw_visit(node.test)
-            for st in node.body:
+            # back-edge unroll (ISSUE 20): a body that awaits is
+            # emitted twice so a read late in iteration i meets the
+            # write after the await in iteration i+1
+            rounds = 2 if any(_contains_await(st)
+                              for st in node.body) else 1
+            for _ in range(rounds):
+                for st in node.body:
+                    self._cw_visit(st)
+                self._cw_visit(node.test)  # re-evaluated before exit
+            for st in node.orelse:
                 self._cw_visit(st)
-            self._cw_visit(node.test)  # re-evaluated before exit
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            self._cw_visit(node.iter)
+            rounds = 2 if any(_contains_await(st)
+                              for st in node.body) else 1
+            for _ in range(rounds):
+                for st in node.body:
+                    self._cw_visit(st)
             for st in node.orelse:
                 self._cw_visit(st)
             return
         if isinstance(node, ast.Assign):
             self._cw_visit(node.value)
+            # allocation-site tracking for lock identity (ISSUE 20)
+            if len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                tgt = node.targets[0].id
+                if isinstance(node.value, ast.Call):
+                    vsegs = chain_segments(node.value.func)
+                    if vsegs and vsegs[-1][:1].isupper():
+                        self._cw_alloc[tgt] = \
+                            f"{vsegs[-1]}@{node.value.lineno}"
+                    else:
+                        self._cw_alloc.pop(tgt, None)
+                elif isinstance(node.value, ast.Name):
+                    site = self._cw_alloc.get(node.value.id)
+                    if site is not None:
+                        self._cw_alloc[tgt] = site
+                    else:
+                        self._cw_alloc.pop(tgt, None)
+                else:
+                    self._cw_alloc.pop(tgt, None)
             # a bare True/False store is idempotent-convergent (every
             # racing task writes the same terminal flag value) — GL12
             # records but does not fire on it
@@ -678,7 +1019,8 @@ class _FunctionCollector:
         if name == "acquire" and segs[:-1] \
                 and any(LOCK_SEG in s.lower() for s in segs[:-1]):
             self.lock_acqs.append({
-                "lock": ".".join(segs[:-1]), "line": node.lineno,
+                "lock": self._cw_lock_token(segs[:-1]),
+                "line": node.lineno,
                 "held": list(self._cw_locks), "sync": False})
         if not emit_call:
             return
@@ -747,6 +1089,11 @@ class _FunctionCollector:
             "awaits_under_lock": self.awaits_under_lock,
             "accesses": self.accesses,
             "lock_acqs": self.lock_acqs,
+            "alloc_sites": {k: self._cw_alloc[k]
+                            for k in sorted(self._cw_alloc)},
+            "var_types": {k: self.var_types[k]
+                          for k in sorted(self.var_types)},
+            "cfg": build_cfg(self.node),
             "nested": {k: nested[k] for k in sorted(nested)},
         }
 

@@ -75,7 +75,11 @@ class AwaitInterleavingAtomicity(Rule):
         "across the await (pass-1 locks-at-await facts), a RE-READ of "
         "the lvalue between the await and the write (re-validation is "
         "the fix idiom), and the guard-loop `while cond: await` "
-        "(its test is re-evaluated before falling through).")
+        "(its test is re-evaluated before falling through). Since "
+        "ISSUE 20 loop bodies that await are unrolled once in the "
+        "event stream (the CFG back-edge), so the loop-carried race — "
+        "read late in iteration i, write after the await early in "
+        "iteration i+1 — fires too.")
     example_fire = ("async def start(self, h):\n"
                     "    if h not in self._inflight:\n"
                     "        fut = await self._spawn(h)\n"
@@ -247,11 +251,13 @@ class LockOrderInversion(Rule):
     name = "lock-order-inversion"
     needs_dataflow = True
     summary = ("two locks are acquired in opposite orders on different "
-               "code paths (lock identity = resolved attribute path; "
-               "acquisitions seen through `async with` / `with` / "
-               "`.acquire()`, including through resolved calls) — the "
-               "classic ABBA deadlock; pick one global order and stick "
-               "to it")
+               "code paths (lock identity = resolved attribute path "
+               "plus, since ISSUE 20, the allocation site of a local "
+               "receiver — two instances of one class are distinct, "
+               "two aliases of one instance are not; acquisitions seen "
+               "through `async with` / `with` / `.acquire()`, "
+               "including through resolved calls) — the classic ABBA "
+               "deadlock; pick one global order and stick to it")
     rationale = (
         "If path 1 holds A while taking B and path 2 holds B while "
         "taking A, two tasks can each hold one lock and wait forever "
@@ -259,7 +265,10 @@ class LockOrderInversion(Rule):
         "reproduces because it needs the exact interleaving. The rule "
         "builds a GLOBAL acquisition graph (edge A -> B = B acquired "
         "while A held, lock identity = class-qualified attribute "
-        "path, edges also found THROUGH resolved calls) and reports "
+        "path — allocation-site-qualified for locks reached through "
+        "a local constructed in the frame, so `x = Guard(); y = "
+        "Guard()` yields two identities while `y = x` aliases one — "
+        "edges also found THROUGH resolved calls) and reports "
         "every cycle with both witness chains. The fix is a single "
         "global acquisition order — usually: take the coarser lock "
         "first, or restructure so one lock is released before the "

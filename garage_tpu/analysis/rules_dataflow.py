@@ -180,7 +180,10 @@ class LeakedBudgetOnException(Rule):
         "that acquires and returns makes its CALLER the owner (the "
         "happy-path-only release there is a real leak — no false "
         "negative). This is the shape of BudgetLeaseBroker "
-        "revoke/renew and the feeder's abort paths.")
+        "revoke/renew and the feeder's abort paths. Since ISSUE 20 "
+        "the raise-capable-call check is path-sensitive over the "
+        "pass-1 CFG: a call in a dead except handler or a branch "
+        "that never reaches the release does not count.")
     example_fire = ("lease = self._rent(n)   # helper acquires+returns\n"
                     "resp = await upstream()  # raise leaks the lease\n"
                     "lease.release()")
@@ -355,8 +358,58 @@ class LeakedBudgetOnException(Rule):
         return sorted(set(evs))
 
     def _risky_between(self, fn, lo: int, hi: int):
+        """Line of a raise-capable call between acquire (lo) and
+        release (hi). Path-sensitive since ISSUE 20: with a CFG in the
+        summary the call must also sit on some control-flow path from
+        the acquire's block to the release's block — a call in a dead
+        except handler (or a sibling branch that never reaches the
+        release) no longer counts. Summaries without a CFG fall back
+        to the textual check."""
+        on_path = self._on_path_lines(fn, lo, hi)
         for rec in fn["calls"]:
             if lo < rec["line"] < hi \
-                    and rec["name"] not in RELEASE_METHODS:
+                    and rec["name"] not in RELEASE_METHODS \
+                    and (on_path is None or rec["line"] in on_path):
                 return rec["line"]
         return None
+
+    @staticmethod
+    def _on_path_lines(fn, lo: int, hi: int):
+        """Call lines inside blocks on some CFG path from the block
+        containing line `lo` to the block containing line `hi`
+        (forward-reachable from the start AND backward-reachable from
+        the end). None when the CFG cannot anchor both lines."""
+        cfg = fn.get("cfg")
+        if not cfg:
+            return None
+        blocks = {b["id"]: b for b in cfg["blocks"]}
+        start = end = None
+        for b in cfg["blocks"]:
+            if start is None and (lo in b["lines"] or lo in b["calls"]):
+                start = b["id"]
+            if end is None and (hi in b["lines"] or hi in b["calls"]):
+                end = b["id"]
+        if start is None or end is None:
+            return None
+        fwd = {start}
+        work = [start]
+        while work:
+            for s in blocks[work.pop()]["succ"]:
+                if s != -1 and s not in fwd:
+                    fwd.add(s)
+                    work.append(s)
+        preds: dict[int, list[int]] = {}
+        for b in cfg["blocks"]:
+            for s in b["succ"]:
+                preds.setdefault(s, []).append(b["id"])
+        bwd = {end}
+        work = [end]
+        while work:
+            for p in preds.get(work.pop(), ()):
+                if p not in bwd:
+                    bwd.add(p)
+                    work.append(p)
+        lines: set[int] = set()
+        for bid in fwd & bwd:
+            lines.update(blocks[bid]["calls"])
+        return lines

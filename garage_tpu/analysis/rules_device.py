@@ -1,0 +1,442 @@
+"""Device-path rules (ISSUE 20): GL14 jit-cache-key-leak, GL15
+unpadded-device-launch, GL16 loop-touch-from-stage-thread.
+
+All three encode the shape-stability and threading discipline
+DEVICE_PATH.md documents as prose, scoped to the device path itself
+(`block/`, `ops/`, `parallel/`) where the invariants are load-bearing:
+
+  * GL14 is the PR 11 leak class: a jit/compile cache keyed on
+    data-dependent runtime values — an erasure pattern, a present/
+    missing set — compiles one program PER PATTERN (C(n,k) executables
+    for RS(n,k) instead of one). The fix is always the same: key on
+    shapes/counts (pad-bucket-derived values are exempt by
+    construction) and ship the pattern as a tensor operand
+    ("pattern-as-data", ops/rs.py's `gf_apply_batched`).
+  * GL15 is the variable-shape trap: a `device_put` / batched-kernel
+    launch whose operand was sized from raw lengths (`len(...)`,
+    `max(...)`) instead of routing through the `pad_buckets` ladder
+    (`bucket_items` / `bucket_len`) — every distinct size is a fresh
+    XLA compile and a cache entry that never repeats.
+  * GL16 is the stage-thread/loop boundary: functions the
+    StageExecutor runs on its worker threads must never touch asyncio
+    primitives directly (`call_soon`, `create_task`, `set_result`,
+    ...) — the loop is not thread-safe; the ONLY sanctioned crossings
+    are `call_soon_threadsafe` / `run_coroutine_threadsafe`
+    (device_backend.py's delivery seam).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import FileContext, ProjectState, Rule, Violation, \
+    chain_segments
+from .rules_dataflow import _dataflow, _is_checked_file
+
+# the device path: where shape stability and stage-thread discipline
+# are load-bearing (matches the ISSUE 20 scope — api/model/qos code
+# has no jit caches or stage threads to misuse)
+_DEVICE_PREFIXES = ("garage_tpu/block/", "garage_tpu/ops/",
+                    "garage_tpu/parallel/")
+
+# data-dependent erasure-pattern identifiers: the values PR 11's leak
+# keyed a jit cache on (a tuple of shard indices changes per request;
+# a shape or count does not)
+_PATTERN_RE = re.compile(r"(^|_)(present|missing|pattern|patterns)($|_)")
+
+# identifiers that mark a value as routed through the pad ladder
+_PAD_SOURCES = {"bucket_items", "bucket_len"}
+_PAD_NAME_RE = re.compile(r"(^|_)pad")
+
+# array allocators whose shape arguments decide the compiled program
+_ALLOC_METHODS = {"zeros", "empty", "ones", "full", "frombuffer",
+                  "zeros_like", "empty_like"}
+
+# raw-size evidence inside an allocation's shape arguments
+_SIZE_CALLS = {"len", "max"}
+
+# loop-affine asyncio primitives a stage thread must not touch; the
+# *_threadsafe crossings are sanctioned by name
+_UNSAFE_LOOP_CALLS = {"call_soon", "call_at", "call_later",
+                      "create_task", "ensure_future", "create_future",
+                      "set_result", "set_exception", "put_nowait"}
+
+# methods a *Backend class runs on the stage executor's worker threads
+_STAGE_METHODS = {"stage", "compute", "readback"}
+
+
+def _device_scoped(rel_path: str) -> bool:
+    # segment-anchored rather than startswith so files scanned from
+    # outside the repo root (rel_path led by ../) still scope
+    p = "/" + rel_path.replace("\\", "/")
+    return any(f"/{pfx}" in p for pfx in _DEVICE_PREFIXES)
+
+
+def _own_scopes(root: ast.AST):
+    """Yield (scope_node, [statements]) for the module/function and
+    every function under it — each function's body is ONE scope; its
+    nested defs are their own."""
+    yield root, _scope_stmts(root)
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield n, _scope_stmts(n)
+        if not isinstance(n, ast.Lambda):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _scope_stmts(scope: ast.AST) -> list:
+    """Statements of a scope in source order, flattened through
+    compound statements but NOT into nested defs/lambdas."""
+    out = []
+    stack = list(getattr(scope, "body", []))[::-1]
+    while stack:
+        st = stack.pop()
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda, ast.ClassDef)):
+            continue
+        out.append(st)
+        for field in ("body", "orelse", "finalbody"):
+            stack.extend(list(getattr(st, field, []))[::-1])
+        for h in getattr(st, "handlers", []):
+            stack.extend(list(h.body)[::-1])
+    return out
+
+
+def _walk_scope_exprs(node: ast.AST, skip_len: bool = False):
+    """Walk an expression/statement without descending into nested
+    defs/lambdas; optionally skip len()/max() call arguments (a
+    len(pattern) key is a COUNT, not the pattern)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)) and n is not node:
+            continue
+        if skip_len and isinstance(n, ast.Call) \
+                and isinstance(n.func, ast.Name) \
+                and n.func.id in _SIZE_CALLS:
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _pattern_names(expr: ast.AST) -> list[str]:
+    """Pattern-named identifiers in an expression, excluding those
+    consumed by len()/max() (counts are shape-stable)."""
+    names = {n.id for n in _walk_scope_exprs(expr, skip_len=True)
+             if isinstance(n, ast.Name) and _PATTERN_RE.search(n.id)}
+    return sorted(names)
+
+
+class JitCacheKeyLeak(Rule):
+    id = "GL14"
+    name = "jit-cache-key-leak"
+    summary = ("a jit/compile cache keyed on data-dependent runtime "
+               "values — an `@lru_cache` whose parameters carry an "
+               "erasure pattern (present/missing sets) into a jitted "
+               "body, or a cache subscript whose key f-string/tuple "
+               "embeds one — compiles one program PER PATTERN (the "
+               "PR 11 leak: C(n,k) executables for RS(n,k)); key on "
+               "shapes/counts (pad-bucket values are exempt) and ship "
+               "the pattern as a tensor operand")
+    rationale = (
+        "PR 11 hand-fixed exactly this: the decode path cached jitted "
+        "programs under `f\"dec{k},{m},{present}\"`, so RS(10,4) "
+        "could compile and retain 1001 distinct executables — the "
+        "compile cache became an unbounded leak keyed on request "
+        "data. The discipline that replaced it (ops/rs.py "
+        "`gf_apply_batched`) keys compiles on SHAPES only and passes "
+        "the pattern as a device tensor, so the 1001 patterns share "
+        "one program. This rule pins that discipline: an lru_cache/"
+        "cache-decorated function whose parameters match present/"
+        "missing/pattern AND whose body builds a jit program fires, "
+        "as does a subscript store/load on a jit/compile-cache "
+        "container whose key expression embeds a pattern-named "
+        "value. `len(present)` keys are counts (shape-stable) and "
+        "stay quiet, as do pad-bucket-derived shape keys.")
+    example_fire = ("@functools.lru_cache(maxsize=None)\n"
+                    "def make_step(mesh, k, m, present, missing):\n"
+                    "    return jax.jit(step)   # one program/pattern")
+    example_ok = ("@functools.lru_cache(maxsize=None)\n"
+                  "def make_step(mesh, k, m, shard_len):\n"
+                  "    return jax.jit(step)  # shape-keyed\n"
+                  "# pattern ships as data: step(bitmats_t, shards)")
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        return not ctx.is_test and _device_scoped(ctx.rel_path)
+
+    def finish_file(self, ctx: FileContext) -> None:
+        for scope, stmts in _own_scopes(ctx.tree):
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_cached_def(ctx, scope)
+            self._check_key_subscripts(ctx, scope, stmts)
+
+    # -- part A: @lru_cache def with pattern params + jitted body --------
+
+    def _check_cached_def(self, ctx: FileContext,
+                          node: ast.AST) -> None:
+        cached = any(
+            segs and segs[-1] in ("lru_cache", "cache")
+            for d in node.decorator_list
+            for segs in [chain_segments(d)])
+        if not cached:
+            return
+        a = node.args
+        pat = sorted(arg.arg for arg in
+                     (a.posonlyargs + a.args + a.kwonlyargs)
+                     if _PATTERN_RE.search(arg.arg))
+        if not pat:
+            return
+        jitted = any(
+            isinstance(n, (ast.Name, ast.Attribute))
+            and "jit" in (n.id if isinstance(n, ast.Name)
+                          else n.attr).lower()
+            for st in node.body for n in _walk_scope_exprs(st))
+        if not jitted:
+            return
+        ctx.report(self.id, node, (
+            f"`@lru_cache` on `{node.name}` is keyed on data-dependent "
+            f"pattern parameter(s) {', '.join(pat)} while the body "
+            "builds a jit program — one compiled executable per "
+            "pattern (the PR 11 leak class, C(n,k) programs for "
+            "RS(n,k)); key the cache on shapes/counts and ship the "
+            "pattern as a tensor operand"))
+
+    # -- part B: cache[key] with a pattern baked into the key ------------
+
+    def _check_key_subscripts(self, ctx: FileContext, scope: ast.AST,
+                              stmts: list) -> None:
+        key_vars: dict[str, ast.AST] = {}
+        for st in stmts:
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name) \
+                    and isinstance(st.value, (ast.JoinedStr, ast.Tuple)):
+                key_vars[st.targets[0].id] = st.value
+        for st in stmts:
+            for n in _walk_scope_exprs(st):
+                if not isinstance(n, ast.Subscript):
+                    continue
+                segs = chain_segments(n.value)
+                if not any("cache" in s.lower() or "jit" in s.lower()
+                           for s in segs):
+                    continue
+                key = n.slice
+                if isinstance(key, ast.Name) and key.id in key_vars:
+                    key = key_vars[key.id]
+                names = _pattern_names(key)
+                if names:
+                    ctx.report(self.id, n, (
+                        f"compile-cache key on `{'.'.join(segs)}` "
+                        f"embeds data-dependent value(s) "
+                        f"{', '.join(names)} — one cached program per "
+                        "pattern (the PR 11 leak); use shape/count "
+                        "keys and pass the pattern as data"))
+
+
+class UnpaddedDeviceLaunch(Rule):
+    id = "GL15"
+    name = "unpadded-device-launch"
+    summary = ("a device_put / batched-kernel launch whose operand was "
+               "sized from raw lengths (len()/max()) instead of the "
+               "pad_buckets ladder (bucket_items/bucket_len) — every "
+               "distinct size is a fresh XLA compile that never "
+               "repeats; round sizes through the bucket helpers so the "
+               "shape set stays closed")
+    rationale = (
+        "XLA compiles per SHAPE: feeding a device a (n_blobs, "
+        "max_len) array sized straight from the request recompiles "
+        "on nearly every batch and fills the compile cache with "
+        "programs that never repeat (DEVICE_PATH.md's variable-shape "
+        "trap — the reason the pad-bucket ladder exists). The feeder "
+        "discipline routes every staged shape through bucket_items/"
+        "bucket_len so the reachable shape set is small and closed, "
+        "and zero new compiles happen after warmup. This rule flags "
+        "an operand allocated with raw len()/max() sizes reaching "
+        "device_put or the batched GF kernel without touching the "
+        "ladder.")
+    example_fire = ("buf = np.zeros((len(blobs), max_len))\n"
+                    "dev = jax.device_put(buf)  # shape per request")
+    example_ok = ("b, padded = bucket_items(len(blobs), buckets)\n"
+                  "buf = np.zeros((b, padded))\n"
+                  "dev = jax.device_put(buf)  # bucketed shape")
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        return not ctx.is_test and _device_scoped(ctx.rel_path)
+
+    def finish_file(self, ctx: FileContext) -> None:
+        for scope, stmts in _own_scopes(ctx.tree):
+            self._check_scope(ctx, stmts)
+
+    def _assigned_names(self, target: ast.AST) -> list[str]:
+        if isinstance(target, ast.Name):
+            return [target.id]
+        if isinstance(target, (ast.Tuple, ast.List)):
+            return [e.id for e in target.elts if isinstance(e, ast.Name)]
+        return []
+
+    def _check_scope(self, ctx: FileContext, stmts: list) -> None:
+        padded: set[str] = set()
+        raw: set[str] = set()
+        # two monotone passes so `smax` defined after first use of the
+        # helper chain still classifies (mirrors pass 1's taint walk)
+        for _ in range(2):
+            for st in stmts:
+                if not isinstance(st, ast.Assign):
+                    continue
+                names = [n for t in st.targets
+                         for n in self._assigned_names(t)]
+                if not names:
+                    continue
+                v = st.value
+                mentions = {n.id for n in _walk_scope_exprs(v)
+                            if isinstance(n, ast.Name)}
+                pad_call = any(
+                    isinstance(n, ast.Call) and (
+                        (cs := chain_segments(n.func))
+                        and (cs[-1] in _PAD_SOURCES
+                             or _PAD_NAME_RE.search(cs[-1])))
+                    for n in _walk_scope_exprs(v))
+                if pad_call or mentions & padded:
+                    padded.update(names)
+                    raw.difference_update(names)
+                    continue
+                alloc = isinstance(v, ast.Call) and (
+                    (cs := chain_segments(v.func))
+                    and cs[-1] in _ALLOC_METHODS)
+                size_call = any(
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Name)
+                    and n.func.id in _SIZE_CALLS
+                    for n in _walk_scope_exprs(v))
+                if (alloc and size_call) or mentions & raw:
+                    raw.update(names)
+        for st in stmts:
+            for n in _walk_scope_exprs(st):
+                if not isinstance(n, ast.Call):
+                    continue
+                segs = chain_segments(n.func)
+                cname = segs[-1] if segs else ""
+                if cname not in ("device_put", "gf_apply_batched"):
+                    continue
+                for a in n.args:
+                    if isinstance(a, ast.Name) and a.id in raw \
+                            and a.id not in padded:
+                        ctx.report(self.id, n, (
+                            f"`{cname}({a.id})` launches an operand "
+                            f"sized from raw len()/max() — `{a.id}` "
+                            "never routed through the pad_buckets "
+                            "ladder (bucket_items/bucket_len), so "
+                            "every distinct size compiles a fresh "
+                            "program; round the shape through the "
+                            "bucket helpers"))
+                        break
+
+
+class LoopTouchFromStageThread(Rule):
+    id = "GL16"
+    name = "loop-touch-from-stage-thread"
+    needs_dataflow = True
+    summary = ("code reachable on a device stage thread (a *Backend "
+               "stage/compute/readback method, or a function submitted "
+               "to the stage executor) calls a loop-affine asyncio "
+               "primitive (call_soon, create_task, set_result, ...) — "
+               "the event loop is not thread-safe off-loop; the only "
+               "sanctioned crossings are call_soon_threadsafe / "
+               "run_coroutine_threadsafe")
+    rationale = (
+        "DevicePipeline runs each stage on a dedicated worker thread "
+        "(StageExecutor); the asyncio loop those stages report back "
+        "to lives on the main thread. Every asyncio primitive except "
+        "the *_threadsafe pair assumes it is called ON the loop "
+        "thread — a stage function calling loop.call_soon or "
+        "fut.set_result directly corrupts the loop's internal state "
+        "or races its wakeup pipe, and the failure is a heisenbug "
+        "(device_backend.py's delivery seam exists precisely to "
+        "funnel results through loop.call_soon_threadsafe). The rule "
+        "walks sync call-graph edges from every stage-executed root "
+        "and flags loop-affine calls it can reach.")
+    example_fire = ("class JaxDeviceBackend:\n"
+                    "    def readback(self, op, handle):\n"
+                    "        self.loop.call_soon(self._deliver, out)")
+    example_ok = ("class JaxDeviceBackend:\n"
+                  "    def readback(self, op, handle):\n"
+                  "        self.loop.call_soon_threadsafe(\n"
+                  "            self._deliver, out)")
+
+    def finish_project(self, project: ProjectState) -> list[Violation]:
+        df = _dataflow(project)
+        if df is None:
+            return []
+        g = df.graph
+        file_ok: dict[str, bool] = {}
+
+        def checked(path: str) -> bool:
+            if path not in file_ok:
+                file_ok[path] = _is_checked_file(project, path)
+            return file_ok[path]
+
+        roots: dict[str, str] = {}
+        for fid in sorted(g.functions):
+            fn = g.functions[fid]
+            if not _device_scoped(fn["path"]) or not checked(fn["path"]):
+                continue
+            cls = fn.get("class") or ""
+            if cls.endswith("Backend") and fn["name"] in _STAGE_METHODS:
+                roots.setdefault(
+                    fid, f"`{cls}.{fn['name']}` runs on a stage "
+                         "executor worker thread")
+            for rec in fn["calls"]:
+                if rec["name"] != "submit":
+                    continue
+                for ad in rec["args"]:
+                    if not ad or "n" not in ad:
+                        continue
+                    cal = g.resolve_ref(fid, ["name", ad["n"]])
+                    if cal is not None:
+                        roots.setdefault(
+                            cal, f"submitted to the stage executor in "
+                                 f"`{fn['qualname']}`")
+
+        out: list[Violation] = []
+        fired: set[tuple] = set()
+        for root in sorted(roots):
+            why = roots[root]
+            seen: set[str] = set()
+            stack = [root]
+            while stack:
+                cur = stack.pop()
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                fn = g.functions[cur]
+                if checked(fn["path"]):
+                    for rec in fn["calls"]:
+                        if rec["name"] in _UNSAFE_LOOP_CALLS \
+                                and rec["recv"]:
+                            key = (fn["path"], rec["line"], rec["name"])
+                            if key in fired:
+                                continue
+                            fired.add(key)
+                            out.append(Violation(
+                                rule=self.id, path=fn["path"],
+                                line=rec["line"], col=0,
+                                message=(
+                                    f"asyncio `{rec['name']}` called "
+                                    "from code reachable on a device "
+                                    f"stage thread ({why}) — loop-"
+                                    "affine primitives are not thread-"
+                                    "safe off-loop; cross via loop."
+                                    "call_soon_threadsafe(...) or "
+                                    "asyncio.run_coroutine_threadsafe"
+                                    "(...)"),
+                                context=fn["qualname"]))
+                for nxt, rec in g.edges_from(cur):
+                    if rec["via_thread"] or rec["awaited"]:
+                        continue
+                    if g.functions[nxt]["is_async"]:
+                        continue
+                    stack.append(nxt)
+        return out

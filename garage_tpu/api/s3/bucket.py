@@ -27,7 +27,6 @@ async def handle_create_bucket(helper, bucket_name: str, api_key,
         except (ET.ParseError, UnicodeDecodeError):
             raise S3Error("MalformedXML", 400,
                           "Invalid create bucket XML query")
-        # lint: ignore[GL10] ElementTree Element.iter (in-memory XML walk), not db.Tree.iter — unique-method CHA mis-resolves the bare receiver
         for c in root.iter():
             if c.tag.endswith("LocationConstraint") and c.text \
                     and c.text.strip() and c.text.strip() != region:

@@ -330,6 +330,7 @@ async def save_stream(garage, bucket_id: bytes, key: str, headers: dict,
         if content_md5 is not None and not _md5_matches(content_md5, etag):
             raise bad_request("Content-MD5 mismatch")
         if checksummer is not None:
+            # lint: ignore[GL10] first update may lazily build+dlopen the native CRC lib (one-time, lock-guarded); steady state is an in-memory table update
             checksummer.update(first_block)
             if checksummer.b64() != expected_checksum[1]:
                 raise bad_request("checksum mismatch")

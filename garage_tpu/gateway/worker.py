@@ -235,6 +235,7 @@ class GatewayWorkerClient:
         while not self._stopped:
             await asyncio.sleep(self.interval)
             try:
+                # lint: ignore[GL12] single-task loop — only this coroutine calls _renew_once, so its lease/_last_ok writes never interleave with the except-path reads
                 await self._renew_once()
             except Exception as e:
                 log.debug("lease renew failed: %s", e)

@@ -549,6 +549,7 @@ class Conn:
             while True:
                 item = self._pick_item()
                 if item is None:
+                    # lint: ignore[GL12] wakeup handshake: clear, re-check _pick_item, then wait — a set() racing the clear is caught by the re-check; a spurious wake costs one loop turn
                     self._send_wakeup.clear()
                     # re-check: a prefetch may have completed in between
                     if self._pick_item() is None:

@@ -247,25 +247,46 @@ def make_scrub_step(mesh, k: int, m: int, shard_len: int):
 
 
 @functools.lru_cache(maxsize=None)
+def _repair_apply_step(mesh, n: int, shard_len: int):
+    """Shape-keyed inner jit for make_repair_step: (mat_bits, surviving)
+    -> (rebuilt, hashes). Keyed on mesh/shape facts only, never on the
+    erasure pattern — the repair matrix is a traced operand."""
+    import jax
+
+    n_chunks = shard_len // treehash.CHUNK_LEN
+    bytes_sh, _, _ = _layouts(mesh, n, shard_len)
+
+    def step(mat_bits, surviving):
+        surviving = jax.lax.with_sharding_constraint(surviving, bytes_sh)
+        rebuilt = gf256.bit_matmul_apply(mat_bits, surviving)  # (B, |missing|, S)
+        hashes = _hash_all_shards(rebuilt, n_chunks)
+        return rebuilt, hashes
+
+    return jax.jit(step, in_shardings=(_sh(mesh), bytes_sh))
+
+
 def make_repair_step(
     mesh, k: int, m: int, present: tuple[int, ...], missing: tuple[int, ...], shard_len: int
 ):
     """Jitted repair: rebuild `missing` shards from the k `present` ones
     and return them with fresh hashes. Degraded-read/resync math: where
     the reference re-fetches whole replicas (src/block/resync.rs:354-505),
-    erasure mode decodes any k of n on device."""
+    erasure mode decodes any k of n on device.
+
+    The per-pattern repair matrix rides as a tensor operand into a
+    shape-keyed jitted apply: every (present, missing) pattern of the
+    same size shares ONE compiled program, where the old per-pattern
+    lru_cache compiled (and pinned a step for) each of the
+    O(n choose k) patterns a degraded cluster can walk through."""
     import jax
 
     if shard_len % treehash.CHUNK_LEN:
         raise ValueError(f"shard_len must be a multiple of {treehash.CHUNK_LEN}")
-    n_chunks = shard_len // treehash.CHUNK_LEN
     mat_bits = gf256.bitmat_t_for(rs.repair_matrix(k, m, present, missing))
-    bytes_sh, _, _ = _layouts(mesh, k + m, shard_len)
+    mat_bits = jax.device_put(mat_bits, _sh(mesh))
+    apply_step = _repair_apply_step(mesh, k + m, shard_len)
 
     def step(surviving):  # (B, k, S) rows `present` in ascending order
-        surviving = jax.lax.with_sharding_constraint(surviving, bytes_sh)
-        rebuilt = gf256.bit_matmul_apply(mat_bits, surviving)  # (B, |missing|, S)
-        hashes = _hash_all_shards(rebuilt, n_chunks)
-        return rebuilt, hashes
+        return apply_step(mat_bits, surviving)
 
-    return jax.jit(step, in_shardings=bytes_sh)
+    return step

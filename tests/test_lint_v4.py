@@ -1,0 +1,651 @@
+"""ISSUE 20: summary-v4 engine upgrades + device-path rules.
+
+Covers: GL12 loop-carried races (back-edge unroll) with the re-read
+suppressor, GL13 allocation-site lock identity (two instances fire,
+aliases don't), GL11 path-sensitivity over the new CFG (dead except
+handlers stop firing), import-aware receiver typing (the bucket.py
+ET.Element.iter mis-resolution class), GL14/GL15/GL16 fire+suppress
+fixtures, the real-CLI exit-1 pins, SARIF output, multi-rule
+--fix-waivers, and byte-determinism + cache round-trip over the new
+cfg/alloc_sites/var_types summary fields under SUMMARY_VERSION 4.
+"""
+
+import ast
+import json
+import textwrap
+
+from garage_tpu.analysis import (analyze_source, default_rules,
+                                 summarize_tree, summary_json)
+from garage_tpu.analysis.dataflow import SUMMARY_VERSION, build_cfg
+
+
+def run(src: str, rel_path: str = "garage_tpu/fake/mod.py"):
+    ctx = analyze_source(textwrap.dedent(src), default_rules(),
+                         rel_path=rel_path)
+    return [v for v in ctx.violations if v.active]
+
+
+def rules_of(violations):
+    return sorted({v.rule for v in violations})
+
+
+def _cli_rc_on(tmp_path, source: str, rel: str) -> int:
+    from garage_tpu.analysis.__main__ import main
+
+    target = tmp_path / rel
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(textwrap.dedent(source))
+    return main(["--baseline", "none", str(target)])
+
+
+# ---- GL12 loop-carried (back-edge unroll) -------------------------------
+
+GL12_LOOP_CARRIED = """
+    class P:
+        async def pump(self):
+            while self._more:
+                await self.flush()
+                self._cur = self.take()
+                last = self._cur
+"""
+
+
+def test_gl12_loop_carried_race_fires():
+    # read late in iteration i (line 7), write after the await in
+    # iteration i+1 (line 6) — invisible to a linear event stream,
+    # caught by the one-round unroll
+    vs = run(GL12_LOOP_CARRIED)
+    assert rules_of(vs) == ["GL12"]
+    assert "self._cur" in vs[0].message
+    assert "awaited" in vs[0].message
+
+
+def test_gl12_loop_carried_reread_suppresses():
+    # the fix idiom survives the unroll: iteration i+1 re-reads the
+    # lvalue between its await and its write
+    vs = run("""
+        class P:
+            async def pump(self):
+                while self._more:
+                    await self.flush()
+                    cur = self._cur
+                    self._cur = self.advance(cur)
+    """)
+    assert vs == []
+
+
+def test_gl12_awaitless_loop_not_unrolled():
+    # no await in the body -> no preemption point inside the loop ->
+    # nothing to unroll, stays quiet
+    vs = run("""
+        class P:
+            def drain(self):
+                while self._more:
+                    self._cur = self.take()
+                    last = self._cur
+    """)
+    assert vs == []
+
+
+def test_cli_gl12_loop_carried_exits_1(tmp_path, capsys):
+    rc = _cli_rc_on(tmp_path, GL12_LOOP_CARRIED,
+                    "garage_tpu/block/fake_pump.py")
+    assert rc == 1
+    assert "GL12" in capsys.readouterr().out
+
+
+# ---- GL13 allocation-site lock identity ---------------------------------
+
+GL13_TWO_INSTANCES = """
+    class Guard:
+        pass
+
+    def crisscross():
+        lock_a = Guard()
+        lock_b = Guard()
+        with lock_a:
+            with lock_b:
+                pass
+        with lock_b:
+            with lock_a:
+                pass
+"""
+
+
+def test_gl13_two_instances_of_one_class_fire():
+    # two Guard() instances ARE two locks: opposite orders cycle
+    vs = run(GL13_TWO_INSTANCES)
+    assert rules_of(vs) == ["GL13"]
+    assert "<Guard@" in vs[0].message
+
+
+def test_gl13_aliased_lock_is_one_identity_no_cycle():
+    # lock_b aliases lock_a: both with-items resolve to the SAME
+    # allocation site, so there is no a->b edge and no false ABBA
+    # (name-level identity used to manufacture one)
+    vs = run("""
+        class Guard:
+            pass
+
+        def fwd():
+            lock_a = Guard()
+            lock_b = lock_a
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def rev():
+            lock_a = Guard()
+            lock_b = lock_a
+            with lock_b:
+                with lock_a:
+                    pass
+    """)
+    assert vs == []
+
+
+def test_gl13_rebound_name_drops_its_site():
+    # rebinding to a non-constructor value forgets the site: identity
+    # falls back to the name, and consistent order stays quiet
+    vs = run("""
+        class Guard:
+            pass
+
+        def f(pool):
+            lock_a = Guard()
+            lock_a = pool.pick()
+            lock_b = Guard()
+            with lock_a:
+                with lock_b:
+                    pass
+            with lock_a:
+                with lock_b:
+                    pass
+    """)
+    assert vs == []
+
+
+def test_cli_gl13_two_instances_exits_1(tmp_path, capsys):
+    rc = _cli_rc_on(tmp_path, GL13_TWO_INSTANCES,
+                    "garage_tpu/gateway/fake_guards.py")
+    assert rc == 1
+    assert "GL13" in capsys.readouterr().out
+
+
+# ---- GL11 path-sensitivity over the CFG ---------------------------------
+
+def test_gl11_risky_call_in_dead_handler_is_off_path():
+    # the await sits in an except handler no try-body statement can
+    # raise into: it is CFG-unreachable between acquire and release,
+    # so the release is NOT at risk (textual betweenness used to fire)
+    vs = run("""
+        class F:
+            async def ok(self, n):
+                tok = await self.bucket.acquire(n)
+                try:
+                    size = n + 1
+                except ValueError:
+                    await self.audit(n)
+                self.bucket.refund(n)
+                return size
+    """)
+    assert vs == []
+
+
+def test_gl11_risky_call_on_the_real_path_still_fires():
+    vs = run("""
+        class F:
+            async def bad(self, n):
+                tok = await self.bucket.acquire(n)
+                await self.audit(n)
+                self.bucket.refund(n)
+    """)
+    assert rules_of(vs) == ["GL11"]
+
+
+def test_cfg_dead_handler_has_no_incoming_edge():
+    # the structural fact GL11 relies on, pinned at the CFG level
+    src = textwrap.dedent("""
+        def f(n):
+            try:
+                size = n + 1
+            except ValueError:
+                cleanup()
+            return size
+    """)
+    fn = ast.parse(src).body[0]
+    cfg = build_cfg(fn)
+    handler = [b for b in cfg["blocks"] if 5 in b["lines"]]
+    assert handler, "handler block exists"
+    hid = handler[0]["id"]
+    assert all(hid not in b["succ"] for b in cfg["blocks"])
+
+
+def test_cfg_loop_back_edges_are_marked():
+    src = textwrap.dedent("""
+        def f(xs):
+            total = 0
+            for x in xs:
+                total += x
+            return total
+    """)
+    fn = ast.parse(src).body[0]
+    cfg = build_cfg(fn)
+    assert any(b["back"] for b in cfg["blocks"])
+
+
+# ---- import-aware receiver typing (the bucket.py class) -----------------
+
+BUCKET_SHAPE = """
+    import xml.etree.ElementTree as ET
+
+    class Tree:
+        blocking_api = True
+
+        def iter(self):
+            return []
+
+    async def parse(body):
+        root = ET.fromstring(body.decode())
+        for c in root.iter():
+            pass
+"""
+
+
+def test_external_typed_receiver_beats_unique_method_cha():
+    # `root` is constructor-typed from an out-of-project import:
+    # root.iter() must NOT resolve to the project-unique (and
+    # blocking) Tree.iter — the exact api/s3/bucket.py mis-resolution
+    # whose waiver this PR deletes
+    vs = run(BUCKET_SHAPE, rel_path="garage_tpu/api/s3/fake_bucket.py")
+    assert vs == []
+
+
+def test_reintroduced_bucket_waiver_goes_stale():
+    # the retired waiver must not come back silently: with typed
+    # receivers the finding is gone, so the waiver suppresses nothing
+    # and GL00 flags it
+    vs = run("""
+        import xml.etree.ElementTree as ET
+
+        class Tree:
+            blocking_api = True
+
+            def iter(self):
+                return []
+
+        async def parse(body):
+            root = ET.fromstring(body.decode())
+            # lint: ignore[GL10] ET walk, not db.Tree.iter
+            for c in root.iter():
+                pass
+    """, rel_path="garage_tpu/api/s3/fake_bucket.py")
+    assert rules_of(vs) == ["GL00"]
+    assert "stale waiver for GL10" in vs[0].message
+
+
+def test_constructor_typed_receiver_resolves_in_project():
+    # the same mechanism, positive direction: a receiver typed by an
+    # in-project constructor resolves to that class's method
+    vs = run("""
+        class Tree:
+            blocking_api = True
+
+            def iter(self):
+                return []
+
+        async def scan():
+            t = Tree()
+            for r in t.iter():
+                pass
+    """)
+    assert rules_of(vs) == ["GL10"]
+    assert "iter" in vs[0].message
+
+
+def test_annotation_typed_receiver_resolves_in_project():
+    vs = run("""
+        class Tree:
+            blocking_api = True
+
+            def iter(self):
+                return []
+
+        async def scan(t: Tree):
+            for r in t.iter():
+                pass
+    """)
+    assert rules_of(vs) == ["GL10"]
+
+
+def test_isinstance_guard_types_a_receiver():
+    vs = run("""
+        class Tree:
+            blocking_api = True
+
+            def iter(self):
+                return []
+
+        async def scan(t):
+            if isinstance(t, Tree):
+                for r in t.iter():
+                    pass
+    """)
+    assert rules_of(vs) == ["GL10"]
+
+
+# ---- GL14 jit-cache-key-leak --------------------------------------------
+
+GL14_CACHED_BUILDER = """
+    import functools
+
+    @functools.lru_cache(maxsize=None)
+    def make_step(mesh, k, m, present, missing):
+        import jax
+
+        def step(x):
+            return x
+
+        return jax.jit(step)
+"""
+
+
+def test_gl14_pattern_keyed_cached_builder_fires():
+    vs = run(GL14_CACHED_BUILDER,
+             rel_path="garage_tpu/parallel/fake_make.py")
+    assert rules_of(vs) == ["GL14"]
+    assert "present" in vs[0].message and "missing" in vs[0].message
+
+
+def test_gl14_shape_keyed_builder_is_quiet():
+    vs = run("""
+        import functools
+
+        @functools.lru_cache(maxsize=None)
+        def make_step(mesh, k, m, shard_len):
+            import jax
+
+            def step(x):
+                return x
+
+            return jax.jit(step)
+    """, rel_path="garage_tpu/parallel/fake_make.py")
+    assert vs == []
+
+
+def test_gl14_pattern_params_without_jit_are_quiet():
+    # host-side matrix caches key on the pattern on purpose (tiny
+    # numpy inverses) — no jit in the body, no leak
+    vs = run("""
+        import functools
+
+        @functools.lru_cache(maxsize=None)
+        def repair_matrix(k, m, present, missing):
+            return invert(k, m, present, missing)
+    """, rel_path="garage_tpu/ops/fake_rs.py")
+    assert vs == []
+
+
+def test_gl14_subscript_key_embedding_pattern_fires():
+    vs = run("""
+        class D:
+            def get(self, k, present):
+                key = (k, present)
+                return self._jit_cache[key]
+    """, rel_path="garage_tpu/ops/fake_rs.py")
+    assert rules_of(vs) == ["GL14"]
+
+
+def test_gl14_len_of_pattern_key_is_a_count_quiet():
+    vs = run("""
+        class D:
+            def get(self, k, present):
+                key = (k, len(present))
+                return self._jit_cache[key]
+    """, rel_path="garage_tpu/ops/fake_rs.py")
+    assert vs == []
+
+
+def test_gl14_outside_device_path_is_quiet():
+    vs = run(GL14_CACHED_BUILDER, rel_path="garage_tpu/api/fake.py")
+    assert vs == []
+
+
+def test_cli_gl14_seeded_fixture_exits_1(tmp_path, capsys):
+    rc = _cli_rc_on(tmp_path, GL14_CACHED_BUILDER,
+                    "garage_tpu/parallel/fake_make.py")
+    assert rc == 1
+    assert "GL14" in capsys.readouterr().out
+
+
+# ---- GL15 unpadded-device-launch ----------------------------------------
+
+def test_gl15_raw_sized_operand_fires():
+    vs = run("""
+        import numpy as np
+
+        def launch(blobs):
+            buf = np.zeros((len(blobs), 256), dtype=np.uint8)
+            return device_put(buf)
+    """, rel_path="garage_tpu/block/fake_launch.py")
+    assert rules_of(vs) == ["GL15"]
+    assert "buf" in vs[0].message
+
+
+def test_gl15_bucketed_operand_is_quiet():
+    vs = run("""
+        import numpy as np
+
+        def launch(blobs, buckets):
+            n, padded = bucket_items(len(blobs), buckets)
+            buf = np.zeros((n, padded), dtype=np.uint8)
+            return device_put(buf)
+    """, rel_path="garage_tpu/block/fake_launch.py")
+    assert vs == []
+
+
+def test_gl15_taint_flows_through_assignment():
+    vs = run("""
+        import numpy as np
+
+        def launch(blobs):
+            raw = np.empty((len(blobs), 64), dtype=np.uint8)
+            staged = raw
+            return gf_apply_batched(staged)
+    """, rel_path="garage_tpu/ops/fake_launch.py")
+    assert rules_of(vs) == ["GL15"]
+
+
+# ---- GL16 loop-touch-from-stage-thread ----------------------------------
+
+def test_gl16_stage_method_touching_loop_fires():
+    vs = run("""
+        class FakeDeviceBackend:
+            def readback(self, fut, out):
+                self.loop.call_soon(fut.set_result, out)
+    """, rel_path="garage_tpu/block/fake_backend.py")
+    assert rules_of(vs) == ["GL16"]
+    assert "call_soon" in vs[0].message
+
+
+def test_gl16_threadsafe_crossing_is_sanctioned():
+    vs = run("""
+        class FakeDeviceBackend:
+            def readback(self, fut, out):
+                self.loop.call_soon_threadsafe(self._done, fut, out)
+    """, rel_path="garage_tpu/block/fake_backend.py")
+    assert vs == []
+
+
+def test_gl16_reaches_through_sync_helpers():
+    vs = run("""
+        class FakeDeviceBackend:
+            def compute(self, op):
+                self._deliver(op)
+
+            def _deliver(self, op):
+                self.loop.call_soon(self._done, op)
+    """, rel_path="garage_tpu/block/fake_backend.py")
+    assert rules_of(vs) == ["GL16"]
+
+
+def test_gl16_same_code_off_device_path_is_quiet():
+    vs = run("""
+        class FakeDeviceBackend:
+            def readback(self, fut, out):
+                self.loop.call_soon(fut.set_result, out)
+    """, rel_path="garage_tpu/gateway/fake_backend.py")
+    assert vs == []
+
+
+# ---- CLI surfaces: SARIF, --explain, --fix-waivers ----------------------
+
+def test_sarif_output_on_seeded_violation(tmp_path, capsys):
+    from garage_tpu.analysis.__main__ import main
+
+    target = tmp_path / "garage_tpu" / "parallel" / "fake_make.py"
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(textwrap.dedent(GL14_CACHED_BUILDER))
+    rc = main(["--baseline", "none", "--format", "sarif", str(target)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["version"] == "2.1.0"
+    driver = doc["runs"][0]["tool"]["driver"]
+    assert driver["name"] == "garage-lint"
+    assert {"GL14", "GL15", "GL16"} <= {r["id"] for r in driver["rules"]}
+    res = doc["runs"][0]["results"]
+    assert res and res[0]["ruleId"] == "GL14"
+    loc = res[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("fake_make.py")
+    assert isinstance(loc["region"]["startLine"], int)
+
+
+def test_explain_covers_device_rules(capsys):
+    from garage_tpu.analysis.__main__ import main
+
+    for rule in ("GL14", "GL15", "GL16"):
+        assert main(["--explain", rule]) == 0
+        out = capsys.readouterr().out
+        assert "fires on:" in out and "quiet on:" in out
+
+
+def test_fix_waivers_keeps_surviving_rules(tmp_path, capsys):
+    from garage_tpu.analysis.__main__ import main
+
+    target = tmp_path / "garage_tpu" / "block" / "fake_fix.py"
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(textwrap.dedent("""\
+        async def teardown(sock):
+            try:
+                await sock.close()
+            except Exception:
+                pass  # lint: ignore[GL05, GL12] close is best-effort
+    """))
+    rc = main(["--fix-waivers", "--write", str(target)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "keep GL05" in out
+    text = target.read_text()
+    # GL12 (stale) stripped, GL05 (still suppressing) + reason kept
+    assert "# lint: ignore[GL05] close is best-effort" in text
+    assert "GL12" not in text
+    assert main(["--baseline", "none", str(target)]) == 0
+    capsys.readouterr()
+
+
+def test_fix_waivers_still_drops_fully_stale_comment(tmp_path, capsys):
+    from garage_tpu.analysis.__main__ import main
+
+    target = tmp_path / "garage_tpu" / "block" / "fake_fix2.py"
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(textwrap.dedent("""\
+        def f():
+            # lint: ignore[GL05] nothing here anymore
+            return 1
+    """))
+    rc = main(["--fix-waivers", "--write", str(target)])
+    assert rc == 0
+    assert "ignore[" not in target.read_text()
+    assert main(["--baseline", "none", str(target)]) == 0
+    capsys.readouterr()
+
+
+# ---- summary v4: determinism + cache round-trip -------------------------
+
+V4_RICH = """
+    class Guard:
+        pass
+
+    class P:
+        async def pump(self, items: list):
+            while self._more:
+                await self.flush()
+                self._cur = self.take()
+                last = self._cur
+
+        def swap(self):
+            lock_a = Guard()
+            lock_b = lock_a
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def route(self, t: "Guard"):
+            try:
+                g = Guard()
+            except ValueError:
+                g = None
+            return g
+"""
+
+
+def test_v4_fields_exist_and_are_byte_deterministic():
+    src = textwrap.dedent(V4_RICH)
+    a = summary_json(summarize_tree(ast.parse(src), "garage_tpu/m.py"))
+    b = summary_json(summarize_tree(ast.parse(src), "garage_tpu/m.py"))
+    assert a == b
+    payload = json.loads(a)
+    pump = payload["functions"]["P.pump"]
+    assert pump["cfg"]["blocks"], "explicit CFG serialized"
+    assert any(blk["back"] for blk in pump["cfg"]["blocks"])
+    assert pump["var_types"]["items"] == {"k": "ann", "t": "list"}
+    swap = payload["functions"]["P.swap"]
+    assert set(swap["alloc_sites"]) == {"lock_a", "lock_b"}
+    assert swap["alloc_sites"]["lock_a"] == \
+        swap["alloc_sites"]["lock_b"]  # alias shares the site
+    route = payload["functions"]["P.route"]
+    assert route["var_types"]["t"] == {"k": "ann", "t": "Guard"}
+
+
+def test_summary_version_is_4():
+    # cached v3 summaries lack cfg/alloc_sites/var_types and MUST be
+    # recomputed — the version bump is what invalidates them
+    assert SUMMARY_VERSION >= 4
+
+
+def test_v4_summary_cache_round_trip(tmp_path, capsys):
+    from garage_tpu.analysis.__main__ import main
+
+    target = tmp_path / "garage_tpu" / "block" / "fake_clean.py"
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(textwrap.dedent("""\
+        class Guard:
+            pass
+
+        def quiet(x: int):
+            g = Guard()
+            return (g, x)
+    """))
+    cache = tmp_path / "summaries.json"
+    args = ["--baseline", "none", "--format", "json",
+            "--summary-cache", str(cache), str(target)]
+    assert main(args) == 0
+    cold = json.loads(capsys.readouterr().out)
+    assert cold["summary_cache_hits"] == 0
+    raw = cache.read_text()
+    for field in ('"cfg"', '"alloc_sites"', '"var_types"'):
+        assert field in raw, f"{field} not persisted in the cache"
+    assert main(args) == 0
+    warm = json.loads(capsys.readouterr().out)
+    assert warm["summary_cache_hits"] >= 1
+    assert warm["violations"] == cold["violations"] == []

@@ -109,6 +109,31 @@ def test_repair_step_rebuilds_missing(dp, tp):
             treehash.blake3_py(shards[0, mi].tobytes())
 
 
+def test_repair_step_shares_one_program_across_patterns():
+    """ISSUE 20 / GL14 regression: make_repair_step was lru_cache'd per
+    (present, missing) pattern — C(n,k) compiled programs. The repair
+    matrix now rides as a tensor operand through a shape-keyed apply:
+    same-size patterns must share ONE cache entry and stay correct."""
+    from garage_tpu.parallel.mesh import _repair_apply_step
+
+    k, m = 4, 2
+    mesh = _mesh(4, 2)
+    batch = 8
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, size=(batch, k, S), dtype=np.uint8)
+    parity = np.stack([rs.encode_np(k, m, data[i]) for i in range(batch)])
+    shards = np.concatenate([data, parity], axis=1)
+    patterns = [((0, 1, 2, 4), (3,)), ((1, 2, 3, 5), (0,)),
+                ((0, 2, 3, 4), (1,))]
+    _repair_apply_step.cache_clear()
+    for present, missing in patterns:
+        repair = make_repair_step(mesh, k, m, present, missing, S)
+        rebuilt, _ = repair(shards[:, list(present), :])
+        np.testing.assert_array_equal(np.asarray(rebuilt),
+                                      shards[:, list(missing), :])
+    assert _repair_apply_step.cache_info().currsize == 1
+
+
 def test_layout_fallback_when_tp_does_not_divide_n():
     mesh = _mesh(2, 4)
     # n = 14, tp = 4: whole-shard layout must fall back to sharding S

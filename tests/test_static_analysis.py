@@ -519,7 +519,9 @@ def test_cli_runs_clean_json(capsys):
 def test_every_rule_has_an_id_and_fixture_coverage():
     ids = {r.id for r in default_rules()}
     assert ids == {f"GL0{i}" for i in range(1, 10)} | {"GL10", "GL11",
-                                                       "GL12", "GL13"}
+                                                       "GL12", "GL13",
+                                                       "GL14", "GL15",
+                                                       "GL16"}
 
 
 def test_every_rule_has_explain_material():
